@@ -1,0 +1,26 @@
+"""bcg_tpu — TPU-native Byzantine Consensus Game framework.
+
+A ground-up re-design of ``leorugli/byzantine-consensus-llm-agents`` for TPU
+hardware.  The reference drives every agent decision through a CUDA-backed
+vLLM engine; this framework replaces that engine with a JAX/XLA/Pallas
+inference stack (sharded weights over an ICI mesh, jitted autoregressive
+decode, schema-guided JSON decoding as an in-graph token-DFA mask) while
+keeping behavioural parity with the reference's game semantics, agent
+prompts, metrics, and CLI.
+
+Layer map (mirrors reference layers, reference file in parens):
+
+* ``bcg_tpu.config``    — typed, immutable config system   (config.py)
+* ``bcg_tpu.comm``      — protocol ABCs, A2A-sim, topology (communication_protocol.py,
+                          a2a_sim.py, agent_network.py, protocol_factory.py)
+* ``bcg_tpu.game``      — consensus state machine + stats  (byzantine_consensus.py)
+* ``bcg_tpu.agents``    — honest/Byzantine LLM agents      (bcg_agents.py)
+* ``bcg_tpu.engine``    — inference engines: JAX + fake    (vllm_agent.py)
+* ``bcg_tpu.models``    — decoder-only transformer family  (vLLM-internal in reference)
+* ``bcg_tpu.ops``       — Pallas/TPU kernels               (CUDA kernels in reference)
+* ``bcg_tpu.guided``    — JSON-schema guided decoding DFA  (vLLM GuidedDecodingParams)
+* ``bcg_tpu.parallel``  — mesh / sharding / collectives    (NCCL via torch.distributed)
+* ``bcg_tpu.runtime``   — orchestrator, metrics, CLI       (main.py)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,149 @@
+"""Typed configuration system for the Byzantine Consensus Game.
+
+Re-designs the reference's nine module-level mutable dicts
+(``byzantine_consensus_game/config.py:1-77``) as immutable dataclasses.  The
+reference mutates config globals from the CLI and from ``run_simulation``
+(``main.py:1042-1045, 1094-1102``); here every run receives its own frozen
+``BCGConfig`` value, eliminating cross-run state leaks while keeping the same
+defaults and knobs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+# Model presets used in the reference experiments (config.py:20-25).
+MODEL_PRESETS: Dict[str, str] = {
+    "qwen3-8b": "Qwen/Qwen3-8B",
+    "qwen3-14b": "Qwen/Qwen3-14B",
+    "qwen3-32b": "Qwen/Qwen3-32B",
+    "mistral-22b": "mistralai/Mistral-Small-Instruct-2409",
+    # Hermetic preset: tiny random-weight model + byte tokenizer, runs anywhere.
+    "tiny-test": "bcg-tpu/tiny-test",
+}
+
+# Default preset used when no model is selected (reference ACTIVE_MODEL,
+# config.py:30).  Select models per-run via EngineConfig(model_name=...) or
+# resolve_model_name(); this constant is informational, not a mutation knob.
+DEFAULT_MODEL = "qwen3-14b"
+
+
+@dataclass(frozen=True)
+class CommunicationConfig:
+    """Protocol selection (reference COMMUNICATION_CONFIG, config.py:7-9)."""
+
+    protocol_type: str = "a2a_sim"
+
+
+@dataclass(frozen=True)
+class NetworkConfig:
+    """Topology selection (reference NETWORK_CONFIG, config.py:12-15).
+
+    Unlike the reference, ``grid`` is actually wired up (the reference lists
+    it in config.py:13 but never dispatches to it, main.py:140-147).
+    """
+
+    topology_type: str = "fully_connected"  # fully_connected | ring | grid | custom
+    custom_adjacency: Optional[Dict[int, List[int]]] = None
+    grid_shape: Optional[Tuple[int, int]] = None  # (rows, cols) for grid
+
+
+@dataclass(frozen=True)
+class EngineConfig:
+    """Inference engine knobs (reference VLLM_CONFIG, config.py:33-41).
+
+    GPU-specific knobs map onto their TPU equivalents:
+
+    * ``gpu_memory_utilization`` -> ``hbm_utilization`` (KV-cache budget)
+    * ``tensor_parallel_size``   -> mesh ``tp`` axis size
+    * CUDA attention backend     -> ``attention_impl`` (pallas | xla)
+    """
+
+    model_name: str = MODEL_PRESETS["qwen3-14b"]
+    backend: str = "jax"  # jax | fake
+    max_model_len: int = 8192
+    hbm_utilization: float = 0.9
+    tensor_parallel_size: int = 1
+    data_parallel_size: int = 1
+    sequence_parallel_size: int = 1
+    max_num_seqs: int = 4
+    dtype: str = "bfloat16"
+    quantization: Optional[str] = None
+    disable_qwen3_thinking: bool = True
+    attention_impl: str = "auto"  # auto | pallas | xla
+    # Fake-backend determinism seed (ignored by the real engine).
+    fake_seed: int = 0
+
+
+@dataclass(frozen=True)
+class AgentConfig:
+    """Agent feature flags (reference AGENT_CONFIG, config.py:44-47)."""
+
+    use_structured_output: bool = True
+    use_batched_inference: bool = True
+
+
+@dataclass(frozen=True)
+class LLMConfig:
+    """Sampling parameters — single source of truth (reference LLM_CONFIG,
+    config.py:52-58)."""
+
+    temperature_decide: float = 0.5
+    temperature_vote: float = 0.3
+    max_tokens_decide: int = 300
+    max_tokens_vote: int = 200
+    max_json_retries: int = 3
+
+
+@dataclass(frozen=True)
+class GameConfig:
+    """Game parameters (reference BCG_CONFIG, config.py:61-67) plus a seed.
+
+    The reference never seeds its RNG (byzantine_consensus.py:125,138); we
+    thread an explicit seed so runs are reproducible when requested.
+    """
+
+    num_honest: int = 8
+    num_byzantine: int = 0
+    value_range: Tuple[int, int] = (0, 50)
+    consensus_threshold: float = 66.0
+    max_rounds: int = 50
+    byzantine_awareness: str = "may_exist"  # may_exist | none_exist
+    seed: Optional[int] = None
+
+
+@dataclass(frozen=True)
+class MetricsConfig:
+    """Result sinks (reference METRICS_CONFIG, config.py:70-77)."""
+
+    track_convergence: bool = True
+    track_byzantine_impact: bool = True
+    track_communication: bool = True
+    save_results: bool = True
+    generate_plots: bool = False
+    results_dir: str = "results"
+    checkpoint_every_round: bool = False
+
+
+@dataclass(frozen=True)
+class BCGConfig:
+    """Top-level bundle of every subsystem config."""
+
+    game: GameConfig = field(default_factory=GameConfig)
+    network: NetworkConfig = field(default_factory=NetworkConfig)
+    communication: CommunicationConfig = field(default_factory=CommunicationConfig)
+    engine: EngineConfig = field(default_factory=EngineConfig)
+    agent: AgentConfig = field(default_factory=AgentConfig)
+    llm: LLMConfig = field(default_factory=LLMConfig)
+    metrics: MetricsConfig = field(default_factory=MetricsConfig)
+    verbose: bool = False
+
+    def replace(self, **kwargs) -> "BCGConfig":
+        return dataclasses.replace(self, **kwargs)
+
+
+def resolve_model_name(name: str) -> str:
+    """Map a preset key (e.g. ``qwen3-14b``) to its full model path."""
+    return MODEL_PRESETS.get(name, name)

@@ -1,0 +1,191 @@
+"""Unit tests for the consensus game state machine.
+
+Covers the reference semantics the SURVEY calls out: unanimity-on-honest-
+initial-value consensus (byzantine_consensus.py:182-249), 2/3 stop vote
+(:373-398), deadline-always-loses (:507-518), and the 1/2-stop milestone
+(:314-371), plus edge cases with 0/1 honest values.
+"""
+
+import pytest
+
+from bcg_tpu.game import ByzantineConsensusGame
+
+
+def make_game(nh=4, nb=0, seed=0, **kw):
+    return ByzantineConsensusGame(
+        num_honest=nh, num_byzantine=nb, seed=seed, **kw
+    )
+
+
+def set_all(game, value):
+    for aid in game.agents:
+        game.update_agent_proposal(aid, value)
+
+
+class TestInit:
+    def test_seeded_determinism(self):
+        g1, g2 = make_game(seed=42, nb=2, nh=6), make_game(seed=42, nb=2, nh=6)
+        assert {a: s.initial_value for a, s in g1.agents.items()} == {
+            a: s.initial_value for a, s in g2.agents.items()
+        }
+        assert [s.is_byzantine for s in g1.agents.values()] == [
+            s.is_byzantine for s in g2.agents.values()
+        ]
+
+    def test_byzantine_have_no_initial_value(self):
+        g = make_game(nh=3, nb=2, seed=1)
+        for s in g.agents.values():
+            if s.is_byzantine:
+                assert s.initial_value is None and s.current_value is None
+            else:
+                lo, hi = g.value_range
+                assert lo <= s.initial_value <= hi
+
+    def test_counts(self):
+        g = make_game(nh=5, nb=3, seed=7)
+        byz = sum(s.is_byzantine for s in g.agents.values())
+        assert byz == 3 and len(g.agents) == 8
+
+
+class TestConsensus:
+    def test_unanimous_on_initial_value_is_consensus(self):
+        g = make_game(nh=4, seed=0)
+        target = g.agents["agent_0"].initial_value
+        set_all(g, target)
+        g.apply_proposals()
+        ok, pct = g.check_consensus()
+        assert ok and pct == 100.0
+
+    def test_unanimous_on_non_initial_value_is_not_consensus(self):
+        g = make_game(nh=4, seed=0, value_range=(0, 50))
+        initials = {s.initial_value for s in g.agents.values()}
+        outsider = next(v for v in range(0, 51) if v not in initials)
+        set_all(g, outsider)
+        g.apply_proposals()
+        ok, pct = g.check_consensus()
+        assert not ok and pct == 100.0
+
+    def test_partial_agreement(self):
+        g = make_game(nh=4, seed=0)
+        vals = [10, 10, 10, 20]
+        for aid, v in zip(sorted(g.agents), vals):
+            g.update_agent_proposal(aid, v)
+        g.apply_proposals()
+        ok, pct = g.check_consensus()
+        assert not ok and pct == 75.0
+
+    def test_byzantine_values_ignored(self):
+        g = make_game(nh=3, nb=2, seed=3)
+        honest = [aid for aid, s in g.agents.items() if not s.is_byzantine]
+        target = g.agents[honest[0]].initial_value
+        for aid in honest:
+            g.update_agent_proposal(aid, target)
+        for aid, s in g.agents.items():
+            if s.is_byzantine:
+                g.update_agent_proposal(aid, target + 1)
+        g.apply_proposals()
+        ok, _ = g.check_consensus()
+        assert ok
+
+    def test_single_honest_value_trivial_consensus(self):
+        g = make_game(nh=1, nb=1, seed=0)
+        aid = next(a for a, s in g.agents.items() if not s.is_byzantine)
+        g.update_agent_proposal(aid, g.agents[aid].initial_value)
+        g.apply_proposals()
+        ok, pct = g.check_consensus()
+        assert ok and pct == 100.0
+
+    def test_all_abstained_no_consensus(self):
+        g = make_game(nh=0, nb=2, seed=0)
+        ok, pct = g.check_consensus()
+        assert not ok and pct == 0.0
+
+
+class TestVoting:
+    def test_two_thirds_terminates(self):
+        g = make_game(nh=3, seed=0)
+        ids = sorted(g.agents)
+        assert g.should_terminate_by_vote({ids[0]: True, ids[1]: True, ids[2]: False})
+        assert not g.should_terminate_by_vote(
+            {ids[0]: True, ids[1]: False, ids[2]: False}
+        )
+
+    def test_abstain_does_not_count_as_stop(self):
+        g = make_game(nh=3, seed=0)
+        ids = sorted(g.agents)
+        assert not g.should_terminate_by_vote(
+            {ids[0]: True, ids[1]: None, ids[2]: None}
+        )
+
+    def test_vote_breakdown_by_role(self):
+        g = make_game(nh=2, nb=1, seed=5)
+        byz = next(a for a, s in g.agents.items() if s.is_byzantine)
+        honest = [a for a, s in g.agents.items() if not s.is_byzantine]
+        info = g.get_all_termination_votes({byz: True, honest[0]: True, honest[1]: None})
+        assert info["byzantine_stop_votes"] == 1
+        assert info["honest_stop_votes"] == 1
+        assert info["honest_abstentions"] == 1
+        assert info["total_abstentions"] == 1
+
+
+class TestTermination:
+    def test_vote_with_consensus_wins(self):
+        g = make_game(nh=3, seed=0)
+        target = g.agents["agent_0"].initial_value
+        set_all(g, target)
+        g.advance_round({aid: True for aid in g.agents})
+        assert g.game_over and g.honest_agents_won
+        assert g.termination_reason == "vote_with_consensus"
+        assert g.consensus_value == target
+
+    def test_vote_without_consensus_loses(self):
+        g = make_game(nh=3, seed=0)
+        for i, aid in enumerate(sorted(g.agents)):
+            g.update_agent_proposal(aid, i * 10)
+        g.advance_round({aid: True for aid in g.agents})
+        assert g.game_over and g.honest_agents_won is False
+        assert g.termination_reason == "vote_without_consensus"
+
+    def test_deadline_always_loses_even_with_agreement(self):
+        g = make_game(nh=3, seed=0, max_rounds=2)
+        target = g.agents["agent_0"].initial_value
+        for _ in range(2):
+            set_all(g, target)
+            g.advance_round({aid: False for aid in g.agents})
+        assert g.game_over
+        assert g.termination_reason == "max_rounds"
+        assert g.honest_agents_won is False
+        assert g.consensus_reached is False
+
+    def test_half_stop_milestone_recorded_once(self):
+        g = make_game(nh=4, seed=0, max_rounds=10)
+        ids = sorted(g.agents)
+        set_all(g, g.agents[ids[0]].initial_value)
+        g.advance_round({ids[0]: True, ids[1]: True, ids[2]: False, ids[3]: False})
+        assert g.first_half_stop_reached
+        assert g.first_half_stop_info["round"] == 1
+        first = g.first_half_stop_info
+        set_all(g, g.agents[ids[0]].initial_value)
+        g.advance_round({aid: True for aid in ids})
+        assert g.first_half_stop_info is first  # not overwritten
+
+    def test_game_state_hides_byzantine_identity(self):
+        g = make_game(nh=2, nb=2, seed=0)
+        state = g.get_game_state()
+        for payload in state["agent_states"].values():
+            assert "is_byzantine" not in payload
+
+
+class TestCheckpoint:
+    def test_snapshot_roundtrip(self):
+        import json
+
+        g = make_game(nh=3, nb=1, seed=9, max_rounds=5)
+        set_all(g, 7)
+        g.advance_round({aid: False for aid in g.agents})
+        blob = json.dumps(g.snapshot())
+        g2 = ByzantineConsensusGame.from_snapshot(json.loads(blob))
+        assert g2.current_round == g.current_round
+        assert g2.get_game_state() == g.get_game_state()
+        # RNG stream continues identically after restore.
+        assert g.rng.randint(0, 10**9) == g2.rng.randint(0, 10**9)

@@ -1,0 +1,131 @@
+"""Tests for Q1/Q2/Q3 statistics (reference byzantine_consensus.py:544-839)."""
+
+from bcg_tpu.game import ByzantineConsensusGame
+
+
+def play_to_consensus(game, target, rounds=1, final_votes=True):
+    for _ in range(rounds):
+        for aid in game.agents:
+            game.update_agent_proposal(aid, target)
+        votes = {aid: final_votes for aid in game.agents}
+        game.advance_round(votes)
+        if game.game_over:
+            break
+    return game
+
+
+def test_empty_before_first_round():
+    g = ByzantineConsensusGame(num_honest=2, seed=0)
+    assert g.get_statistics() == {}
+
+
+def test_valid_outcome_and_quality_score():
+    g = ByzantineConsensusGame(num_honest=4, seed=0, max_rounds=10)
+    target = g.agents["agent_0"].initial_value
+    play_to_consensus(g, target)
+    s = g.get_statistics()
+    assert s["consensus_outcome"] == "valid"
+    assert s["consensus_is_valid"] is True
+    assert s["honest_unanimous"] is True
+    assert s["unanimous_value"] == target
+    assert s["convergence_speed"] == 1
+    assert s["convergence_rate"] == 1.0
+    assert s["stability_rounds"] == 1
+    # quality = 50*1 + 30*centrality + 20*(1 - 1/10)
+    assert s["consensus_quality_score"] == 50 + 30 * s["centrality"] + 20 * 0.9
+    assert s["inclusivity"] == 1.0
+    assert s["agreement_rate"] == 100.0
+
+
+def test_timeout_outcome():
+    g = ByzantineConsensusGame(num_honest=3, seed=0, max_rounds=1)
+    target = g.agents["agent_0"].initial_value
+    play_to_consensus(g, target, final_votes=False)
+    s = g.get_statistics()
+    assert s["termination_reason"] == "max_rounds"
+    assert s["consensus_outcome"] == "timeout"
+    assert s["honest_agents_won"] is False
+
+
+def test_invalid_outcome_unanimous_non_initial():
+    g = ByzantineConsensusGame(num_honest=3, seed=0, value_range=(0, 50))
+    initials = {s.initial_value for s in g.agents.values()}
+    outsider = next(v for v in range(51) if v not in initials)
+    play_to_consensus(g, outsider)
+    s = g.get_statistics()
+    assert s["consensus_outcome"] == "invalid"
+    assert s["consensus_reached"] is False  # game-level flag follows check_consensus
+
+
+def test_none_outcome_disagreement():
+    g = ByzantineConsensusGame(num_honest=3, seed=0)
+    for i, aid in enumerate(sorted(g.agents)):
+        g.update_agent_proposal(aid, i)
+    g.advance_round({aid: True for aid in g.agents})
+    s = g.get_statistics()
+    assert s["consensus_outcome"] == "none"
+
+
+def test_byzantine_infiltration():
+    g = ByzantineConsensusGame(num_honest=3, num_byzantine=2, seed=1)
+    honest = [a for a, st in g.agents.items() if not st.is_byzantine]
+    byz = [a for a, st in g.agents.items() if st.is_byzantine]
+    target = g.agents[honest[0]].initial_value
+    for aid in honest:
+        g.update_agent_proposal(aid, target)
+    # one byzantine copies consensus, one deviates
+    g.update_agent_proposal(byz[0], target)
+    g.update_agent_proposal(byz[1], (target + 1) % 51)
+    g.advance_round({aid: True for aid in g.agents})
+    s = g.get_statistics()
+    assert s["byzantine_infiltration"] == 50.0
+    assert s["byzantine_agent_ids"] == sorted(byz) or set(
+        s["byzantine_agent_ids"]
+    ) == set(byz)
+
+
+def test_q3_keyword_detection_honest_only():
+    g = ByzantineConsensusGame(num_honest=2, num_byzantine=1, seed=2)
+    honest = [a for a, st in g.agents.items() if not st.is_byzantine]
+    byz = [a for a, st in g.agents.items() if st.is_byzantine]
+    g.store_round_reasoning(
+        {
+            honest[0]: "agent_3 looks Suspicious, possibly Byzantine behaviour",
+            honest[1]: "converging to the median",
+            byz[0]: "I am suspicious and malicious",  # must NOT count
+        }
+    )
+    target = g.agents[honest[0]].initial_value
+    play_to_consensus(g, target)
+    s = g.get_statistics()
+    assert s["keyword_counts"]["suspicious"] == 1
+    assert s["keyword_counts"]["byzantine"] == 1
+    assert s["keyword_counts"]["malicious"] == 0
+    assert s["total_keyword_mentions"] == 2
+    assert s["honest_reasoning_count"] == 2
+
+
+def test_rounds_data_structure():
+    g = ByzantineConsensusGame(num_honest=2, seed=0, max_rounds=5)
+    play_to_consensus(g, g.agents["agent_0"].initial_value, rounds=2)
+    s = g.get_statistics()
+    rd = s["rounds_data"]
+    assert len(rd) == s["total_rounds"]
+    assert {"round", "honest_values", "has_consensus", "consensus_value"} <= set(rd[0])
+
+
+def test_consensus_preference_flags():
+    g = ByzantineConsensusGame(num_honest=3, seed=0, value_range=(0, 50))
+    # Force known initials by rebuilding agent states.
+    for aid, v in zip(sorted(g.agents), [10, 20, 30]):
+        st = g.agents[aid]
+        st.initial_value = v
+        st.current_value = v
+        st.proposed_value = v
+    play_to_consensus(g, 10)
+    s = g.get_statistics()
+    assert s["consensus_is_initial"] is True
+    assert s["consensus_is_extreme"] is True  # 10 == min, range >= 2
+    assert s["consensus_is_median"] is False
+    assert s["consensus_distance_from_median"] == 10
+    assert s["centrality"] == 1.0 - 10 / 20
