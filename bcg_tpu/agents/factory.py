@@ -1,0 +1,34 @@
+"""Agent factory (reference ``bcg_agents.py:1402-1441``)."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+from bcg_tpu.agents.base import BCGAgent
+from bcg_tpu.agents.byzantine import ByzantineBCGAgent
+from bcg_tpu.agents.honest import HonestBCGAgent
+from bcg_tpu.config import LLMConfig
+from bcg_tpu.engine.interface import InferenceEngine
+
+
+def create_agent(
+    agent_id: str,
+    is_byzantine: bool,
+    engine: InferenceEngine,
+    value_range: Tuple[int, int],
+    byzantine_awareness: str = "may_exist",
+    llm_config: LLMConfig = LLMConfig(),
+) -> BCGAgent:
+    cls = ByzantineBCGAgent if is_byzantine else HonestBCGAgent
+    return cls(
+        agent_id=agent_id,
+        is_byzantine=is_byzantine,
+        engine=engine,
+        value_range=value_range,
+        byzantine_awareness=byzantine_awareness,
+        max_json_retries=llm_config.max_json_retries,
+        temperature_decide=llm_config.temperature_decide,
+        temperature_vote=llm_config.temperature_vote,
+        max_tokens_decide=llm_config.max_tokens_decide,
+        max_tokens_vote=llm_config.max_tokens_vote,
+    )

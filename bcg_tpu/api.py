@@ -1,0 +1,62 @@
+"""Programmatic batch-experiment API (reference ``main.py:1073-1141``).
+
+``run_simulation`` runs one game with file-saving disabled and returns
+``{"metrics": stats}``.  Unlike the reference, which temporarily mutates
+METRICS_CONFIG/VLLM_CONFIG globals with a finally-restore dance
+(main.py:1094-1102), each call here builds its own immutable config.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from bcg_tpu.config import BCGConfig, resolve_model_name
+from bcg_tpu.engine.interface import InferenceEngine
+
+
+def run_simulation(
+    n_agents: int = 8,
+    max_rounds: int = 50,
+    model_name: Optional[str] = None,
+    byzantine_count: int = 0,
+    byzantine_awareness: str = "may_exist",
+    backend: Optional[str] = None,
+    seed: Optional[int] = None,
+    engine: Optional[InferenceEngine] = None,
+    config: Optional[BCGConfig] = None,
+) -> dict:
+    """Run a single simulation for batch experiments; no files written."""
+    from bcg_tpu.runtime.orchestrator import BCGSimulation
+
+    base = config or BCGConfig()
+    game = dataclasses.replace(
+        base.game,
+        num_honest=n_agents - byzantine_count,
+        num_byzantine=byzantine_count,
+        max_rounds=max_rounds,
+        byzantine_awareness=byzantine_awareness,
+        seed=seed if seed is not None else base.game.seed,
+    )
+    engine_cfg = base.engine
+    if model_name:
+        engine_cfg = dataclasses.replace(engine_cfg, model_name=resolve_model_name(model_name))
+    if backend:
+        engine_cfg = dataclasses.replace(engine_cfg, backend=backend)
+    metrics = dataclasses.replace(base.metrics, save_results=False, generate_plots=False)
+
+    sim = BCGSimulation(
+        config=dataclasses.replace(base, game=game, engine=engine_cfg, metrics=metrics),
+        engine=engine,
+    )
+    try:
+        while not sim.game.game_over:
+            sim.run_round()
+        stats = sim.game.get_statistics()
+        stats["byzantine_awareness"] = byzantine_awareness
+        return {"metrics": stats}
+    finally:
+        if engine is None:
+            # We created the engine internally; release its device memory.
+            sim.engine.shutdown()
+        sim.close()

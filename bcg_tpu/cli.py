@@ -1,0 +1,143 @@
+"""CLI entry point (reference ``main.py:998-1070``).
+
+Same flags as the reference plus TPU-era additions (--backend, --model,
+--seed, --topology, --results-dir, --checkpoint-every-round, --resume).
+
+    python -m bcg_tpu.cli --honest 8 --byzantine 2 --rounds 50
+    python -m bcg_tpu.cli --honest 4 --byzantine 0 --backend fake --seed 0
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import sys
+from typing import Optional
+
+from bcg_tpu.config import (
+    AgentConfig,
+    BCGConfig,
+    EngineConfig,
+    GameConfig,
+    MetricsConfig,
+    NetworkConfig,
+    resolve_model_name,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="Byzantine Consensus Game Simulation (TPU-native)")
+    p.add_argument("--honest", type=int, default=None, help="Number of honest agents")
+    p.add_argument("--byzantine", type=int, default=None, help="Number of Byzantine agents (can be 0)")
+    p.add_argument("--rounds", type=int, default=None, help="Max number of rounds")
+    p.add_argument("--threshold", type=float, default=None, help="Reported majority agreement percentage (default: 66)")
+    p.add_argument("--value-range", type=str, default=None, help="Value range as 'min-max' (default: 0-50)")
+    p.add_argument(
+        "--byzantine-awareness",
+        type=str,
+        default="may_exist",
+        choices=["may_exist", "none_exist"],
+        help="Whether honest agents are told Byzantine agents may exist",
+    )
+    p.add_argument("--verbose", action="store_true", help="Print detailed output to terminal")
+    # TPU-era additions
+    p.add_argument("--backend", type=str, default=None, choices=["jax", "fake"], help="Inference backend")
+    p.add_argument("--model", type=str, default=None, help="Model preset key or full path")
+    p.add_argument("--seed", type=int, default=None, help="Game RNG seed (reproducible runs)")
+    p.add_argument("--topology", type=str, default=None, choices=["fully_connected", "ring", "grid"], help="Network topology")
+    p.add_argument("--results-dir", type=str, default=None, help="Results directory")
+    p.add_argument("--no-save", action="store_true", help="Disable result files")
+    p.add_argument("--checkpoint-every-round", action="store_true", help="Write a resumable checkpoint after each round")
+    p.add_argument("--resume", type=str, default=None, help="Resume from checkpoint file")
+    p.add_argument("--tensor-parallel", type=int, default=None, help="TP mesh axis size")
+    return p
+
+
+def config_from_args(args) -> BCGConfig:
+    base = BCGConfig()
+    game = base.game
+    if args.value_range:
+        try:
+            lo, hi = map(int, args.value_range.split("-"))
+        except ValueError:
+            raise SystemExit(
+                f"Error: Invalid value range format '{args.value_range}'. Use 'min-max' (e.g., 0-50)"
+            )
+        value_range = (lo, hi)
+    else:
+        value_range = game.value_range
+
+    game = dataclasses.replace(
+        game,
+        num_honest=args.honest if args.honest is not None else game.num_honest,
+        num_byzantine=args.byzantine if args.byzantine is not None else game.num_byzantine,
+        max_rounds=args.rounds if args.rounds is not None else game.max_rounds,
+        consensus_threshold=args.threshold if args.threshold is not None else game.consensus_threshold,
+        value_range=value_range,
+        byzantine_awareness=args.byzantine_awareness,
+        seed=args.seed,
+    )
+    engine = base.engine
+    if args.backend:
+        engine = dataclasses.replace(engine, backend=args.backend)
+    if args.model:
+        engine = dataclasses.replace(engine, model_name=resolve_model_name(args.model))
+    if args.tensor_parallel:
+        engine = dataclasses.replace(engine, tensor_parallel_size=args.tensor_parallel)
+    network = base.network
+    if args.topology:
+        network = dataclasses.replace(network, topology_type=args.topology)
+    metrics = base.metrics
+    if args.results_dir:
+        metrics = dataclasses.replace(metrics, results_dir=args.results_dir)
+    if args.no_save:
+        metrics = dataclasses.replace(metrics, save_results=False)
+    if args.checkpoint_every_round:
+        metrics = dataclasses.replace(metrics, checkpoint_every_round=True)
+
+    return BCGConfig(
+        game=game,
+        network=network,
+        engine=engine,
+        metrics=metrics,
+        verbose=args.verbose,
+    )
+
+
+def main(argv: Optional[list] = None) -> int:
+    args = build_parser().parse_args(argv)
+    config = config_from_args(args)
+
+    print("=" * 60)
+    print("Configuration:")
+    print(f"  Honest agents: {config.game.num_honest}")
+    print(f"  Byzantine agents: {config.game.num_byzantine}")
+    print(f"  Value range: {config.game.value_range[0]}-{config.game.value_range[1]}")
+    print(f"  Max rounds: {config.game.max_rounds}")
+    print(f"  Consensus threshold: {config.game.consensus_threshold}%")
+    print(f"  Byzantine awareness: {config.game.byzantine_awareness}")
+    print(f"  Backend: {config.engine.backend} ({config.engine.model_name})")
+    print("=" * 60)
+
+    try:
+        if args.resume:
+            from bcg_tpu.runtime.checkpoint import resume_simulation
+
+            sim = resume_simulation(args.resume, config=config)
+        else:
+            from bcg_tpu.runtime.orchestrator import BCGSimulation
+
+            sim = BCGSimulation(config=config)
+    except (ValueError, FileNotFoundError) as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    try:
+        sim.run()
+    finally:
+        sim.engine.shutdown()
+        sim.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,260 @@
+"""A2A-Sim protocol: synchronous, idealized agent-to-agent messaging.
+
+Behavioural clone of the reference ``a2a_sim.py``:
+
+* static undirected graph, neighbour-only routing with validation
+* dual payload — structured :class:`Decision` + free-text reasoning capped
+  at 500 chars
+* per-round buffered delivery; all round-t messages arrive before t+1
+* duplicate suppression keyed on (sender, receiver, round, phase, timestamp)
+* inbox ordering by (sender_id, timestamp)
+
+Improvement over the reference: the orchestrator actually calls
+``clear_round_buffer`` each round (the reference defines it at
+a2a_sim.py:235-244 but never calls it, so buffers grow for the whole run).
+The aggregate message count survives clearing via a per-round counter.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from enum import Enum
+from typing import Any, Dict, List, Optional, Set
+
+from bcg_tpu.comm.protocol import CommunicationProtocol, Message, ProtocolClient
+
+REASONING_CHAR_LIMIT = 500  # a2a_sim.py:69-73
+
+
+class Phase(str, Enum):
+    """Protocol phases (reference a2a_sim.py:20-26)."""
+
+    PROPOSE = "propose"
+    PREPARE = "prepare"
+    COMMIT = "commit"
+    CUSTOM = "custom"
+
+
+class DecisionType(str, Enum):
+    """Structured decision kinds (reference a2a_sim.py:28-32)."""
+
+    VALUE = "value"
+    VOTE = "vote"
+    ABSTAIN = "abstain"
+
+
+@dataclass
+class Decision:
+    """Machine-readable action part of a message (reference a2a_sim.py:35-46)."""
+
+    type: str
+    value: Any
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"type": self.type, "value": self.value}
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Decision":
+        return cls(type=data["type"], value=data["value"])
+
+
+@dataclass
+class A2AMessage(Message):
+    """Dual-payload message (reference a2a_sim.py:49-113).
+
+    Carries both a structured decision and the sender's public reasoning;
+    the timestamp is a per-sender monotonic counter used for total ordering
+    and duplicate suppression.
+    """
+
+    sender_id: int
+    receiver_id: int
+    round: int
+    phase: str
+    decision: Decision
+    reasoning: str
+    timestamp: int
+
+    def __post_init__(self):
+        if len(self.reasoning) > REASONING_CHAR_LIMIT:
+            self.reasoning = self.reasoning[: REASONING_CHAR_LIMIT - 3] + "..."
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "sender_id": self.sender_id,
+            "receiver_id": self.receiver_id,
+            "round": self.round,
+            "phase": self.phase,
+            "decision": self.decision.to_dict(),
+            "reasoning": self.reasoning,
+            "timestamp": self.timestamp,
+        }
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "A2AMessage":
+        return cls(
+            sender_id=data["sender_id"],
+            receiver_id=data["receiver_id"],
+            round=data["round"],
+            phase=data["phase"],
+            decision=Decision.from_dict(data["decision"]),
+            reasoning=data["reasoning"],
+            timestamp=data["timestamp"],
+        )
+
+    def _key(self):
+        return (self.sender_id, self.receiver_id, self.round, self.phase, self.timestamp)
+
+    def __hash__(self):
+        return hash(self._key())
+
+    def __eq__(self, other):
+        return isinstance(other, A2AMessage) and self._key() == other._key()
+
+
+class A2ASimProtocol(CommunicationProtocol):
+    """Round-buffered router over a static graph (reference a2a_sim.py:116-298)."""
+
+    def __init__(self, num_agents: int, topology: Dict[int, List[int]]):
+        super().__init__(num_agents, topology)
+        # round -> receiver_id -> inbox list
+        self.message_buffer: Dict[int, Dict[int, List[A2AMessage]]] = {}
+        self.delivered: Set[A2AMessage] = set()
+        # round -> count, survives clear_round_buffer so aggregate metrics
+        # stay correct even with per-round GC.
+        self._round_counts: Dict[int, int] = {}
+        self.current_round = 0
+        self.current_phase = Phase.PROPOSE.value
+
+    def send_message(self, sender_id: int, receiver_id: int, message: A2AMessage) -> None:
+        """Buffer a point-to-point message after neighbour validation and
+        duplicate suppression (reference a2a_sim.py:157-181)."""
+        if receiver_id not in self.topology.get(sender_id, []):
+            raise ValueError(
+                f"Agent {sender_id} cannot send to {receiver_id}: not in neighbor set"
+            )
+        if message in self.delivered:
+            return
+        inbox = self.message_buffer.setdefault(message.round, {}).setdefault(
+            receiver_id, []
+        )
+        inbox.append(message)
+        self.delivered.add(message)
+        self._round_counts[message.round] = self._round_counts.get(message.round, 0) + 1
+
+    def broadcast_to_neighbors(
+        self,
+        sender_id: int,
+        round: int,
+        phase: str,
+        decision: Decision,
+        reasoning: str,
+        timestamp: int,
+    ) -> None:
+        """Multicast illusion: identical content to every neighbour
+        (reference a2a_sim.py:183-210)."""
+        for neighbor_id in self.topology.get(sender_id, []):
+            self.send_message(
+                sender_id,
+                neighbor_id,
+                A2AMessage(
+                    sender_id=sender_id,
+                    receiver_id=neighbor_id,
+                    round=round,
+                    phase=phase,
+                    decision=decision,
+                    reasoning=reasoning,
+                    timestamp=timestamp,
+                ),
+            )
+
+    def deliver_messages(self, agent_id: int, round: int) -> List[A2AMessage]:
+        """Inbox for (agent, round), ordered by (sender_id, timestamp)
+        (reference a2a_sim.py:212-233)."""
+        inbox = self.message_buffer.get(round, {}).get(agent_id, [])
+        return sorted(inbox, key=lambda m: (m.sender_id, m.timestamp))
+
+    def clear_round_buffer(self, round: int) -> None:
+        """GC a completed round's buffers and delivered-set entries."""
+        dropped = self.message_buffer.pop(round, None)
+        if dropped:
+            for inbox in dropped.values():
+                for msg in inbox:
+                    self.delivered.discard(msg)
+
+    def get_neighbors(self, agent_id: int) -> List[int]:
+        return self.topology.get(agent_id, [])
+
+    def set_phase(self, round: int, phase: str) -> None:
+        self.current_round = round
+        self.current_phase = phase
+
+    def get_message_count(self, round: int) -> int:
+        return self._round_counts.get(round, 0)
+
+    def get_total_message_count(self) -> int:
+        """Total messages across all rounds (fixes the reference's final-
+        round undercount — main.py:804-808 sums ``range(current_round)``
+        against 1-indexed round keys)."""
+        return sum(self._round_counts.values())
+
+    def reset(self) -> None:
+        self.message_buffer.clear()
+        self.delivered.clear()
+        self._round_counts.clear()
+        self.current_round = 0
+
+    def create_client(self, agent_id: int) -> "A2ASimClient":
+        return A2ASimClient(agent_id=agent_id, protocol=self)
+
+
+class A2ASimClient(ProtocolClient):
+    """Agent-side handle: send, receive, and persistent history H_i
+    (reference a2a_sim.py:301-387)."""
+
+    def __init__(self, agent_id: int, protocol: A2ASimProtocol):
+        super().__init__(agent_id, protocol)
+        self.protocol: A2ASimProtocol = protocol
+        self.history: List[Dict[str, Any]] = []
+        self._timestamp_counter = 0
+
+    def next_timestamp(self) -> int:
+        self._timestamp_counter += 1
+        return self._timestamp_counter
+
+    def receive_messages(self, round: int) -> List[A2AMessage]:
+        return self.protocol.deliver_messages(self.agent_id, round)
+
+    def send_to_neighbors(
+        self, round: int, phase: str = Phase.PROPOSE.value,
+        decision: Optional[Decision] = None, reasoning: str = "",
+    ) -> None:
+        self.protocol.broadcast_to_neighbors(
+            sender_id=self.agent_id,
+            round=round,
+            phase=phase,
+            decision=decision,
+            reasoning=reasoning,
+            timestamp=self.next_timestamp(),
+        )
+
+    def update_history(
+        self, round: int, inbox: List[A2AMessage], local_state: Dict[str, Any]
+    ) -> None:
+        self.history.append(
+            {
+                "round": round,
+                "inbox": [m.to_dict() for m in inbox],
+                "local_state": local_state,
+            }
+        )
+
+    def get_neighbors(self) -> List[int]:
+        return self.protocol.get_neighbors(self.agent_id)
+
+    def get_history(self) -> List[Dict[str, Any]]:
+        return self.history
+
+    def reset(self) -> None:
+        self.history.clear()
+        self._timestamp_counter = 0

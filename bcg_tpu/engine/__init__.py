@@ -1,0 +1,15 @@
+"""Inference engines.
+
+The reference funnels every agent decision through a CUDA vLLM singleton
+(``vllm_agent.py:58-551``).  Here the engine is an injected dependency
+behind :class:`InferenceEngine`:
+
+* :class:`bcg_tpu.engine.jax_engine.JaxEngine` — the TPU path: sharded
+  weights, jitted prefill+decode, DFA-guided JSON decoding.
+* :class:`bcg_tpu.engine.fake.FakeEngine` — deterministic, game-aware
+  backend for hermetic tests (the reference ships no test backend at all).
+"""
+
+from bcg_tpu.engine.interface import GenerationRequest, InferenceEngine, create_engine
+
+__all__ = ["InferenceEngine", "GenerationRequest", "create_engine"]

@@ -1,0 +1,155 @@
+"""Deterministic fake inference engine for hermetic tests.
+
+The reference has no test backend (SURVEY.md §4); every piece of game
+logic upstream of the LLM is untestable there without a GPU.  This engine
+implements the full :class:`InferenceEngine` contract with deterministic,
+game-aware behaviour so the orchestrator, retry ladder, metrics, and CLI
+run end-to-end on any machine in milliseconds.
+
+Policies
+--------
+* ``consensus`` (default): honest-looking behaviour that converges — for
+  decision schemas it proposes the most common value visible in the
+  prompt (ties -> smallest), falling back to the agent's current value or
+  the schema's midpoint; for vote schemas it votes "stop" iff every value
+  mentioned in the current-round section agrees.
+* ``schema_min``: emits the minimal schema-conforming object.
+* ``disrupt``: for Byzantine-shaped schemas (value accepts "abstain"),
+  proposes values far from the observed mode and votes "continue".
+
+Failure injection: ``fail_first_n_calls`` makes the first N ``*_json``
+calls return invalid results, exercising the orchestrator's batch-retry →
+sequential fallback ladder (reference main.py:293-341).
+"""
+
+from __future__ import annotations
+
+import random
+import re
+from collections import Counter
+from typing import Any, Dict, List, Optional, Tuple
+
+from bcg_tpu.engine.interface import InferenceEngine
+
+# Matches per-agent proposal lines in round summaries ("agent_3 value: 17"),
+# not the agent's own "Your current value: N" line.
+_VALUE_RE = re.compile(r"agent_\w+ value: (-?\d+)")
+_CURRENT_RE = re.compile(r"[Yy]our current value: (-?\d+)")
+
+
+def _schema_bounds(schema: Dict[str, Any]) -> Tuple[int, int]:
+    """Extract integer bounds from a decision schema (handles the Byzantine
+    anyOf[int, "abstain"] form)."""
+    vs = schema.get("properties", {}).get("value", {})
+    if "anyOf" in vs:
+        for option in vs["anyOf"]:
+            if option.get("type") == "integer":
+                vs = option
+                break
+    return int(vs.get("minimum", 0)), int(vs.get("maximum", 100))
+
+
+def _is_vote_schema(schema: Dict[str, Any]) -> bool:
+    return "decision" in schema.get("properties", {})
+
+
+def _vote_options(schema: Dict[str, Any]) -> List[str]:
+    return schema["properties"]["decision"].get("enum", ["stop", "continue"])
+
+
+class FakeEngine(InferenceEngine):
+    def __init__(
+        self,
+        seed: int = 0,
+        policy: str = "consensus",
+        fail_first_n_calls: int = 0,
+    ):
+        self.rng = random.Random(seed)
+        self.policy = policy
+        self.fail_first_n_calls = fail_first_n_calls
+        self.call_count = 0  # counts individual JSON generations
+        self.batch_calls = 0
+
+    # ------------------------------------------------------------- free text
+
+    def generate(self, prompt, temperature=0.0, max_tokens=256, top_p=1.0,
+                 system_prompt=None) -> str:
+        return f"[fake:{len(prompt)}ch]"
+
+    def batch_generate(self, prompts, temperature=0.0, max_tokens=256, top_p=1.0):
+        return [self.generate(p) for p in prompts]
+
+    # ------------------------------------------------------------------ JSON
+
+    def generate_json(self, prompt, schema, temperature=0.0, max_tokens=512,
+                      system_prompt=None) -> Dict[str, Any]:
+        self.call_count += 1
+        if self.call_count <= self.fail_first_n_calls:
+            return {"error": "fake_injected_failure", "message": "injected"}
+        return self._respond(system_prompt or "", prompt, schema)
+
+    def batch_generate_json(self, prompts, temperature=0.8, max_tokens=512):
+        self.batch_calls += 1
+        out = []
+        for system_prompt, user_prompt, schema in prompts:
+            self.call_count += 1
+            if self.call_count <= self.fail_first_n_calls:
+                out.append({"error": "fake_injected_failure", "message": "injected"})
+            else:
+                out.append(self._respond(system_prompt, user_prompt, schema))
+        return out
+
+    # ---------------------------------------------------------------- policy
+
+    def _respond(self, system_prompt: str, user_prompt: str, schema: Dict) -> Dict:
+        if _is_vote_schema(schema):
+            return self._vote(user_prompt, schema)
+        return self._decide(user_prompt, schema)
+
+    def _decide(self, prompt: str, schema: Dict) -> Dict:
+        lo, hi = _schema_bounds(schema)
+        observed = [int(v) for v in _VALUE_RE.findall(prompt)]
+        current = _CURRENT_RE.search(prompt)
+        current_value = int(current.group(1)) if current else None
+
+        if self.policy == "schema_min":
+            value: Any = lo
+        elif self.policy == "disrupt":
+            # Push away from the observed mode; occasionally abstain when
+            # the schema allows it.
+            allows_abstain = "anyOf" in schema.get("properties", {}).get("value", {})
+            if allows_abstain and self.rng.random() < 0.2:
+                value = "abstain"
+            elif observed:
+                mode = Counter(observed).most_common(1)[0][0]
+                value = hi if mode <= (lo + hi) // 2 else lo
+            else:
+                value = self.rng.randint(lo, hi)
+        else:  # consensus
+            if observed:
+                # most common, smallest on ties -> deterministic attractor
+                counts = Counter(observed)
+                best = max(counts.values())
+                value = min(v for v, c in counts.items() if c == best)
+            elif current_value is not None:
+                value = current_value
+            else:
+                value = (lo + hi) // 2
+            value = max(lo, min(hi, value))
+
+        return {
+            "internal_strategy": f"fake[{self.policy}] tracking {len(observed)} proposals",
+            "value": value,
+            "public_reasoning": f"Proposing {value} based on the visible round history.",
+        }
+
+    def _vote(self, prompt: str, schema: Dict) -> Dict:
+        options = _vote_options(schema)
+        if self.policy == "disrupt" and "continue" in options:
+            return {"decision": "continue"}
+        # Look only at the current-round section if present.
+        section = prompt.split("PREVIOUS ROUNDS")[0]
+        observed = [int(v) for v in re.findall(r": (-?\d+)", section)]
+        unanimous = len(observed) > 0 and len(set(observed)) == 1
+        decision = "stop" if unanimous and "stop" in options else "continue"
+        return {"decision": decision}

@@ -1,0 +1,124 @@
+"""Engine interface: the contract the reference implements at
+``vllm_agent.py:159-504`` (generate / generate_json / batch_generate_json /
+batch_generate / shutdown), re-designed as an ABC with engines injected
+rather than inherited-from, so game logic is testable without any
+accelerator.
+"""
+
+from __future__ import annotations
+
+import json
+from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+
+@dataclass
+class GenerationRequest:
+    """One structured-generation request: chat prompt pair + JSON schema."""
+
+    system_prompt: str
+    user_prompt: str
+    schema: Optional[Dict[str, Any]] = None
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+class InferenceEngine(ABC):
+    """Shared LLM serving all agents (single weights, many prompts)."""
+
+    @abstractmethod
+    def generate(
+        self,
+        prompt: str,
+        temperature: float = 0.0,
+        max_tokens: int = 256,
+        top_p: float = 1.0,
+        system_prompt: Optional[str] = None,
+    ) -> str:
+        """Free-text generation for a single prompt."""
+
+    @abstractmethod
+    def batch_generate(
+        self,
+        prompts: List[str],
+        temperature: float = 0.0,
+        max_tokens: int = 256,
+        top_p: float = 1.0,
+    ) -> List[str]:
+        """Free-text generation for a padded batch of prompts."""
+
+    @abstractmethod
+    def generate_json(
+        self,
+        prompt: str,
+        schema: Dict[str, Any],
+        temperature: float = 0.0,
+        max_tokens: int = 512,
+        system_prompt: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """Schema-guided JSON generation.  Returns the parsed object, or a
+        dict with an ``"error"`` key on failure (contract of reference
+        vllm_agent.py:294-379 — callers branch on ``"error" in result``)."""
+
+    @abstractmethod
+    def batch_generate_json(
+        self,
+        prompts: List[Tuple[str, str, Dict[str, Any]]],
+        temperature: float = 0.8,
+        max_tokens: int = 512,
+    ) -> List[Dict[str, Any]]:
+        """Batched schema-guided generation over (system, user, schema)
+        tuples.  Unlike the reference (vllm_agent.py:417-455, which falls
+        back to sequential calls when schemas differ), implementations here
+        are expected to batch heterogeneous schemas via per-sequence DFA
+        masks."""
+
+    def shutdown(self) -> None:
+        """Release device resources (reference vllm_agent.py:506-551)."""
+
+    # ---------------------------------------------------------------- helpers
+
+    @staticmethod
+    def extract_json(text: str) -> Optional[Dict[str, Any]]:
+        """Brace-matching JSON salvage (reference vllm_agent.py:457-472)."""
+        start = text.find("{")
+        if start < 0:
+            return None
+        depth = 0
+        in_string = False
+        escaped = False
+        for i in range(start, len(text)):
+            ch = text[i]
+            if in_string:
+                if escaped:
+                    escaped = False
+                elif ch == "\\":
+                    escaped = True
+                elif ch == '"':
+                    in_string = False
+                continue
+            if ch == '"':
+                in_string = True
+            elif ch == "{":
+                depth += 1
+            elif ch == "}":
+                depth -= 1
+                if depth == 0:
+                    try:
+                        return json.loads(text[start : i + 1])
+                    except (json.JSONDecodeError, ValueError):
+                        return None
+        return None
+
+
+def create_engine(engine_config, llm_config=None) -> InferenceEngine:
+    """Build an engine from :class:`bcg_tpu.config.EngineConfig`."""
+    if engine_config.backend == "fake":
+        from bcg_tpu.engine.fake import FakeEngine
+
+        return FakeEngine(seed=engine_config.fake_seed)
+    if engine_config.backend == "jax":
+        from bcg_tpu.engine.jax_engine import JaxEngine
+
+        return JaxEngine(engine_config)
+    raise ValueError(f"Unknown engine backend: {engine_config.backend!r}")

@@ -1,0 +1,6 @@
+"""Simulation runtime: orchestrator, metrics sinks, logging, checkpointing,
+profiling, CLI (reference ``main.py``)."""
+
+from bcg_tpu.runtime.orchestrator import BCGSimulation
+
+__all__ = ["BCGSimulation"]

@@ -1,0 +1,74 @@
+"""Per-round checkpoint / resume.
+
+The reference persists only terminal artifacts — a crashed 50-round run
+loses everything (SURVEY.md §5.4).  Game + agent memories are a small JSON
+blob; model weights never need checkpointing (inference only), so resume
+cost is one engine warm-up.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+
+def save_checkpoint(sim, path: str) -> str:
+    """Serialize simulation state (game, agent memories, network round)."""
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    blob = {
+        "version": 1,
+        "run_number": sim.run_number,
+        "game": sim.game.snapshot(),
+        "agents": {aid: agent.snapshot() for aid, agent in sim.agents.items()},
+        "network_round": sim.network.current_round,
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(blob, f)
+    os.replace(tmp, path)  # atomic
+    return path
+
+
+def load_checkpoint(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def resume_simulation(path: str, config=None, engine=None):
+    """Rebuild a :class:`BCGSimulation` from a checkpoint.
+
+    The restored game is authoritative: agents are re-created from ITS
+    Byzantine assignment (a fresh, unseeded simulation would otherwise
+    roll different roles than the checkpoint), then their memories are
+    restored.  ``sim.run()`` continues from the next round under the
+    original run number, appending to the original log.
+    """
+    from bcg_tpu.config import BCGConfig
+    from bcg_tpu.game import ByzantineConsensusGame
+    from bcg_tpu.runtime.orchestrator import BCGSimulation
+
+    blob = load_checkpoint(path)
+    config = config or BCGConfig()
+    sim = BCGSimulation(
+        config=config,
+        engine=engine,
+        run_number=blob["run_number"],
+        log_mode="a",
+    )
+    sim.game = ByzantineConsensusGame.from_snapshot(blob["game"])
+    # Re-create agents against the restored game's roles (the initial
+    # construction used a freshly-rolled game whose Byzantine assignment
+    # need not match the checkpoint).
+    sim.agents = {}
+    sim._create_agents()
+    for aid, agent_blob in blob["agents"].items():
+        if aid in sim.agents:
+            sim.agents[aid].restore(agent_blob)
+            # Initial values feed cached system prompts; re-sync them.
+            game_agent = sim.game.agents[aid]
+            if game_agent.initial_value is not None:
+                sim.agents[aid].set_initial_value(game_agent.initial_value)
+                sim.agents[aid].my_value = agent_blob["my_value"]
+    sim.network.current_round = blob["network_round"]
+    return sim

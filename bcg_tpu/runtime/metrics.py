@@ -1,0 +1,219 @@
+"""Result persistence: JSON + CSV metrics sinks.
+
+Byte-compatible with the reference layout (``main.py:792-995``):
+``results/json/run_NNN.json`` (config + statistics + per-round trajectory +
+final state + message count), ``results/metrics/run_NNN.csv`` (fixed column
+order with the reference's rounding map), ``results/logs/run_NNN_log.txt``
+(written live by :class:`RunLogger`).  Adds performance fields the
+reference lacks (rounds/sec, decisions/sec).
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+from dataclasses import asdict
+from datetime import datetime
+from typing import Dict, Optional
+
+# Fixed CSV column order (reference main.py:911-951).
+CSV_FIELDNAMES = [
+    "run_number",
+    "timestamp",
+    # Core outcome
+    "consensus_reached",
+    "consensus_outcome",
+    "honest_agents_won",
+    "total_rounds",
+    "max_rounds",
+    "consensus_value",
+    # Q1
+    "convergence_speed",
+    "consensus_is_median",
+    "consensus_is_extreme",
+    "consensus_is_initial",
+    "trajectory_stability",
+    "final_convergence_metric",
+    "convergence_rate_percent",
+    # Q2
+    "centrality",
+    "inclusivity",
+    "stability_rounds",
+    "agreement_rate",
+    "consensus_quality_score",
+    "avg_distance_from_consensus",
+    "byzantine_infiltration",
+    # Initial state
+    "honest_initial_mean",
+    "honest_initial_median",
+    "honest_initial_std",
+    "honest_final_std",
+    # Communication
+    "a2a_message_count",
+    # Config
+    "value_range",
+    "network_topology",
+    "model_name",
+    "byzantine_strategy",
+    "honest_agent_type",
+    "protocol_type",
+    # Performance (new vs reference)
+    "wall_clock_seconds",
+    "rounds_per_sec",
+    "decisions_per_sec",
+]
+
+# Rounding map (reference main.py:955-969).
+PRECISION_MAP = {
+    "final_convergence_metric": 1,
+    "convergence_rate_percent": 1,
+    "agreement_rate": 1,
+    "consensus_quality_score": 1,
+    "avg_distance_from_consensus": 3,
+    "honest_initial_std": 3,
+    "honest_final_std": 3,
+    "byzantine_infiltration": 1,
+    "centrality": 3,
+    "inclusivity": 3,
+    "trajectory_stability": 3,
+    "honest_initial_mean": 2,
+    "honest_initial_median": 2,
+    "wall_clock_seconds": 2,
+    "rounds_per_sec": 4,
+    "decisions_per_sec": 3,
+}
+
+
+def build_metrics_payload(
+    run_number: int,
+    stats: Dict,
+    config,
+    message_count: int,
+    profile: Optional[Dict] = None,
+    timestamp: Optional[str] = None,
+) -> Dict:
+    """Flat ~38-field metrics dict (reference main.py:852-903)."""
+    convergence_rate = stats.get("convergence_rate")
+    profile = profile or {}
+    return {
+        "run_number": run_number,
+        "timestamp": timestamp or datetime.now().strftime("%Y%m%d_%H%M%S"),
+        # Core outcome
+        "consensus_reached": stats.get("consensus_reached"),
+        "consensus_outcome": stats.get("consensus_outcome"),
+        "honest_agents_won": stats.get("honest_agents_won"),
+        "total_rounds": stats.get("total_rounds"),
+        "max_rounds": stats.get("max_rounds"),
+        "consensus_value": stats.get("consensus_value"),
+        # Q1
+        "convergence_speed": stats.get("convergence_speed"),
+        "consensus_is_median": stats.get("consensus_is_median"),
+        "consensus_is_extreme": stats.get("consensus_is_extreme"),
+        "consensus_is_initial": stats.get("consensus_is_initial"),
+        "trajectory_stability": stats.get("trajectory_stability"),
+        "final_convergence_metric": stats.get("final_convergence_metric"),
+        "convergence_rate_percent": (
+            convergence_rate * 100 if convergence_rate is not None else None
+        ),
+        # Q2
+        "centrality": stats.get("centrality"),
+        "inclusivity": stats.get("inclusivity"),
+        "stability_rounds": stats.get("stability_rounds"),
+        "agreement_rate": stats.get("agreement_rate"),
+        "consensus_quality_score": stats.get("consensus_quality_score"),
+        "avg_distance_from_consensus": stats.get("avg_distance_from_consensus"),
+        "byzantine_infiltration": stats.get("byzantine_infiltration"),
+        # Initial state
+        "honest_initial_mean": stats.get("honest_initial_mean"),
+        "honest_initial_median": stats.get("honest_initial_median"),
+        "honest_initial_std": stats.get("honest_initial_std"),
+        "honest_final_std": stats.get("honest_final_std"),
+        # Communication
+        "a2a_message_count": message_count,
+        # Config echo
+        "value_range": list(config.game.value_range),
+        "network_topology": config.network.topology_type,
+        "model_name": config.engine.model_name,
+        # The reference reads these two keys from AGENT_CONFIG where they are
+        # never defined (main.py:899-900) — always None.  Kept for CSV-column
+        # parity, populated with honest defaults.
+        "byzantine_strategy": "llm",
+        "honest_agent_type": "llm",
+        "protocol_type": config.communication.protocol_type,
+        # Performance
+        "wall_clock_seconds": profile.get("total_seconds"),
+        "rounds_per_sec": profile.get("rounds_per_sec"),
+        "decisions_per_sec": profile.get("decisions_per_sec"),
+    }
+
+
+def save_json_results(
+    results_dir: str,
+    run_number: str,
+    config,
+    stats: Dict,
+    metrics: Dict,
+    game,
+    message_count: int,
+) -> str:
+    """results/json/run_NNN.json (reference main.py:813-834)."""
+    json_dir = os.path.join(results_dir, "json")
+    os.makedirs(json_dir, exist_ok=True)
+    path = os.path.join(json_dir, f"run_{run_number}.json")
+    results = {
+        "run_number": int(run_number),
+        "timestamp": metrics["timestamp"],
+        "config": asdict(config),
+        "statistics": stats,
+        "metrics": metrics,
+        "rounds": [
+            {
+                "round": r.round_num,
+                "honest_mean": r.honest_mean,
+                "honest_std": r.honest_std,
+                "convergence_metric": r.convergence_metric,
+                "has_consensus": r.has_consensus,
+            }
+            for r in game.rounds
+        ],
+        "final_state": game.get_game_state(),
+        "a2a_message_count": message_count,
+    }
+    with open(path, "w") as f:
+        json.dump(results, f, indent=2)
+    return path
+
+
+def save_metrics_csv(results_dir: str, run_number: str, metrics: Dict) -> str:
+    """results/metrics/run_NNN.csv — one header + one row, with the
+    reference's rounding and formatting rules (main.py:905-995):
+    None -> "", list -> "a-b", bool -> "True"/"False"."""
+    metrics_dir = os.path.join(results_dir, "metrics")
+    os.makedirs(metrics_dir, exist_ok=True)
+    path = os.path.join(metrics_dir, f"run_{run_number}.csv")
+
+    row = {field: metrics.get(field) for field in CSV_FIELDNAMES}
+    for key, decimals in PRECISION_MAP.items():
+        value = row.get(key)
+        if value is None:
+            row[key] = ""
+        else:
+            try:
+                row[key] = round(float(value), decimals)
+            except (TypeError, ValueError):
+                row[key] = value
+    for key in CSV_FIELDNAMES:
+        value = row.get(key)
+        if value is None:
+            row[key] = ""
+        elif isinstance(value, list):
+            row[key] = "-".join(str(v) for v in value)
+        elif isinstance(value, bool):
+            row[key] = str(value)
+
+    with open(path, "w", newline="") as f:
+        writer = csv.DictWriter(f, fieldnames=CSV_FIELDNAMES)
+        writer.writeheader()
+        writer.writerow(row)
+    return path

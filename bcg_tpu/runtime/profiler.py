@@ -1,0 +1,66 @@
+"""Phase-level wall-clock profiling.
+
+The reference has no timing instrumentation at all (SURVEY.md §5.1).  This
+collects per-phase wall time and derives the driver's headline metrics —
+rounds/sec and agent-decisions/sec — plus optional ``jax.profiler`` traces.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Dict, Optional
+
+
+class SimulationProfiler:
+    def __init__(self):
+        self.phase_seconds: Dict[str, float] = defaultdict(float)
+        self.phase_counts: Dict[str, int] = defaultdict(int)
+        self.rounds = 0
+        self.decisions = 0  # LLM-made agent decisions (decide + vote calls)
+        self._start = time.perf_counter()
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.phase_seconds[name] += time.perf_counter() - t0
+            self.phase_counts[name] += 1
+
+    def count_round(self, num_decisions: int) -> None:
+        self.rounds += 1
+        self.decisions += num_decisions
+
+    @property
+    def total_seconds(self) -> float:
+        return time.perf_counter() - self._start
+
+    def summary(self) -> Dict:
+        total = self.total_seconds
+        return {
+            "total_seconds": total,
+            "rounds": self.rounds,
+            "decisions": self.decisions,
+            "rounds_per_sec": self.rounds / total if total > 0 else 0.0,
+            "decisions_per_sec": self.decisions / total if total > 0 else 0.0,
+            "phase_seconds": dict(self.phase_seconds),
+            "phase_counts": dict(self.phase_counts),
+        }
+
+
+@contextlib.contextmanager
+def jax_trace(log_dir: Optional[str]):
+    """Wrap a block in a ``jax.profiler`` trace when a log dir is given."""
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,244 @@
+"""Tests for the agent layer against the deterministic fake engine."""
+
+import pytest
+
+from bcg_tpu.agents import AgentMemory, ByzantineBCGAgent, HonestBCGAgent, create_agent
+from bcg_tpu.engine.fake import FakeEngine
+
+
+def honest(engine=None, awareness="may_exist", **kw):
+    a = create_agent(
+        "agent_0", False, engine or FakeEngine(), (0, 50), byzantine_awareness=awareness, **kw
+    )
+    a.set_initial_value(10)
+    return a
+
+
+def byz(engine=None, **kw):
+    return create_agent("agent_1", True, engine or FakeEngine(), (0, 50), **kw)
+
+
+GAME_STATE = {"round": 1, "max_rounds": 20}
+
+
+class TestMemory:
+    def test_round_summary_cap(self):
+        m = AgentMemory()
+        for i in range(10):
+            m.add_round_summary(f"r{i}", max_history=5)
+        assert m.last_k_rounds == [f"r{i}" for i in range(5, 10)]
+
+    def test_strategy_cap_and_order(self):
+        m = AgentMemory()
+        for i in range(7):
+            m.add_internal_strategy(i, f"s{i}")
+        assert m.last_k_internal_strategies[0] == (2, "s2")
+        assert len(m.last_k_internal_strategies) == 5
+
+    def test_neighbor_stats(self):
+        m = AgentMemory()
+        m.update_neighbor_stat("a", 5)
+        m.update_neighbor_stat("a", 7)
+        assert m.neighbor_stats["a"] == {"last_value": 7, "message_count": 1}
+
+    def test_snapshot_roundtrip(self):
+        m = AgentMemory()
+        m.add_round_summary("x")
+        m.add_internal_strategy(1, "plan")
+        m.update_neighbor_stat("b", 3)
+        m2 = AgentMemory.from_snapshot(m.snapshot())
+        assert m2.last_k_rounds == m.last_k_rounds
+        assert m2.last_k_internal_strategies == m.last_k_internal_strategies
+        assert m2.neighbor_stats == m.neighbor_stats
+
+
+class TestPrompts:
+    def test_honest_system_prompt_contains_rules(self):
+        a = honest()
+        sp = a.build_system_prompt(GAME_STATE)
+        assert "HONEST" in sp and "Byzantine" in sp
+        assert "between 0 and 50" in sp
+        assert "Your Initial Value: 10" in sp
+        assert "66%+" in sp
+
+    def test_none_exist_variant(self):
+        a = honest(awareness="none_exist")
+        sp = a.build_system_prompt(GAME_STATE)
+        assert "NO Byzantine" in sp
+        assert "Cooperative" in sp
+
+    def test_system_prompt_cached_and_invalidated(self):
+        a = honest()
+        sp1 = a.build_system_prompt(GAME_STATE)
+        assert a.build_system_prompt({"max_rounds": 99}) is sp1  # cached
+        a.set_initial_value(20)
+        assert "Your Initial Value: 20" in a.build_system_prompt(GAME_STATE)
+
+    def test_byzantine_system_prompt(self):
+        b = byz()
+        sp = b.build_system_prompt(GAME_STATE)
+        assert "BYZANTINE" in sp and "secret" in sp.lower()
+        assert "NO initial value" in sp
+
+    def test_round_prompt_history_most_recent_first(self):
+        a = honest()
+        for i in range(1, 5):
+            a.memory.add_round_summary(f"Round {i}: agent_0 value: {i}")
+        rp = a.build_round_prompt(GAME_STATE)
+        i3, i4 = rp.index("Round 3:"), rp.index("Round 4:")
+        assert i4 < i3  # most recent first
+        assert "Round 1:" not in rp  # only last 3 shown
+
+    def test_vote_round_prompt_shows_current_proposals(self):
+        a = honest()
+        a.my_value = 12
+        a.last_reasoning = "R" * 300
+        a.receive_proposals([("agent_1", 13, "their reasons"), ("agent_2", 12, "")])
+        vp = a.build_vote_round_prompt(GAME_STATE)
+        assert "agent_0 (you): 12" in vp
+        assert "agent_1: 13" in vp
+        # own reasoning snipped to 200 chars
+        assert "R" * 200 in vp and "R" * 201 not in vp
+
+    def test_abstained_agent_shown(self):
+        b = byz()
+        b.my_value = None
+        vp = b.build_vote_round_prompt(GAME_STATE)
+        assert "ABSTAINED" in vp
+
+
+class TestSchemas:
+    def test_honest_decision_schema(self):
+        s = honest().decision_schema()
+        assert s["properties"]["value"] == {"type": "integer", "minimum": 0, "maximum": 50}
+        assert s["required"] == ["internal_strategy", "value", "public_reasoning"]
+        assert s["additionalProperties"] is False
+
+    def test_byzantine_decision_schema_allows_abstain(self):
+        s = byz().decision_schema()
+        any_of = s["properties"]["value"]["anyOf"]
+        assert {"type": "string", "enum": ["abstain"]} in any_of
+        assert s["required"] == ["internal_strategy", "value"]
+
+    def test_vote_schemas(self):
+        assert honest().vote_schema()["properties"]["decision"]["enum"] == [
+            "stop", "continue",
+        ]
+        assert byz().vote_schema()["properties"]["decision"]["enum"] == [
+            "stop", "continue", "abstain",
+        ]
+
+
+class TestParsing:
+    def test_honest_clamps_out_of_range(self):
+        a = honest()
+        v = a.parse_decision_response(
+            {"internal_strategy": "s", "value": 999, "public_reasoning": "r"}, GAME_STATE
+        )
+        assert v == 50
+
+    def test_honest_truncates_reasoning_to_600(self):
+        a = honest()
+        a.parse_decision_response(
+            {"internal_strategy": "s" * 500, "value": 5, "public_reasoning": "x" * 700},
+            GAME_STATE,
+        )
+        assert len(a.last_reasoning) == 600
+        assert len(a.memory.last_k_internal_strategies[0][1]) == 400
+
+    def test_honest_error_means_abstain(self):
+        a = honest()
+        assert a.parse_decision_response({"error": "boom"}, GAME_STATE) is None
+        assert "FAILED" in a.last_reasoning
+
+    def test_byzantine_abstain_records_strategy(self):
+        b = byz()
+        v = b.parse_decision_response(
+            {"internal_strategy": "lurk", "value": "abstain", "public_reasoning": "hmm"},
+            GAME_STATE,
+        )
+        assert v is None
+        assert b.memory.last_k_internal_strategies[0][1] == "lurk"
+        assert b.last_reasoning == "hmm"
+
+    def test_byzantine_unexpected_type_is_abstain(self):
+        b = byz()
+        assert (
+            b.parse_decision_response(
+                {"internal_strategy": "s", "value": [1, 2]}, GAME_STATE
+            )
+            is None
+        )
+
+    def test_vote_parsing(self):
+        a, b = honest(), byz()
+        assert a.parse_vote_response({"decision": "stop"}, GAME_STATE) is True
+        assert a.parse_vote_response({"decision": "continue"}, GAME_STATE) is False
+        assert a.parse_vote_response({"error": "x"}, GAME_STATE) is False
+        assert b.parse_vote_response({"decision": "abstain"}, GAME_STATE) is None
+        assert b.parse_vote_response({"decision": " STOP "}, GAME_STATE) is True
+
+
+class TestRetryLadder:
+    def test_decide_retries_then_succeeds(self):
+        eng = FakeEngine(fail_first_n_calls=2)
+        a = honest(engine=eng)
+        v = a.decide_next_value(GAME_STATE)
+        assert v is not None
+        assert eng.call_count == 3  # 2 failures + 1 success
+
+    def test_decide_total_failure_abstains(self):
+        eng = FakeEngine(fail_first_n_calls=99)
+        a = honest(engine=eng)
+        assert a.decide_next_value(GAME_STATE) is None
+        assert eng.call_count == 3  # capped at max_json_retries
+
+    def test_vote_total_failure_defaults_continue(self):
+        eng = FakeEngine(fail_first_n_calls=99)
+        a = honest(engine=eng)
+        assert a.vote_to_terminate(GAME_STATE) is False
+
+
+class TestFakePolicies:
+    def test_consensus_policy_follows_mode(self):
+        a = honest()
+        a.memory.add_round_summary(
+            "Round 1: agent_0 value: 10; agent_1 value: 30; agent_2 value: 30"
+        )
+        v = a.decide_next_value({"round": 2, "max_rounds": 20})
+        assert v == 30
+
+    def test_consensus_policy_keeps_current_value_without_history(self):
+        a = honest()
+        assert a.decide_next_value(GAME_STATE) == 10
+
+    def test_vote_stop_when_unanimous(self):
+        a = honest()
+        a.my_value = 7
+        a.receive_proposals([("agent_1", 7, ""), ("agent_2", 7, "")])
+        assert a.vote_to_terminate(GAME_STATE) is True
+
+    def test_vote_continue_when_split(self):
+        a = honest()
+        a.my_value = 7
+        a.receive_proposals([("agent_1", 8, ""), ("agent_2", 7, "")])
+        assert a.vote_to_terminate(GAME_STATE) is False
+
+    def test_disrupt_policy_pushes_away(self):
+        b = byz(engine=FakeEngine(policy="disrupt", seed=1))
+        b.memory.add_round_summary("Round 1: agent_0 value: 5; agent_2 value: 5")
+        v = b.decide_next_value(GAME_STATE)
+        assert v is None or v >= 25  # abstain or far from mode
+
+    def test_snapshot_restore(self):
+        a = honest()
+        a.my_value = 33
+        a.receive_proposals([("agent_1", 2, "x")])
+        a.last_reasoning = "why"
+        a.memory.add_round_summary("Round 1: ...")
+        blob = a.snapshot()
+        fresh = honest()
+        fresh.restore(blob)
+        assert fresh.my_value == 33
+        assert fresh.received_proposals == [("agent_1", 2, "x")]
+        assert fresh.memory.last_k_rounds == ["Round 1: ..."]

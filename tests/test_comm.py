@@ -1,0 +1,197 @@
+"""Tests for the communication layer: topology, A2A-sim protocol, network.
+
+Covers reference semantics: neighbour-only routing (a2a_sim.py:169-171),
+duplicate suppression (:173-175), inbox ordering (:231), reasoning cap
+(:69-73), multicast illusion (:183-210), plus the grid topology the
+reference never wired up.
+"""
+
+import numpy as np
+import pytest
+
+from bcg_tpu.comm import (
+    A2AMessage,
+    A2ASimProtocol,
+    AgentNetwork,
+    Decision,
+    DecisionType,
+    NetworkTopology,
+    Phase,
+    create_protocol,
+    register_protocol,
+)
+
+
+def msg(sender, receiver, round=1, ts=1, phase=Phase.PROPOSE.value, value=5, reasoning="r"):
+    return A2AMessage(
+        sender_id=sender,
+        receiver_id=receiver,
+        round=round,
+        phase=phase,
+        decision=Decision(type=DecisionType.VALUE.value, value=value),
+        reasoning=reasoning,
+        timestamp=ts,
+    )
+
+
+class TestTopology:
+    def test_fully_connected(self):
+        t = NetworkTopology.fully_connected(4)
+        assert all(len(v) == 3 for v in t.adjacency_list.values())
+        assert t.avg_degree == 3.0
+
+    def test_ring(self):
+        t = NetworkTopology.ring(5)
+        assert sorted(t.adjacency_list[0]) == [1, 4]
+        assert t.avg_degree == 2.0
+
+    def test_grid(self):
+        t = NetworkTopology.grid(2, 3)
+        assert t.num_agents == 6
+        # corner has 2 neighbours, middle-edge has 3
+        assert sorted(t.adjacency_list[0]) == [1, 3]
+        assert sorted(t.adjacency_list[1]) == [0, 2, 4]
+
+    def test_custom(self):
+        t = NetworkTopology.custom({0: [1], 1: [0]})
+        assert t.topology_type == "custom" and t.num_agents == 2
+
+    def test_neighbor_mask_matches_adjacency(self):
+        t = NetworkTopology.ring(4)
+        m = t.neighbor_mask()
+        assert m.shape == (4, 4)
+        assert not m.diagonal().any()
+        for i, nbrs in t.adjacency_list.items():
+            assert set(np.where(m[i])[0]) == set(nbrs)
+
+
+class TestA2ASim:
+    def setup_method(self):
+        self.topo = NetworkTopology.fully_connected(3)
+        self.proto = A2ASimProtocol(3, self.topo.adjacency_list)
+
+    def test_send_and_deliver(self):
+        self.proto.send_message(0, 1, msg(0, 1))
+        inbox = self.proto.deliver_messages(1, 1)
+        assert len(inbox) == 1 and inbox[0].decision.value == 5
+
+    def test_non_neighbor_send_rejected(self):
+        ring = NetworkTopology.ring(4)
+        proto = A2ASimProtocol(4, ring.adjacency_list)
+        with pytest.raises(ValueError, match="not in neighbor set"):
+            proto.send_message(0, 2, msg(0, 2))
+
+    def test_duplicate_suppression(self):
+        m = msg(0, 1)
+        self.proto.send_message(0, 1, m)
+        self.proto.send_message(0, 1, msg(0, 1))  # same key -> suppressed
+        assert len(self.proto.deliver_messages(1, 1)) == 1
+        assert self.proto.get_message_count(1) == 1
+
+    def test_inbox_ordering_by_sender_then_timestamp(self):
+        self.proto.send_message(2, 0, msg(2, 0, ts=1))
+        self.proto.send_message(1, 0, msg(1, 0, ts=2))
+        self.proto.send_message(1, 0, msg(1, 0, ts=1, phase="prepare"))
+        inbox = self.proto.deliver_messages(0, 1)
+        assert [(m.sender_id, m.timestamp) for m in inbox] == [(1, 1), (1, 2), (2, 1)]
+
+    def test_broadcast_reaches_all_neighbors_identically(self):
+        self.proto.broadcast_to_neighbors(
+            0, 1, Phase.PROPOSE.value, Decision("value", 9), "hello", timestamp=1
+        )
+        for receiver in (1, 2):
+            inbox = self.proto.deliver_messages(receiver, 1)
+            assert len(inbox) == 1
+            assert inbox[0].decision.value == 9 and inbox[0].reasoning == "hello"
+        assert self.proto.deliver_messages(0, 1) == []  # no self-delivery
+        assert self.proto.get_message_count(1) == 2
+
+    def test_reasoning_truncated_to_500(self):
+        m = msg(0, 1, reasoning="x" * 600)
+        assert len(m.reasoning) == 500 and m.reasoning.endswith("...")
+
+    def test_clear_round_buffer_frees_memory_keeps_count(self):
+        self.proto.send_message(0, 1, msg(0, 1))
+        self.proto.clear_round_buffer(1)
+        assert self.proto.deliver_messages(1, 1) == []
+        assert self.proto.get_message_count(1) == 1  # metric survives GC
+        assert len(self.proto.delivered) == 0
+
+    def test_message_roundtrip_serialization(self):
+        m = msg(0, 1, value=7, reasoning="why")
+        m2 = A2AMessage.from_dict(m.to_dict())
+        assert m2 == m and m2.decision.value == 7
+
+    def test_client_monotonic_timestamps(self):
+        c = self.proto.create_client(0)
+        c.send_to_neighbors(round=1, phase="propose", decision=Decision("value", 1), reasoning="")
+        c.send_to_neighbors(round=1, phase="propose", decision=Decision("value", 2), reasoning="")
+        inbox = self.proto.deliver_messages(1, 1)
+        assert [m.timestamp for m in inbox] == [1, 2]
+
+    def test_client_history(self):
+        c = self.proto.create_client(0)
+        c.update_history(1, [msg(1, 0)], {"v": 3})
+        h = c.get_history()
+        assert len(h) == 1 and h[0]["round"] == 1 and h[0]["local_state"] == {"v": 3}
+        c.reset()
+        assert c.get_history() == []
+
+    def test_reset(self):
+        self.proto.send_message(0, 1, msg(0, 1))
+        self.proto.reset()
+        assert self.proto.deliver_messages(1, 1) == []
+        assert self.proto.get_message_count(1) == 0
+
+
+class TestFactory:
+    def test_create_a2a_sim(self):
+        t = NetworkTopology.fully_connected(2)
+        p = create_protocol("a2a_sim", 2, t.adjacency_list)
+        assert isinstance(p, A2ASimProtocol)
+
+    def test_unknown_protocol_raises(self):
+        with pytest.raises(ValueError, match="Unknown protocol"):
+            create_protocol("nope", 2, {})
+
+    def test_register_custom(self):
+        class Dummy(A2ASimProtocol):
+            pass
+
+        register_protocol("dummy", lambda num_agents, topology, config: Dummy(num_agents, topology))
+        p = create_protocol("dummy", 2, NetworkTopology.ring(2).adjacency_list)
+        assert isinstance(p, Dummy)
+
+
+class TestNetwork:
+    def make_net(self, n=3):
+        topo = NetworkTopology.fully_connected(n)
+        proto = A2ASimProtocol(n, topo.adjacency_list)
+        net = AgentNetwork(topo, proto)
+        for i in range(n):
+            net.register_agent(f"agent_{i}", object(), i)
+        return net
+
+    def test_broadcast_and_receive_by_string_id(self):
+        net = self.make_net()
+        net.broadcast_message("agent_0", 1, Phase.PROPOSE, Decision("value", 4), "because")
+        msgs = net.get_messages("agent_1", 1, Phase.PROPOSE)
+        assert len(msgs) == 1 and msgs[0].decision.value == 4
+        assert net.index_to_agent_id[msgs[0].sender_id] == "agent_0"
+
+    def test_network_stats(self):
+        net = self.make_net()
+        net.broadcast_message("agent_0", 0, Phase.PROPOSE, Decision("value", 1), "")
+        net.advance_round()
+        stats = net.get_network_stats()
+        assert stats["total_messages"] == 2
+        assert stats["topology_type"] == "fully_connected"
+        assert stats["avg_degree"] == 2.0
+
+    def test_end_round_gc(self):
+        net = self.make_net()
+        net.broadcast_message("agent_0", 0, Phase.PROPOSE, Decision("value", 1), "")
+        net.advance_round()
+        net.end_round_gc(0)
+        assert net.get_messages("agent_1", 0) == []
+        assert net.get_network_stats()["total_messages"] == 2  # metric kept

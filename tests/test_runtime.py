@@ -1,0 +1,277 @@
+"""End-to-end runtime tests on the fake backend: orchestrator round loop,
+retry ladder, metrics sinks, checkpoint/resume, CLI, batch API."""
+
+import csv
+import dataclasses
+import json
+import os
+
+import pytest
+
+from bcg_tpu.api import run_simulation
+from bcg_tpu.config import (
+    AgentConfig,
+    BCGConfig,
+    EngineConfig,
+    GameConfig,
+    MetricsConfig,
+    NetworkConfig,
+)
+from bcg_tpu.engine.fake import FakeEngine
+from bcg_tpu.runtime.orchestrator import BCGSimulation, build_topology
+
+
+def make_config(tmp_path=None, nh=4, nb=0, max_rounds=8, seed=0, **game_kw):
+    return BCGConfig(
+        game=GameConfig(
+            num_honest=nh, num_byzantine=nb, max_rounds=max_rounds, seed=seed, **game_kw
+        ),
+        engine=EngineConfig(backend="fake", model_name="bcg-tpu/tiny-test"),
+        metrics=MetricsConfig(
+            save_results=tmp_path is not None,
+            results_dir=str(tmp_path) if tmp_path else "results",
+        ),
+    )
+
+
+class TestEndToEnd:
+    def test_honest_game_converges_and_wins(self):
+        sim = BCGSimulation(config=make_config(nh=4, max_rounds=10))
+        stats = sim.run()
+        assert stats["consensus_outcome"] == "valid"
+        assert stats["honest_agents_won"] is True
+        assert stats["total_rounds"] <= 4  # fake consensus policy converges fast
+        assert stats["termination_reason"] == "vote_with_consensus"
+
+    def test_seeded_runs_are_identical(self):
+        s1 = BCGSimulation(config=make_config(seed=5)).run()
+        s2 = BCGSimulation(config=make_config(seed=5)).run()
+        assert s1["consensus_value"] == s2["consensus_value"]
+        assert s1["total_rounds"] == s2["total_rounds"]
+        assert s1["rounds_data"] == s2["rounds_data"]
+
+    def test_byzantine_game_runs_to_completion(self):
+        cfg = make_config(nh=4, nb=2, max_rounds=6)
+        sim = BCGSimulation(config=cfg, engine=FakeEngine(seed=3))
+        stats = sim.run()
+        assert stats["total_rounds"] >= 1
+        assert stats["termination_reason"] in (
+            "vote_with_consensus",
+            "vote_without_consensus",
+            "max_rounds",
+        )
+        assert len(stats["byzantine_agent_ids"]) == 2
+
+    def test_sequential_mode_matches_contract(self):
+        cfg = dataclasses.replace(
+            make_config(nh=3, max_rounds=6),
+            agent=AgentConfig(use_batched_inference=False),
+        )
+        stats = BCGSimulation(config=cfg).run()
+        assert stats["consensus_outcome"] == "valid"
+
+    def test_ring_topology_limits_messages(self):
+        cfg = dataclasses.replace(
+            make_config(nh=4, max_rounds=3),
+            network=NetworkConfig(topology_type="ring"),
+        )
+        sim = BCGSimulation(config=cfg)
+        sim.run_round()
+        # ring: each of 4 agents broadcasts to 2 neighbours
+        assert sim.network.protocol.get_message_count(1) == 8
+
+    def test_grid_topology_wired(self):
+        cfg = dataclasses.replace(
+            make_config(nh=4, max_rounds=3),
+            network=NetworkConfig(topology_type="grid", grid_shape=(2, 2)),
+        )
+        sim = BCGSimulation(config=cfg)
+        assert sim.topology.topology_type == "grid"
+        sim.run_round()
+        assert sim.network.protocol.get_message_count(1) == 8  # 4 agents x 2 nbrs
+
+    def test_grid_shape_mismatch_raises(self):
+        with pytest.raises(ValueError, match="grid"):
+            build_topology(5, NetworkConfig(topology_type="grid", grid_shape=(2, 2)))
+
+
+class TestRetryLadder:
+    def test_batch_failures_recover_via_retry(self):
+        # First batch call (4 prompts) fails entirely -> full batch retry.
+        eng = FakeEngine(fail_first_n_calls=4)
+        sim = BCGSimulation(config=make_config(nh=4, max_rounds=6), engine=eng)
+        stats = sim.run()
+        assert stats["consensus_outcome"] == "valid"
+
+    def test_partial_failure_takes_sequential_path(self):
+        # One agent of four fails on attempt 1 (25% <= 30% threshold).
+        eng = FakeEngine(fail_first_n_calls=1)
+        sim = BCGSimulation(config=make_config(nh=4, max_rounds=6), engine=eng)
+        sim.run_round()
+        proposals = sim.game.get_all_proposals()
+        assert all(v is not None for v in proposals.values())
+
+    def test_total_failure_abstains_and_game_survives(self):
+        eng = FakeEngine(fail_first_n_calls=10**9)
+        sim = BCGSimulation(config=make_config(nh=3, max_rounds=2), engine=eng)
+        stats = sim.run()
+        # Nobody ever proposes; game rides to the deadline and loses.
+        assert stats["termination_reason"] == "max_rounds"
+        assert stats["honest_agents_won"] is False
+
+
+class TestSinks:
+    def test_results_files_layout(self, tmp_path):
+        cfg = make_config(tmp_path=tmp_path, nh=3, max_rounds=6)
+        sim = BCGSimulation(config=cfg)
+        sim.run()
+        sim.close()
+        json_path = tmp_path / "json" / "run_001.json"
+        csv_path = tmp_path / "metrics" / "run_001.csv"
+        log_path = tmp_path / "logs" / "run_001_log.txt"
+        assert json_path.exists() and csv_path.exists() and log_path.exists()
+
+        blob = json.loads(json_path.read_text())
+        assert blob["run_number"] == 1
+        assert {"config", "statistics", "metrics", "rounds", "final_state"} <= set(blob)
+        assert blob["statistics"]["consensus_outcome"] == "valid"
+        assert blob["a2a_message_count"] > 0
+
+        with open(csv_path) as f:
+            rows = list(csv.DictReader(f))
+        assert len(rows) == 1
+        row = rows[0]
+        assert row["consensus_outcome"] == "valid"
+        assert row["value_range"] == "0-50"
+        assert row["consensus_reached"] == "True"
+        assert float(row["rounds_per_sec"]) > 0
+
+        log_text = log_path.read_text()
+        assert "Round 1" in log_text and "SIMULATION COMPLETE" in log_text
+
+    def test_run_numbering_increments(self, tmp_path):
+        for expected in ("001", "002"):
+            cfg = make_config(tmp_path=tmp_path, nh=3, max_rounds=6)
+            sim = BCGSimulation(config=cfg)
+            assert sim.run_number == expected
+            sim.run()
+            sim.close()
+
+
+class TestCheckpoint:
+    def test_checkpoint_and_resume(self, tmp_path):
+        cfg = dataclasses.replace(
+            make_config(tmp_path=tmp_path, nh=4, nb=1, max_rounds=10, seed=11),
+            metrics=MetricsConfig(
+                save_results=True,
+                results_dir=str(tmp_path),
+                checkpoint_every_round=True,
+            ),
+        )
+        sim = BCGSimulation(config=cfg, engine=FakeEngine(seed=2, policy="schema_min"))
+        sim.run_round()
+        ckpt = tmp_path / "checkpoints" / "run_001.json"
+        assert ckpt.exists()
+
+        from bcg_tpu.runtime.checkpoint import resume_simulation
+
+        cfg2 = dataclasses.replace(cfg, metrics=dataclasses.replace(cfg.metrics, save_results=False))
+        sim2 = resume_simulation(str(ckpt), config=cfg2, engine=FakeEngine(seed=2, policy="schema_min"))
+        assert sim2.game.current_round == sim.game.current_round
+        assert sim2.game.get_game_state() == sim.game.get_game_state()
+        for aid in sim.agents:
+            assert sim2.agents[aid].memory.last_k_rounds == sim.agents[aid].memory.last_k_rounds
+            assert sim2.agents[aid].my_value == sim.agents[aid].my_value
+        # Resumed game can continue running.
+        sim2.run_round()
+        assert sim2.game.current_round >= sim.game.current_round
+
+    def test_resume_unseeded_preserves_byzantine_roles(self, tmp_path):
+        # Without a seed, a fresh simulation would roll a DIFFERENT
+        # Byzantine assignment; resume must rebuild agents from the
+        # checkpointed game's roles.
+        cfg = dataclasses.replace(
+            make_config(tmp_path=tmp_path, nh=3, nb=3, max_rounds=10, seed=0),
+            game=GameConfig(num_honest=3, num_byzantine=3, max_rounds=10, seed=None),
+            metrics=MetricsConfig(
+                save_results=True, results_dir=str(tmp_path), checkpoint_every_round=True
+            ),
+        )
+        sim = BCGSimulation(config=cfg, engine=FakeEngine(seed=1))
+        sim.run_round()
+        sim.close()
+        ckpt = tmp_path / "checkpoints" / "run_001.json"
+
+        from bcg_tpu.runtime.checkpoint import resume_simulation
+
+        for attempt in range(5):  # several resumes, roles must match every time
+            sim2 = resume_simulation(str(ckpt), config=cfg, engine=FakeEngine(seed=1))
+            for aid, game_agent in sim2.game.agents.items():
+                assert sim2.agents[aid].is_byzantine == game_agent.is_byzantine
+            assert sim2.run_number == "001"
+            sim2.close()
+
+    def test_resume_appends_to_original_log(self, tmp_path):
+        cfg = dataclasses.replace(
+            make_config(tmp_path=tmp_path, nh=3, max_rounds=10, seed=4),
+            metrics=MetricsConfig(
+                save_results=True, results_dir=str(tmp_path), checkpoint_every_round=True
+            ),
+        )
+        sim = BCGSimulation(config=cfg)
+        sim.run_round()
+        sim.close()
+        log_path = tmp_path / "logs" / "run_001_log.txt"
+        size_before = log_path.stat().st_size
+
+        from bcg_tpu.runtime.checkpoint import resume_simulation
+
+        sim2 = resume_simulation(str(ckpt := str(tmp_path / "checkpoints" / "run_001.json")), config=cfg)
+        sim2.run_round()
+        sim2.close()
+        assert log_path.stat().st_size > size_before  # appended, not truncated
+        assert not (tmp_path / "logs" / "run_002_log.txt").exists()
+
+
+class TestCLI:
+    def test_cli_end_to_end(self, tmp_path, capsys):
+        from bcg_tpu.cli import main
+
+        rc = main(
+            [
+                "--honest", "3", "--byzantine", "0", "--rounds", "6",
+                "--backend", "fake", "--seed", "0",
+                "--results-dir", str(tmp_path),
+            ]
+        )
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "Results:" in out and "Metrics:" in out
+        assert (tmp_path / "json" / "run_001.json").exists()
+
+    def test_cli_bad_value_range(self):
+        from bcg_tpu.cli import main
+
+        with pytest.raises(SystemExit):
+            main(["--value-range", "banana"])
+
+    def test_cli_no_save(self, tmp_path, capsys):
+        from bcg_tpu.cli import main
+
+        rc = main(
+            ["--honest", "3", "--rounds", "5", "--backend", "fake",
+             "--seed", "1", "--no-save", "--results-dir", str(tmp_path)]
+        )
+        assert rc == 0
+        assert not (tmp_path / "json").exists()
+
+
+class TestBatchAPI:
+    def test_run_simulation_returns_metrics(self):
+        out = run_simulation(
+            n_agents=4, max_rounds=6, byzantine_count=1, backend="fake", seed=0
+        )
+        stats = out["metrics"]
+        assert stats["num_honest"] == 3 and stats["num_byzantine"] == 1
+        assert stats["byzantine_awareness"] == "may_exist"
+        assert "consensus_outcome" in stats
