@@ -1,0 +1,218 @@
+"""JSON schema -> regex AST.
+
+Covers the schema surface the BCG agents use (reference
+bcg_agents.py:590-599, 651-659, 1083-1092, 1155-1163) plus the common
+basics, mirroring what vLLM's guided decoding (outlines-style) accepts:
+
+* ``object`` with ordered ``properties``, ``required`` subsets,
+  ``additionalProperties: false``
+* ``string`` (sanitised ASCII content with escapes), ``enum`` of strings
+* ``integer`` with ``minimum``/``maximum`` (tight digit-DP range regex)
+* ``number``, ``boolean``, ``null``, ``array`` (bounded whitespace)
+* ``anyOf`` alternation (the Byzantine ``int | "abstain"`` case)
+
+Strings are restricted to printable ASCII + escaped ``\\" \\\\ \\n \\t``:
+the game prompts demand English-only output, and a byte-exact ASCII
+automaton keeps the token DFA small and UTF-8-unambiguous.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from bcg_tpu.guided.regex_ast import (
+    DIGIT,
+    EPS,
+    CharClass,
+    Node,
+    alt,
+    byte_range,
+    char,
+    char_set,
+    digit_range,
+    literal,
+    opt,
+    plus,
+    seq,
+    star,
+)
+
+# Optional whitespace between structural JSON tokens.
+WS = star(char_set(" \n\t"))
+
+# String content byte: printable ASCII except '"' and '\'.
+_CONTENT = CharClass(
+    frozenset(b for b in range(0x20, 0x7F) if b not in (0x22, 0x5C))
+)
+# Escape sequences: \" \\ \/ \n \t \r \b \f
+_ESCAPE = seq(char("\\"), char_set('"\\/ntrbf'))
+STRING_CHAR = alt(_CONTENT, _ESCAPE)
+
+
+def string_ast(min_len: int = 0) -> Node:
+    body = star(STRING_CHAR)
+    if min_len > 0:
+        body = seq(*([STRING_CHAR] * min_len), star(STRING_CHAR))
+    return seq(char('"'), body, char('"'))
+
+
+def _fixed_length_range(a: str, b: str) -> Node:
+    """Digits-string regex for the closed range [a, b], len(a) == len(b)."""
+    if not a:
+        return EPS
+    a0, b0 = int(a[0]), int(b[0])
+    if a0 == b0:
+        return seq(digit_range(a0, a0), _fixed_length_range(a[1:], b[1:]))
+    parts = [seq(digit_range(a0, a0), _fixed_length_range(a[1:], "9" * (len(a) - 1)))]
+    if b0 - a0 >= 2:
+        tail = seq(*([DIGIT] * (len(a) - 1))) if len(a) > 1 else EPS
+        parts.append(seq(digit_range(a0 + 1, b0 - 1), tail))
+    parts.append(seq(digit_range(b0, b0), _fixed_length_range("0" * (len(b) - 1), b[1:])))
+    return alt(*parts)
+
+
+def _nonneg_range(lo: int, hi: int) -> Node:
+    """Regex for integers lo..hi (0 <= lo <= hi), no leading zeros except
+    the single digit 0."""
+    assert 0 <= lo <= hi
+    parts = []
+    for length in range(len(str(lo)), len(str(hi)) + 1):
+        lo_l = 0 if length == 1 else 10 ** (length - 1)
+        hi_l = 10**length - 1
+        a, b = max(lo, lo_l), min(hi, hi_l)
+        if a > b:
+            continue
+        parts.append(_fixed_length_range(str(a), str(b)))
+    return alt(*parts)
+
+
+def int_range_ast(lo: Any = None, hi: Any = None) -> Node:
+    """Integer regex honouring optional bounds."""
+    if lo is None and hi is None:
+        # -?(0|[1-9][0-9]*)
+        return seq(opt(char("-")), alt(char("0"), seq(digit_range(1, 9), star(DIGIT))))
+    lo = int(lo) if lo is not None else -(10**12)
+    hi = int(hi) if hi is not None else 10**12
+    if lo > hi:
+        raise ValueError(f"empty integer range [{lo}, {hi}]")
+    parts = []
+    if hi >= 0:
+        parts.append(_nonneg_range(max(lo, 0), hi))
+    if lo < 0:
+        neg_hi = -lo
+        neg_lo = 1 if hi >= 0 else -hi
+        parts.append(seq(char("-"), _nonneg_range(neg_lo, neg_hi)))
+    return alt(*parts)
+
+
+def number_ast() -> Node:
+    """JSON number: -?int(.frac)?([eE][+-]?digits)?"""
+    integer = alt(char("0"), seq(digit_range(1, 9), star(DIGIT)))
+    frac = seq(char("."), plus(DIGIT))
+    exp = seq(char_set("eE"), opt(char_set("+-")), plus(DIGIT))
+    return seq(opt(char("-")), integer, opt(frac), opt(exp))
+
+
+def schema_to_ast(schema: Dict[str, Any]) -> Node:
+    """Compile a JSON schema into a regex AST for its serialized form."""
+    if "enum" in schema:
+        options = []
+        for v in schema["enum"]:
+            if isinstance(v, str):
+                options.append(literal(f'"{v}"'))
+            elif isinstance(v, bool):
+                options.append(literal("true" if v else "false"))
+            elif v is None:
+                options.append(literal("null"))
+            else:
+                options.append(literal(str(v)))
+        return alt(*options)
+
+    if "anyOf" in schema:
+        return alt(*(schema_to_ast(s) for s in schema["anyOf"]))
+
+    t = schema.get("type")
+    if t == "object":
+        return _object_ast(schema)
+    if t == "string":
+        return string_ast(min_len=schema.get("minLength", 0))
+    if t == "integer":
+        return int_range_ast(schema.get("minimum"), schema.get("maximum"))
+    if t == "number":
+        return number_ast()
+    if t == "boolean":
+        return alt(literal("true"), literal("false"))
+    if t == "null":
+        return literal("null")
+    if t == "array":
+        item = schema.get("items", {"type": "string"})
+        inner = schema_to_ast(item)
+        items = opt(seq(inner, star(seq(WS, char(","), WS, inner))))
+        return seq(char("["), WS, items, WS, char("]"))
+    raise ValueError(f"Unsupported schema: {schema!r}")
+
+
+_MAX_OPTIONAL_PROPS = 8
+
+
+def _object_ast(schema: Dict[str, Any]) -> Node:
+    """Object with properties emitted in declaration order (outlines-
+    compatible: the model must emit keys in schema order).
+
+    JSON Schema semantics: only names listed in ``required`` are
+    mandatory; an absent ``required`` means every property is optional.
+    Optional properties anywhere in the order are supported by
+    enumerating the presence subsets (bounded by ``_MAX_OPTIONAL_PROPS``
+    to keep the automaton small)."""
+    props = schema.get("properties", {})
+    required = set(schema.get("required", []))
+    unknown = required - set(props)
+    if unknown:
+        raise ValueError(f"required names {sorted(unknown)} not in properties")
+
+    members = []
+    for name, sub in props.items():
+        member = seq(literal(f'"{name}"'), WS, char(":"), WS, schema_to_ast(sub))
+        members.append((name, member, name in required))
+
+    if not members:
+        return seq(char("{"), WS, char("}"))
+
+    optional_count = sum(1 for _, _, is_req in members if not is_req)
+    if optional_count > _MAX_OPTIONAL_PROPS:
+        raise ValueError(
+            f"object schema has {optional_count} optional properties; "
+            f"at most {_MAX_OPTIONAL_PROPS} supported"
+        )
+
+    # Fast path: optional members form a suffix after >=1 required member
+    # (every BCG schema) -> linear chain of optional comma-groups.
+    flags = [is_req for _, _, is_req in members]
+    suffix_form = flags[0] and not any(
+        earlier is False and later is True for earlier, later in zip(flags, flags[1:])
+    )
+    if suffix_form:
+        body = members[0][1]
+        for _, member, is_required in members[1:]:
+            group = seq(WS, char(","), WS, member)
+            body = seq(body, group if is_required else opt(group))
+        return seq(char("{"), WS, body, WS, char("}"))
+
+    # General path: alternate over every valid presence subset, keeping
+    # declaration order within each subset.
+    optional_idx = [i for i, (_, _, is_req) in enumerate(members) if not is_req]
+    bodies = []
+    for mask in range(1 << len(optional_idx)):
+        present = [
+            m
+            for i, (_, m, is_req) in enumerate(members)
+            if is_req or (i in optional_idx and (mask >> optional_idx.index(i)) & 1)
+        ]
+        if not present:
+            bodies.append(EPS)
+            continue
+        body = present[0]
+        for member in present[1:]:
+            body = seq(body, WS, char(","), WS, member)
+        bodies.append(body)
+    return seq(char("{"), WS, alt(*bodies), WS, char("}"))

@@ -1,8 +1,13 @@
 """Test configuration.
 
-Force JAX onto an 8-device virtual CPU mesh BEFORE any jax import, so
-multi-chip sharding logic (tp/dp/sp over a Mesh) is exercised hermetically
-without TPU hardware (SURVEY.md §4's test-strategy requirement).
+Force JAX onto an 8-device virtual CPU mesh so multi-chip sharding logic
+(tp/dp/sp over a Mesh) is exercised hermetically without TPU hardware
+(SURVEY.md §4's test-strategy requirement).
+
+Note: this environment's axon sitecustomize force-registers the TPU
+backend and overrides JAX_PLATFORMS, so the env var alone is NOT enough —
+``jax.config.update("jax_platforms", "cpu")`` must run before any
+computation.
 """
 
 import os
@@ -14,5 +19,9 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402  (must import after XLA_FLAGS is set)
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,301 @@
+"""Tests for schema-guided decoding: schema->regex->DFA->token DFA.
+
+The property being tested end-to-end: a string matches the byte DFA iff it
+is a serialization the schema accepts, and the token DFA accepts exactly
+the token sequences whose concatenated bytes the byte DFA accepts.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from bcg_tpu.guided import (
+    GuidedBatch,
+    ast_to_dfa,
+    build_token_dfa,
+    compile_schema,
+    schema_to_ast,
+)
+from bcg_tpu.guided.schema_compiler import int_range_ast
+from bcg_tpu.guided.token_dfa import _build_numpy, _load_native
+
+
+def dfa_for(schema):
+    return ast_to_dfa(schema_to_ast(schema))
+
+
+def accepts(dfa, text: str) -> bool:
+    return dfa.matches(text.encode("utf-8"))
+
+
+HONEST_DECISION = {
+    "type": "object",
+    "properties": {
+        "internal_strategy": {"type": "string"},
+        "value": {"type": "integer", "minimum": 0, "maximum": 50},
+        "public_reasoning": {"type": "string"},
+    },
+    "required": ["internal_strategy", "value", "public_reasoning"],
+    "additionalProperties": False,
+}
+
+BYZ_DECISION = {
+    "type": "object",
+    "properties": {
+        "internal_strategy": {"type": "string"},
+        "value": {
+            "anyOf": [
+                {"type": "integer", "minimum": 0, "maximum": 50},
+                {"type": "string", "enum": ["abstain"]},
+            ]
+        },
+        "public_reasoning": {"type": "string"},
+    },
+    "required": ["internal_strategy", "value"],
+    "additionalProperties": False,
+}
+
+VOTE = {
+    "type": "object",
+    "properties": {"decision": {"type": "string", "enum": ["stop", "continue"]}},
+    "required": ["decision"],
+    "additionalProperties": False,
+}
+
+
+class TestIntRange:
+    @pytest.mark.parametrize(
+        "lo,hi",
+        [(0, 50), (0, 0), (5, 5), (1, 9), (0, 100), (17, 23), (99, 1001), (-20, 30), (-45, -7)],
+    )
+    def test_range_acceptance_is_exact(self, lo, hi):
+        dfa = ast_to_dfa(int_range_ast(lo, hi))
+        for v in range(lo - 15, hi + 16):
+            assert dfa.matches(str(v).encode()) == (lo <= v <= hi), (v, lo, hi)
+
+    def test_no_leading_zeros(self):
+        dfa = ast_to_dfa(int_range_ast(0, 50))
+        assert not dfa.matches(b"007")
+        assert not dfa.matches(b"01")
+        assert dfa.matches(b"0")
+
+    def test_unbounded(self):
+        dfa = ast_to_dfa(int_range_ast(None, None))
+        for s in (b"0", b"-1", b"123456789", b"-987654"):
+            assert dfa.matches(s)
+        for s in (b"01", b"--3", b"", b"+5"):
+            assert not dfa.matches(s)
+
+
+class TestSchemaDFA:
+    def test_honest_decision_accepts_valid_json(self):
+        dfa = dfa_for(HONEST_DECISION)
+        obj = {
+            "internal_strategy": "watch agent_3",
+            "value": 25,
+            "public_reasoning": "converging to the majority",
+        }
+        assert accepts(dfa, json.dumps(obj))
+        # whitespace variants
+        assert accepts(dfa, json.dumps(obj, indent=2))
+        assert accepts(dfa, json.dumps(obj, separators=(",", ":")))
+
+    def test_honest_decision_rejects_bad_json(self):
+        dfa = dfa_for(HONEST_DECISION)
+        # out-of-range value
+        assert not accepts(dfa, '{"internal_strategy": "s", "value": 51, "public_reasoning": "r"}')
+        # missing required field
+        assert not accepts(dfa, '{"internal_strategy": "s", "value": 5}')
+        # wrong key order (schema order is the contract)
+        assert not accepts(dfa, '{"value": 5, "internal_strategy": "s", "public_reasoning": "r"}')
+        # trailing garbage
+        assert not accepts(dfa, '{"internal_strategy": "s", "value": 5, "public_reasoning": "r"} x')
+        # string where int expected
+        assert not accepts(dfa, '{"internal_strategy": "s", "value": "5", "public_reasoning": "r"}')
+
+    def test_byzantine_value_abstain_or_int(self):
+        dfa = dfa_for(BYZ_DECISION)
+        assert accepts(dfa, '{"internal_strategy": "lurk", "value": "abstain", "public_reasoning": "hmm"}')
+        assert accepts(dfa, '{"internal_strategy": "lurk", "value": 50}')  # reasoning optional
+        assert not accepts(dfa, '{"internal_strategy": "lurk", "value": "sneaky"}')
+        assert not accepts(dfa, '{"value": 5}')  # strategy required
+
+    def test_vote_schema(self):
+        dfa = dfa_for(VOTE)
+        assert accepts(dfa, '{"decision": "stop"}')
+        assert accepts(dfa, '{"decision": "continue"}')
+        assert not accepts(dfa, '{"decision": "maybe"}')
+        assert not accepts(dfa, '{"decision": stop}')
+
+    def test_string_escapes(self):
+        dfa = dfa_for({"type": "string"})
+        assert accepts(dfa, '"hello world"')
+        assert accepts(dfa, '"say \\"hi\\" now"')
+        assert accepts(dfa, '"line\\nbreak"')
+        assert not accepts(dfa, '"unterminated')
+        assert not accepts(dfa, '"raw " quote"')
+
+    def test_boolean_null_number_array(self):
+        assert accepts(dfa_for({"type": "boolean"}), "true")
+        assert accepts(dfa_for({"type": "null"}), "null")
+        num = dfa_for({"type": "number"})
+        for s in ("3.25", "-1e9", "0.5", "42"):
+            assert accepts(num, s)
+        arr = dfa_for({"type": "array", "items": {"type": "integer"}})
+        assert accepts(arr, "[1, 2, 3]")
+        assert accepts(arr, "[]")
+        assert not accepts(arr, "[1,]")
+
+    def test_optional_in_middle_supported(self):
+        # 'a' optional, 'b' required — general presence-subset path.
+        schema = {
+            "type": "object",
+            "properties": {"a": {"type": "string"}, "b": {"type": "string"}},
+            "required": ["b"],
+        }
+        dfa = dfa_for(schema)
+        assert accepts(dfa, '{"b": "x"}')
+        assert accepts(dfa, '{"a": "y", "b": "x"}')
+        assert not accepts(dfa, '{"a": "y"}')  # b required
+        assert not accepts(dfa, '{"b": "x", "a": "y"}')  # declaration order
+
+    def test_absent_required_means_all_optional(self):
+        schema = {"type": "object", "properties": {"a": {"type": "integer"}}}
+        dfa = dfa_for(schema)
+        assert accepts(dfa, "{}")
+        assert accepts(dfa, '{"a": 3}')
+
+    def test_cache_distinguishes_property_order(self):
+        vocab = [bytes([i]) for i in range(256)]
+        s1 = {"type": "object", "properties": {"a": {"type": "integer"}, "b": {"type": "integer"}},
+              "required": ["a", "b"], "additionalProperties": False}
+        s2 = {"type": "object", "properties": {"b": {"type": "integer"}, "a": {"type": "integer"}},
+              "required": ["a", "b"], "additionalProperties": False}
+        g1 = compile_schema(s1, vocab, vocab_id=3)
+        g2 = compile_schema(s2, vocab, vocab_id=3)
+        assert g1 is not g2
+
+    def test_required_name_not_in_properties_raises(self):
+        with pytest.raises(ValueError, match="not in properties"):
+            schema_to_ast({"type": "object", "properties": {}, "required": ["x"]})
+
+
+def byte_vocab():
+    """Byte-level vocabulary: token i = bytes([i]) plus a few multi-byte
+    merges, mimicking BPE structure."""
+    toks = [bytes([i]) for i in range(256)]
+    toks += [b'{"', b'":', b'", "', b'"}', b"abstain", b"stop", b"continue", b"decision"]
+    return toks
+
+
+class TestTokenDFA:
+    def test_token_walk_matches_char_walk(self):
+        vocab = byte_vocab()
+        char_dfa = dfa_for(VOTE)
+        tdfa = build_token_dfa(char_dfa, vocab, force_numpy=True)
+        text = b'{"decision": "stop"}'
+        # single-byte token path
+        state = tdfa.start
+        for b in text:
+            state = int(tdfa.transitions[state, b])
+            assert state >= 0
+        assert tdfa.accepting[state]
+        # multi-byte token path: '{"' + 'decision' + '":' ...
+        seq = [vocab.index(b'{"'), vocab.index(b"decision"), vocab.index(b'":'),
+               vocab.index(b" "), vocab.index(b'"'), vocab.index(b"stop"),
+               vocab.index(b'"}')]
+        state = tdfa.start
+        for t in seq:
+            state = int(tdfa.transitions[state, t])
+            assert state >= 0, t
+        assert tdfa.accepting[state]
+
+    def test_forbidden_tokens_masked(self):
+        vocab = byte_vocab()
+        tdfa = build_token_dfa(dfa_for(VOTE), vocab, force_numpy=True)
+        # From the start state, only '{' (or tokens starting with '{'/ws) are legal.
+        start_row = tdfa.transitions[tdfa.start]
+        assert start_row[ord("{")] >= 0
+        assert start_row[ord("x")] < 0
+        assert start_row[vocab.index(b'{"')] >= 0
+        assert start_row[vocab.index(b"stop")] < 0
+
+    def test_native_matches_numpy(self):
+        if _load_native() is None:
+            pytest.skip("no C++ toolchain")
+        vocab = byte_vocab()
+        char_dfa = dfa_for(BYZ_DECISION)
+        a = build_token_dfa(char_dfa, vocab, force_numpy=True).transitions
+        b = build_token_dfa(char_dfa, vocab, force_numpy=False).transitions
+        np.testing.assert_array_equal(a, b)
+
+    def test_zero_length_token_forbidden(self):
+        vocab = byte_vocab() + [b""]
+        tdfa = build_token_dfa(dfa_for(VOTE), vocab, force_numpy=True)
+        assert (tdfa.transitions[:, len(vocab) - 1] == -1).all()
+
+
+class TestGuidedBatch:
+    def test_heterogeneous_batch(self):
+        vocab = byte_vocab()
+        g_vote = compile_schema(VOTE, vocab, vocab_id=1)
+        g_byz = compile_schema(
+            {"type": "object", "properties": {"decision": {"type": "string",
+             "enum": ["stop", "continue", "abstain"]}}, "required": ["decision"],
+             "additionalProperties": False},
+            vocab, vocab_id=1,
+        )
+        batch = GuidedBatch([g_vote, g_byz, g_vote])
+        assert batch.num_unique == 2
+
+        states = batch.init_states
+        mask = np.asarray(batch.token_mask(states))
+        assert mask.shape == (3, len(vocab))
+        assert mask[0, ord("{")] and mask[1, ord("{")]
+
+        # Drive rows through '{"decision": "' on the host table and confirm
+        # row 0 (honest vote) forbids the 'abstain' token where row 1
+        # (Byzantine vote) allows it.
+        tables = np.asarray(batch.tables)
+        dfa_ids = np.asarray(batch.dfa_ids)
+        prefix = b'{"decision": "'
+        s0 = int(batch.init_states[0])
+        s1 = int(batch.init_states[1])
+        for b in prefix:
+            s0 = int(tables[dfa_ids[0], s0, b])
+            s1 = int(tables[dfa_ids[1], s1, b])
+            assert s0 >= 0 and s1 >= 0
+        abstain_tok = vocab.index(b"abstain")
+        assert tables[dfa_ids[1], s1, abstain_tok] >= 0
+        assert tables[dfa_ids[0], s0, abstain_tok] < 0
+
+    def test_compile_cache(self):
+        vocab = byte_vocab()
+        a = compile_schema(VOTE, vocab, vocab_id=7)
+        b = compile_schema(json.loads(json.dumps(VOTE)), vocab, vocab_id=7)
+        assert a is b
+
+    def test_step_and_eos(self):
+        import jax
+        import jax.numpy as jnp
+
+        vocab = byte_vocab()
+        g = compile_schema(VOTE, vocab, vocab_id=2)
+        batch = GuidedBatch([g])
+
+        # Single jitted step fn, reused each iteration (as the decode loop
+        # does) — no per-step recompilation.
+        @jax.jit
+        def step(states, tok):
+            return batch.step(states, tok), batch.eos_allowed(states)
+
+        states = batch.init_states
+        for b in b'{"decision": "stop"}':
+            states, eos_ok = step(states, jnp.asarray([b], dtype=jnp.int32))
+            assert int(states[0]) >= 0
+        assert bool(np.asarray(batch.eos_allowed(states))[0])
+        # Sticky negative state
+        states = jnp.asarray([-1])
+        states = batch.step(states, jnp.asarray([5]))
+        assert int(states[0]) == -1
