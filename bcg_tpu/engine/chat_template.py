@@ -1,0 +1,60 @@
+"""Per-model-family chat templating.
+
+The reference hand-rolls these formats in ``vllm_agent.py:199-292``; the
+template strings themselves are the models' public chat formats (ChatML,
+Llama-3 headers, Mistral ``[INST]``), so they must match byte-for-byte —
+a wrong template silently wrecks game behaviour (SURVEY.md §7 hard part
+3).  Family is auto-detected from the model name, mirroring the
+reference's dispatch order:
+
+1. Qwen3 Instruct-2507  -> ChatML (no thinking mode)
+2. Qwen3                -> ChatML, ``/no_think`` soft switch appended to
+                           the user turn when thinking is disabled
+3. other Qwen           -> ChatML
+4. Llama-3              -> header-id format
+5. other Llama/Mistral  -> ``[INST]`` with ``<<SYS>>``
+6. fallback             -> ChatML
+"""
+
+from __future__ import annotations
+
+
+def _chatml(system_prompt: str, user_prompt: str) -> str:
+    return (
+        f"<|im_start|>system\n{system_prompt}<|im_end|>\n"
+        f"<|im_start|>user\n{user_prompt}<|im_end|>\n"
+        f"<|im_start|>assistant\n"
+    )
+
+
+def format_chat_prompt(
+    model_name: str,
+    system_prompt: str,
+    user_prompt: str,
+    disable_qwen3_thinking: bool = True,
+) -> str:
+    m = model_name.lower()
+
+    if "qwen3" in m or "qwen-3" in m:
+        if "instruct-2507" in m or "instruct_2507" in m:
+            return _chatml(system_prompt, user_prompt)
+        if disable_qwen3_thinking:
+            return _chatml(system_prompt, f"{user_prompt} /no_think")
+        return _chatml(system_prompt, user_prompt)
+
+    if "qwen" in m:
+        return _chatml(system_prompt, user_prompt)
+
+    if "llama-3" in m or "llama3" in m:
+        return (
+            "<|begin_of_text|><|start_header_id|>system<|end_header_id|>\n\n"
+            f"{system_prompt}<|eot_id|>"
+            "<|start_header_id|>user<|end_header_id|>\n\n"
+            f"{user_prompt}<|eot_id|>"
+            "<|start_header_id|>assistant<|end_header_id|>\n\n"
+        )
+
+    if "llama" in m or "mistral" in m:
+        return f"<s>[INST] <<SYS>>\n{system_prompt}\n<</SYS>>\n\n{user_prompt} [/INST]"
+
+    return _chatml(system_prompt, user_prompt)

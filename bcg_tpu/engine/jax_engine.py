@@ -1,0 +1,327 @@
+"""JAX/XLA inference engine — the TPU replacement for the reference's
+CUDA vLLM singleton (``vllm_agent.py:58-551``).
+
+Serving design (lockstep game, no continuous batching needed —
+SURVEY.md §7 hard part 2):
+
+* One padded batch per game phase; prompts are LEFT-padded into a
+  length bucket (multiple of ``_LEN_BUCKET``) so only a handful of
+  prefill shapes ever compile.
+* Prefill runs once per call; decode is a single ``lax.while_loop``
+  entirely on device — no host round-trip per token.  Guided decoding
+  rides along as per-sequence DFA states + two gathers per step
+  (:mod:`bcg_tpu.guided`), so heterogeneous schemas (honest + Byzantine
+  in one batch) stay batched.
+* Weights/KV bf16; logits f32; EOS is forced exactly when a sequence's
+  DFA reaches an accepting state with no tokens allowed.
+"""
+
+from __future__ import annotations
+
+import json
+from functools import partial
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bcg_tpu.engine.chat_template import format_chat_prompt
+from bcg_tpu.engine.interface import InferenceEngine
+from bcg_tpu.engine.tokenizer import Tokenizer, tokenizer_for_model
+from bcg_tpu.guided.processor import GuidedBatch, compile_schema
+from bcg_tpu.models.configs import ModelSpec, spec_for_model
+from bcg_tpu.models.transformer import (
+    decode_step,
+    init_kv_cache,
+    init_params,
+    prefill,
+)
+
+_LEN_BUCKET = 128
+
+
+class JaxEngine(InferenceEngine):
+    def __init__(self, config, mesh=None, params=None, spec: Optional[ModelSpec] = None):
+        self.config = config
+        self.spec = spec or spec_for_model(config.model_name)
+        if self.spec is None:
+            raise ValueError(
+                f"No architecture spec for model {config.model_name!r}; "
+                f"known: {sorted(__import__('bcg_tpu.models.configs', fromlist=['MODEL_SPECS']).MODEL_SPECS)}"
+            )
+        self.tokenizer: Tokenizer = tokenizer_for_model(config.model_name)
+        self.mesh = mesh
+        self.attention_impl = (
+            "xla" if config.attention_impl == "auto" else config.attention_impl
+        )
+        self.max_model_len = config.max_model_len
+
+        if params is not None:
+            self.params = params
+        elif config.model_name.startswith("bcg-tpu/"):
+            # Hermetic presets: random weights (no checkpoint needed).
+            self.params = init_params(self.spec, jax.random.PRNGKey(0))
+        else:
+            from bcg_tpu.models.loader import load_checkpoint_params
+
+            self.params = load_checkpoint_params(self.spec, config.model_name, mesh=mesh)
+
+        if mesh is not None:
+            from bcg_tpu.parallel.sharding import shard_params
+
+            self.params = shard_params(self.params, self.spec, mesh)
+
+        self._key = jax.random.PRNGKey(config.fake_seed if hasattr(config, "fake_seed") else 0)
+        self._token_bytes = self.tokenizer.token_bytes()
+
+        # jit entry points (shape-polymorphic via jax.jit's trace cache).
+        self._prefill = jax.jit(
+            partial(prefill, spec=self.spec, impl=self.attention_impl),
+            static_argnames=(),
+        )
+        self._decode_loops: Dict[Tuple, Any] = {}
+
+    # ------------------------------------------------------------- tokenizing
+
+    def _prepare_batch(self, full_prompts: List[str]) -> Tuple[np.ndarray, np.ndarray, int]:
+        """Tokenize + LEFT-pad into a bucketed [B, L] batch."""
+        token_lists = [self.tokenizer.encode(p) for p in full_prompts]
+        limit = self.max_model_len - 8
+        token_lists = [t[-limit:] for t in token_lists]
+        max_len = max(len(t) for t in token_lists)
+        L = max(_LEN_BUCKET, ((max_len + _LEN_BUCKET - 1) // _LEN_BUCKET) * _LEN_BUCKET)
+        B = len(token_lists)
+        tokens = np.full((B, L), self.tokenizer.pad_id, dtype=np.int32)
+        valid = np.zeros((B, L), dtype=bool)
+        for i, toks in enumerate(token_lists):
+            tokens[i, L - len(toks):] = toks
+            valid[i, L - len(toks):] = True
+        return tokens, valid, L
+
+    # ------------------------------------------------------------ decode loop
+
+    def _get_decode_loop(self, guided_sig: Tuple, temperature: float, max_new: int):
+        """Build (or fetch) the compiled guided decode loop for a shape
+        signature.  The whole token loop is one ``lax.while_loop`` on
+        device; ``io_callback``-free and host-sync-free."""
+        key = (guided_sig, float(temperature), int(max_new), self.attention_impl)
+        if key in self._decode_loops:
+            return self._decode_loops[key]
+
+        spec = self.spec
+        impl = self.attention_impl
+        eos_id = self.tokenizer.eos_id
+        greedy = temperature <= 0.0
+
+        def loop(params, cache, first_logits, valid_mask, prompt_lens, L,
+                 tables, accepting, dfa_ids, init_states, rng):
+            B = first_logits.shape[0]
+            V = first_logits.shape[1]
+
+            def masked_sample(logits, states, rng):
+                clamped = jnp.maximum(states, 0)
+                rows = tables[dfa_ids, clamped]              # [B, V]
+                allowed = rows >= 0
+                eos_ok = accepting[dfa_ids, clamped]
+                any_tok = allowed.any(axis=-1)
+                scaled = logits if greedy else logits / temperature
+                lg = jnp.where(allowed, scaled, -jnp.inf)
+                # EOS is legal exactly at accepting states (same
+                # temperature scaling as every other token).
+                lg = lg.at[:, eos_id].set(
+                    jnp.where(eos_ok, scaled[:, eos_id], -jnp.inf)
+                )
+                rng, sub = jax.random.split(rng)
+                if greedy:
+                    tok = jnp.argmax(lg, axis=-1)
+                else:
+                    tok = jax.random.categorical(sub, lg, axis=-1)
+                # Dead end (no token allowed): force EOS.
+                tok = jnp.where(~any_tok, eos_id, tok)
+                next_states = tables[dfa_ids, clamped, tok].astype(jnp.int32)
+                next_states = jnp.where(tok == eos_id, -1, next_states)
+                return tok.astype(jnp.int32), next_states, rng
+
+            def cond(carry):
+                i, done, *_ = carry
+                return (i < max_new) & ~done.all()
+
+            def body(carry):
+                i, done, cur_tok, states, cache, valid_mask, out, rng = carry
+                out = jax.lax.dynamic_update_slice(
+                    out, jnp.where(done, eos_id, cur_tok)[:, None], (0, i)
+                )
+                done = done | (cur_tok == eos_id)
+                # Open cache slot L+i, run the step, sample the next token.
+                valid_mask = jax.lax.dynamic_update_slice(
+                    valid_mask, jnp.ones((B, 1), bool), (0, L + i)
+                )
+                logits, cache = decode_step(
+                    params, spec,
+                    jnp.where(done, eos_id, cur_tok),
+                    L + i, prompt_lens + i, cache, valid_mask, impl,
+                )
+                tok, states, rng = masked_sample(logits, states, rng)
+                cur_tok = jnp.where(done, cur_tok, tok)
+                return (i + 1, done, cur_tok, states, cache, valid_mask, out, rng)
+
+            tok0, states0, rng = masked_sample(first_logits, init_states, rng)
+            out = jnp.full((B, max_new), eos_id, dtype=jnp.int32)
+            carry = (jnp.int32(0), jnp.zeros((B,), bool), tok0, states0,
+                     cache, valid_mask, out, rng)
+            i, done, cur_tok, states, cache, valid_mask, out, rng = jax.lax.while_loop(
+                cond, body, carry
+            )
+            # Tokens sampled beyond the max_new budget are dropped (vLLM
+            # max_tokens semantics); early-exit rows are already EOS-filled.
+            return out, rng
+
+        compiled = jax.jit(loop, static_argnames=("L",), donate_argnums=(1,))
+        self._decode_loops[key] = compiled
+        return compiled
+
+    def _run_guided(
+        self,
+        full_prompts: List[str],
+        schemas: List[Dict],
+        temperature: float,
+        max_tokens: int,
+    ) -> List[str]:
+        tokens, valid, L = self._prepare_batch(full_prompts)
+        B = tokens.shape[0]
+        guides = [
+            compile_schema(s, self._token_bytes, vocab_id=self.tokenizer.vocab_id)
+            for s in schemas
+        ]
+        batch = GuidedBatch(guides)
+        max_new = min(max_tokens, self.max_model_len - L)
+        if max_new <= 0:
+            raise ValueError(f"prompt length {L} exhausts max_model_len")
+
+        cache = init_kv_cache(self.spec, B, L + max_new + 1)
+        first_logits, cache = self._prefill(
+            self.params, tokens=jnp.asarray(tokens), valid=jnp.asarray(valid),
+            cache=cache,
+        )
+        S = L + max_new + 1
+        valid_mask = np.zeros((B, S), dtype=bool)
+        valid_mask[:, :L] = valid
+        prompt_lens = valid.sum(axis=1).astype(np.int32)
+
+        guided_sig = (batch.num_unique, batch.tables.shape[1], batch.tables.shape[2], B, L)
+        loop = self._get_decode_loop(guided_sig, temperature, max_new)
+        self._key, sub = jax.random.split(self._key)
+        out, _ = loop(
+            self.params, cache, first_logits, jnp.asarray(valid_mask),
+            jnp.asarray(prompt_lens), L,
+            batch.tables, batch.accepting, batch.dfa_ids, batch.init_states, sub,
+        )
+        out_np = np.asarray(out)
+        texts = []
+        for i in range(B):
+            row = out_np[i]
+            end = np.where(row == self.tokenizer.eos_id)[0]
+            row = row[: end[0]] if end.size else row
+            texts.append(self.tokenizer.decode(row.tolist()))
+        return texts
+
+    # -------------------------------------------------------- public surface
+
+    def generate_json(self, prompt, schema, temperature=0.0, max_tokens=512,
+                      system_prompt=None) -> Dict[str, Any]:
+        return self.batch_generate_json(
+            [(system_prompt or "", prompt, schema)], temperature, max_tokens
+        )[0]
+
+    def batch_generate_json(self, prompts, temperature=0.8, max_tokens=512):
+        if not prompts:
+            return []
+        full = [
+            format_chat_prompt(
+                self.config.model_name, system_prompt, user_prompt,
+                self.config.disable_qwen3_thinking,
+            )
+            for system_prompt, user_prompt, _ in prompts
+        ]
+        schemas = [schema for _, _, schema in prompts]
+        try:
+            texts = self._run_guided(full, schemas, temperature, max_tokens)
+        except ValueError as e:
+            return [{"error": "generation_failed", "message": str(e)} for _ in prompts]
+        results = []
+        for text in texts:
+            try:
+                results.append(json.loads(text))
+            except json.JSONDecodeError:
+                salvaged = self.extract_json(text)
+                results.append(
+                    salvaged
+                    if salvaged is not None
+                    else {"error": "json_parse_failed", "raw": text[:200]}
+                )
+        return results
+
+    def generate(self, prompt, temperature=0.0, max_tokens=256, top_p=1.0,
+                 system_prompt=None) -> str:
+        return self.batch_generate(
+            [
+                format_chat_prompt(
+                    self.config.model_name, system_prompt, prompt,
+                    self.config.disable_qwen3_thinking,
+                )
+                if system_prompt
+                else prompt
+            ],
+            temperature, max_tokens, top_p,
+        )[0]
+
+    def batch_generate(self, prompts, temperature=0.0, max_tokens=256, top_p=1.0):
+        """Unguided generation: same loop with a permissive one-state DFA
+        that allows every token and EOS everywhere."""
+        return self._run_free(prompts, temperature, max_tokens)
+
+    def _run_free(self, full_prompts, temperature, max_tokens):
+        tokens, valid, L = self._prepare_batch(full_prompts)
+        B = tokens.shape[0]
+        V = self.tokenizer.vocab_size
+        max_new = min(max_tokens, self.max_model_len - L)
+
+        # Permissive automaton: single always-accepting state allowing all.
+        class _Free:
+            tables = jnp.zeros((1, 1, V), dtype=jnp.int16)
+            accepting = jnp.ones((1, 1), dtype=bool)
+            dfa_ids = jnp.zeros((B,), dtype=jnp.int32)
+            init_states = jnp.zeros((B,), dtype=jnp.int32)
+            num_unique = 1
+
+        batch = _Free()
+        cache = init_kv_cache(self.spec, B, L + max_new + 1)
+        first_logits, cache = self._prefill(
+            self.params, tokens=jnp.asarray(tokens), valid=jnp.asarray(valid),
+            cache=cache,
+        )
+        S = L + max_new + 1
+        valid_mask = np.zeros((B, S), dtype=bool)
+        valid_mask[:, :L] = valid
+        prompt_lens = valid.sum(axis=1).astype(np.int32)
+        guided_sig = ("free", 1, V, B, L)
+        loop = self._get_decode_loop(guided_sig, temperature, max_new)
+        self._key, sub = jax.random.split(self._key)
+        out, _ = loop(
+            self.params, cache, first_logits, jnp.asarray(valid_mask),
+            jnp.asarray(prompt_lens), L,
+            batch.tables, batch.accepting, batch.dfa_ids, batch.init_states, sub,
+        )
+        out_np = np.asarray(out)
+        texts = []
+        for i in range(B):
+            row = out_np[i]
+            end = np.where(row == self.tokenizer.eos_id)[0]
+            row = row[: end[0]] if end.size else row
+            texts.append(self.tokenizer.decode(row.tolist()).strip())
+        return texts
+
+    def shutdown(self) -> None:
+        self.params = None
+        self._decode_loops.clear()

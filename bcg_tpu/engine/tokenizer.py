@@ -1,0 +1,129 @@
+"""Tokenizers.
+
+The engine needs three things from a tokenizer: encode/decode, a byte
+representation of every vocabulary entry (to build token DFAs), and the
+special ids.  Two implementations:
+
+* :class:`ByteTokenizer` — hermetic byte-level tokenizer (token i =
+  byte i, plus specials), used by the tiny-test and bench models.
+* :class:`HFTokenizer` — wraps a local HuggingFace tokenizer for real
+  checkpoints (Qwen3 / Llama-3 / Mistral), recovering token byte strings
+  from the GPT-2 byte-unicode table or SentencePiece metaspace.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+
+class Tokenizer:
+    """Protocol: subclasses provide the attributes/methods below."""
+
+    vocab_size: int
+    eos_id: int
+    pad_id: int
+    vocab_id: int  # stable id for the guided-decoding schema cache
+
+    def encode(self, text: str) -> List[int]:
+        raise NotImplementedError
+
+    def decode(self, ids: Sequence[int]) -> str:
+        raise NotImplementedError
+
+    def token_bytes(self) -> List[bytes]:
+        """Byte string of every token id (specials map to b'')."""
+        raise NotImplementedError
+
+
+class ByteTokenizer(Tokenizer):
+    """Token i == byte i for i < 256; then specials.  Vocabulary is padded
+    to ``vocab_size`` (model embedding tables like multiples of 128)."""
+
+    def __init__(self, vocab_size: int = 512):
+        assert vocab_size >= 260
+        self.vocab_size = vocab_size
+        self.eos_id = 256
+        self.bos_id = 257
+        self.pad_id = 258
+        self.vocab_id = 1  # reserved id for the byte vocabulary
+
+    def encode(self, text: str) -> List[int]:
+        return list(text.encode("utf-8"))
+
+    def decode(self, ids: Sequence[int]) -> str:
+        data = bytes(i for i in ids if 0 <= i < 256)
+        return data.decode("utf-8", errors="replace")
+
+    def token_bytes(self) -> List[bytes]:
+        out = [bytes([i]) for i in range(256)]
+        out += [b""] * (self.vocab_size - 256)
+        return out
+
+
+# GPT-2 byte<->unicode table (used by Qwen/Llama BPE vocabs).
+def _gpt2_byte_decoder() -> dict:
+    bs = (
+        list(range(ord("!"), ord("~") + 1))
+        + list(range(ord("\xa1"), ord("\xac") + 1))
+        + list(range(ord("\xae"), ord("\xff") + 1))
+    )
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return {chr(c): b for b, c in zip(bs, cs)}
+
+
+class HFTokenizer(Tokenizer):
+    """Adapter over ``transformers.AutoTokenizer`` loaded from a local
+    path (this build environment has no network egress; checkpoints must
+    already be on disk)."""
+
+    def __init__(self, path: str, vocab_id: int = 2):
+        from transformers import AutoTokenizer
+
+        self.tk = AutoTokenizer.from_pretrained(path, trust_remote_code=True)
+        self.vocab_size = len(self.tk)
+        self.eos_id = self.tk.eos_token_id
+        self.pad_id = (
+            self.tk.pad_token_id if self.tk.pad_token_id is not None else self.eos_id
+        )
+        self.vocab_id = vocab_id
+        self._byte_decoder = _gpt2_byte_decoder()
+
+    def encode(self, text: str) -> List[int]:
+        return self.tk.encode(text, add_special_tokens=False)
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self.tk.decode(list(ids), skip_special_tokens=True)
+
+    def _token_to_bytes(self, token: str, tid: int) -> bytes:
+        if tid in self.tk.all_special_ids:
+            return b""
+        # SentencePiece metaspace.
+        if "▁" in token:
+            return token.replace("▁", " ").encode("utf-8")
+        # GPT-2 byte-unicode.
+        try:
+            return bytes(self._byte_decoder[ch] for ch in token)
+        except KeyError:
+            return token.encode("utf-8")
+
+    def token_bytes(self) -> List[bytes]:
+        out = [b""] * self.vocab_size
+        for token, tid in self.tk.get_vocab().items():
+            if tid < self.vocab_size:
+                out[tid] = self._token_to_bytes(token, tid)
+        return out
+
+
+def tokenizer_for_model(model_name: str, model_path: Optional[str] = None) -> Tokenizer:
+    if model_name.startswith("bcg-tpu/"):
+        from bcg_tpu.models.configs import spec_for_model
+
+        spec = spec_for_model(model_name)
+        return ByteTokenizer(vocab_size=spec.vocab_size if spec else 512)
+    return HFTokenizer(model_path or model_name)

@@ -14,7 +14,7 @@ from typing import Dict, FrozenSet, List, Set, Tuple
 
 import numpy as np
 
-from bcg_tpu.guided.regex_ast import Alt, CharClass, Epsilon, Node, Seq, Star
+from bcg_tpu.guided.regex_ast import Alt, Bounded, CharClass, Epsilon, Node, Seq, Star
 
 
 @dataclass
@@ -97,6 +97,21 @@ def _build_nfa(node: Node, nfa: _NFA) -> Tuple[int, int]:
         nfa.add_eps(it, is_)
         nfa.add_eps(it, t)
         return s, t
+    if isinstance(node, Bounded):
+        # Chain of max_count copies; an epsilon exit after every count in
+        # [min_count, max_count].  Iterative: depth independent of count.
+        exit_state = nfa.new_state()
+        start = nfa.new_state()
+        cur = start
+        if node.min_count == 0:
+            nfa.add_eps(cur, exit_state)
+        for i in range(1, node.max_count + 1):
+            is_, it = _build_nfa(node.inner, nfa)
+            nfa.add_eps(cur, is_)
+            cur = it
+            if i >= node.min_count:
+                nfa.add_eps(cur, exit_state)
+        return start, exit_state
     raise TypeError(f"Unknown AST node: {node!r}")
 
 
@@ -110,6 +125,8 @@ def _collect_classes(node: Node, out: Set[FrozenSet[int]]) -> None:
         for o in node.options:
             _collect_classes(o, out)
     elif isinstance(node, Star):
+        _collect_classes(node.inner, out)
+    elif isinstance(node, Bounded):
         _collect_classes(node.inner, out)
 
 

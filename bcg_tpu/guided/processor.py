@@ -54,8 +54,9 @@ def compile_schema(
     """Schema -> token DFA, cached per (schema, vocabulary).
 
     ``vocab_id`` identifies the tokenizer (vocabularies are large; callers
-    pass a stable id rather than hashing the bytes)."""
-    key = (schema_cache_key(schema), vocab_id)
+    pass a stable id rather than hashing the bytes).  The vocabulary size
+    is folded into the key as a safety net against id collisions."""
+    key = (schema_cache_key(schema), vocab_id, len(token_bytes))
     with _cache_lock:
         hit = _cache.get(key)
     if hit is not None:

@@ -49,6 +49,19 @@ class Star(Node):
     inner: Node
 
 
+@dataclass(frozen=True)
+class Bounded(Node):
+    """Between ``min_count`` and ``max_count`` repetitions of ``inner``.
+
+    A dedicated node (rather than a nested ``opt(seq(...))`` chain) keeps
+    AST depth O(1), so deep bounded repetitions (e.g. ``maxLength: 500``)
+    don't blow Python's recursion limit during NFA construction."""
+
+    inner: Node
+    min_count: int
+    max_count: int
+
+
 EPS = Epsilon()
 
 
@@ -90,6 +103,14 @@ def plus(inner: Node) -> Node:
 
 def opt(inner: Node) -> Node:
     return alt(inner, EPS)
+
+
+def bounded(inner: Node, min_count: int, max_count: int) -> Node:
+    if min_count < 0 or max_count < min_count:
+        raise ValueError(f"bad repetition bounds [{min_count}, {max_count}]")
+    if max_count == 0:
+        return EPS
+    return Bounded(inner, min_count, max_count)
 
 
 def char(c: str) -> Node:

@@ -18,7 +18,8 @@ automaton keeps the token DFA small and UTF-8-unambiguous.
 
 from __future__ import annotations
 
-from typing import Any, Dict
+import json
+from typing import Any, Dict, Optional
 
 from bcg_tpu.guided.regex_ast import (
     DIGIT,
@@ -26,7 +27,7 @@ from bcg_tpu.guided.regex_ast import (
     CharClass,
     Node,
     alt,
-    byte_range,
+    bounded,
     char,
     char_set,
     digit_range,
@@ -49,11 +50,23 @@ _ESCAPE = seq(char("\\"), char_set('"\\/ntrbf'))
 STRING_CHAR = alt(_CONTENT, _ESCAPE)
 
 
-def string_ast(min_len: int = 0) -> Node:
-    body = star(STRING_CHAR)
-    if min_len > 0:
-        body = seq(*([STRING_CHAR] * min_len), star(STRING_CHAR))
+def string_ast(min_len: int = 0, max_len: Optional[int] = None) -> Node:
+    if max_len is None:
+        body = star(STRING_CHAR)
+        if min_len > 0:
+            body = seq(*([STRING_CHAR] * min_len), body)
+    else:
+        if max_len < min_len:
+            raise ValueError(f"maxLength {max_len} < minLength {min_len}")
+        body = bounded(STRING_CHAR, min_len, max_len)
     return seq(char('"'), body, char('"'))
+
+
+def json_string_literal(value: str) -> Node:
+    """AST for the canonical JSON serialization of ``value`` (quotes,
+    escapes, and non-ASCII \\uXXXX included — embedding the raw string
+    would mis-handle quotes/backslashes)."""
+    return literal(json.dumps(value, ensure_ascii=True))
 
 
 def _fixed_length_range(a: str, b: str) -> Node:
@@ -86,22 +99,41 @@ def _nonneg_range(lo: int, hi: int) -> Node:
     return alt(*parts)
 
 
+def _nonneg_at_least(lo: int) -> Node:
+    """Regex for integers >= lo (lo >= 0), unbounded above: the exact
+    range up to the same digit length, plus any longer digit string
+    (no leading zeros => longer means larger)."""
+    length = len(str(lo))
+    exact = _nonneg_range(lo, 10**length - 1)
+    longer = seq(digit_range(1, 9), *([DIGIT] * length), star(DIGIT))
+    return alt(exact, longer)
+
+
 def int_range_ast(lo: Any = None, hi: Any = None) -> Node:
-    """Integer regex honouring optional bounds."""
+    """Integer regex honouring optional bounds (either side may be open)."""
     if lo is None and hi is None:
         # -?(0|[1-9][0-9]*)
         return seq(opt(char("-")), alt(char("0"), seq(digit_range(1, 9), star(DIGIT))))
-    lo = int(lo) if lo is not None else -(10**12)
-    hi = int(hi) if hi is not None else 10**12
-    if lo > hi:
+    if lo is not None and hi is not None and int(lo) > int(hi):
         raise ValueError(f"empty integer range [{lo}, {hi}]")
+
     parts = []
-    if hi >= 0:
-        parts.append(_nonneg_range(max(lo, 0), hi))
-    if lo < 0:
-        neg_hi = -lo
-        neg_lo = 1 if hi >= 0 else -hi
-        parts.append(seq(char("-"), _nonneg_range(neg_lo, neg_hi)))
+    # Non-negative side.
+    if hi is None:
+        parts.append(_nonneg_at_least(max(int(lo), 0)))
+    elif int(hi) >= 0:
+        parts.append(_nonneg_range(max(int(lo), 0) if lo is not None else 0, int(hi)))
+    # Negative side: -m where m ranges over the mirrored magnitudes.
+    neg_needed = (lo is None and (hi is None or int(hi) < 0)) or (
+        lo is not None and int(lo) < 0
+    )
+    if neg_needed:
+        mag_hi = None if lo is None else -int(lo)           # largest magnitude
+        mag_lo = 1 if (hi is None or int(hi) >= 0) else -int(hi)  # smallest
+        if mag_hi is None:
+            parts.append(seq(char("-"), _nonneg_at_least(mag_lo)))
+        elif mag_hi >= mag_lo:
+            parts.append(seq(char("-"), _nonneg_range(mag_lo, mag_hi)))
     return alt(*parts)
 
 
@@ -119,7 +151,7 @@ def schema_to_ast(schema: Dict[str, Any]) -> Node:
         options = []
         for v in schema["enum"]:
             if isinstance(v, str):
-                options.append(literal(f'"{v}"'))
+                options.append(json_string_literal(v))
             elif isinstance(v, bool):
                 options.append(literal("true" if v else "false"))
             elif v is None:
@@ -135,7 +167,10 @@ def schema_to_ast(schema: Dict[str, Any]) -> Node:
     if t == "object":
         return _object_ast(schema)
     if t == "string":
-        return string_ast(min_len=schema.get("minLength", 0))
+        return string_ast(
+            min_len=schema.get("minLength", 0),
+            max_len=schema.get("maxLength"),
+        )
     if t == "integer":
         return int_range_ast(schema.get("minimum"), schema.get("maximum"))
     if t == "number":
@@ -172,7 +207,7 @@ def _object_ast(schema: Dict[str, Any]) -> Node:
 
     members = []
     for name, sub in props.items():
-        member = seq(literal(f'"{name}"'), WS, char(":"), WS, schema_to_ast(sub))
+        member = seq(json_string_literal(name), WS, char(":"), WS, schema_to_ast(sub))
         members.append((name, member, name in required))
 
     if not members:

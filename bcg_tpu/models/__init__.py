@@ -1,0 +1,25 @@
+"""Decoder-only transformer family.
+
+One configurable architecture (RMSNorm + RoPE + GQA + SwiGLU, the
+Qwen3/Llama-3/Mistral shape) covers every model preset the reference
+serves through vLLM (config.py:20-25).  Parameters are a plain pytree so
+``jax.sharding`` partition specs apply directly.
+"""
+
+from bcg_tpu.models.configs import MODEL_SPECS, ModelSpec, spec_for_model
+from bcg_tpu.models.transformer import (
+    TransformerParams,
+    init_params,
+    prefill,
+    decode_step,
+)
+
+__all__ = [
+    "ModelSpec",
+    "MODEL_SPECS",
+    "spec_for_model",
+    "TransformerParams",
+    "init_params",
+    "prefill",
+    "decode_step",
+]

@@ -1,0 +1,87 @@
+"""Model architecture specs.
+
+Shapes for the reference's model presets (Qwen3-8B/14B/32B,
+Mistral-Small-22B — reference config.py:20-25) plus a tiny hermetic spec
+for tests and CPU smoke runs.  All are the same architecture family:
+pre-RMSNorm decoder blocks, rotary positions, grouped-query attention,
+SwiGLU MLP.  Qwen3 additionally applies RMSNorm to per-head q/k
+projections (qk_norm=True).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+
+@dataclass(frozen=True)
+class ModelSpec:
+    name: str
+    vocab_size: int
+    hidden_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    intermediate_size: int
+    rope_theta: float = 1_000_000.0
+    rms_eps: float = 1e-6
+    qk_norm: bool = False          # Qwen3-style per-head q/k RMSNorm
+    tie_embeddings: bool = False
+    max_position: int = 40960
+
+    @property
+    def q_size(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_size(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+
+MODEL_SPECS: Dict[str, ModelSpec] = {
+    # Qwen3 dense family (HF config.json values).
+    "Qwen/Qwen3-8B": ModelSpec(
+        name="Qwen/Qwen3-8B",
+        vocab_size=151936, hidden_size=4096, num_layers=36,
+        num_heads=32, num_kv_heads=8, head_dim=128,
+        intermediate_size=12288, qk_norm=True,
+    ),
+    "Qwen/Qwen3-14B": ModelSpec(
+        name="Qwen/Qwen3-14B",
+        vocab_size=151936, hidden_size=5120, num_layers=40,
+        num_heads=40, num_kv_heads=8, head_dim=128,
+        intermediate_size=17408, qk_norm=True,
+    ),
+    "Qwen/Qwen3-32B": ModelSpec(
+        name="Qwen/Qwen3-32B",
+        vocab_size=151936, hidden_size=5120, num_layers=64,
+        num_heads=64, num_kv_heads=8, head_dim=128,
+        intermediate_size=25600, qk_norm=True,
+    ),
+    "mistralai/Mistral-Small-Instruct-2409": ModelSpec(
+        name="mistralai/Mistral-Small-Instruct-2409",
+        vocab_size=32768, hidden_size=6144, num_layers=56,
+        num_heads=48, num_kv_heads=8, head_dim=128,
+        intermediate_size=16384, rope_theta=1_000_000.0,
+        rms_eps=1e-5, max_position=32768,
+    ),
+    # Hermetic tiny model: byte tokenizer vocabulary, runs on CPU in ms.
+    "bcg-tpu/tiny-test": ModelSpec(
+        name="bcg-tpu/tiny-test",
+        vocab_size=512, hidden_size=64, num_layers=2,
+        num_heads=4, num_kv_heads=2, head_dim=16,
+        intermediate_size=128, qk_norm=True, max_position=2048,
+    ),
+    # Mid-size random-weight spec for single-chip benchmarking.
+    "bcg-tpu/bench-1b": ModelSpec(
+        name="bcg-tpu/bench-1b",
+        vocab_size=151936, hidden_size=2048, num_layers=16,
+        num_heads=16, num_kv_heads=8, head_dim=128,
+        intermediate_size=6144, qk_norm=True, max_position=8192,
+    ),
+}
+
+
+def spec_for_model(model_name: str) -> Optional[ModelSpec]:
+    return MODEL_SPECS.get(model_name)

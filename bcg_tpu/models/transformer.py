@@ -1,0 +1,257 @@
+"""Functional decoder-only transformer (RMSNorm / RoPE / GQA / SwiGLU).
+
+TPU-first design choices:
+
+* Parameters are a plain dict pytree; :mod:`bcg_tpu.parallel.sharding`
+  assigns ``NamedSharding`` per leaf (heads and the MLP intermediate dim
+  partition over the ``tp`` mesh axis — Megatron layout: column-parallel
+  in-projections, row-parallel out-projections).
+* Static shapes everywhere: prefill is [B, L] with an explicit validity
+  mask (left-padded batches), decode is a [B, 1] step against a
+  preallocated KV cache updated via ``dynamic_update_slice``.
+* Weights and KV cache are bf16; RMSNorm accumulates in f32; attention
+  logits/softmax run in f32 for stability.
+* The attention inner op is pluggable (``attention_impl``): the stock
+  XLA path (einsum softmax einsum — XLA fuses it well on MXU) or the
+  Pallas flash kernel in :mod:`bcg_tpu.ops.attention`.
+
+Replaces the CUDA side of the reference's engine (vLLM internals behind
+``vllm_agent.py:100-157``); no reference code exists at this layer.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from bcg_tpu.models.configs import ModelSpec
+
+TransformerParams = Dict  # pytree: see init_params for the layout
+
+
+# ----------------------------------------------------------------- building
+
+def init_params(
+    spec: ModelSpec, key: jax.Array, dtype=jnp.bfloat16
+) -> TransformerParams:
+    """Random-init parameters with the HF-compatible logical layout.
+
+    Layout (per layer l):
+      embed            [V, D]
+      layers.l.attn_norm [D]
+      layers.l.wq      [D, H*Dh]    layers.l.wk/wv [D, Hkv*Dh]
+      layers.l.wo      [H*Dh, D]
+      layers.l.q_norm/k_norm [Dh]   (qk_norm models only)
+      layers.l.mlp_norm [D]
+      layers.l.w_gate/w_up [D, F]   layers.l.w_down [F, D]
+      final_norm       [D]
+      lm_head          [D, V]       (absent when tie_embeddings)
+    """
+    keys = iter(jax.random.split(key, 4 + spec.num_layers * 7))
+
+    def dense(k, shape):
+        fan_in = shape[0]
+        return (jax.random.normal(k, shape, jnp.float32) / math.sqrt(fan_in)).astype(dtype)
+
+    params: Dict = {
+        "embed": dense(next(keys), (spec.vocab_size, spec.hidden_size)),
+        "final_norm": jnp.ones((spec.hidden_size,), dtype),
+        "layers": [],
+    }
+    for _ in range(spec.num_layers):
+        layer = {
+            "attn_norm": jnp.ones((spec.hidden_size,), dtype),
+            "wq": dense(next(keys), (spec.hidden_size, spec.q_size)),
+            "wk": dense(next(keys), (spec.hidden_size, spec.kv_size)),
+            "wv": dense(next(keys), (spec.hidden_size, spec.kv_size)),
+            "wo": dense(next(keys), (spec.q_size, spec.hidden_size)),
+            "mlp_norm": jnp.ones((spec.hidden_size,), dtype),
+            "w_gate": dense(next(keys), (spec.hidden_size, spec.intermediate_size)),
+            "w_up": dense(next(keys), (spec.hidden_size, spec.intermediate_size)),
+            "w_down": dense(next(keys), (spec.intermediate_size, spec.hidden_size)),
+        }
+        if spec.qk_norm:
+            layer["q_norm"] = jnp.ones((spec.head_dim,), dtype)
+            layer["k_norm"] = jnp.ones((spec.head_dim,), dtype)
+        params["layers"].append(layer)
+    if not spec.tie_embeddings:
+        params["lm_head"] = dense(next(keys), (spec.hidden_size, spec.vocab_size))
+    return params
+
+
+# ------------------------------------------------------------------ kernels
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * scale).astype(x.dtype) * weight
+
+
+def rope_table(positions: jax.Array, head_dim: int, theta: float) -> Tuple[jax.Array, jax.Array]:
+    """cos/sin tables for the given positions ([..., P] -> [..., P, Dh/2])."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """Rotate half (HF convention). x: [B, T, H, Dh]; cos/sin: [B, T, Dh/2]."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    cos = cos[:, :, None, :]
+    sin = sin[:, :, None, :]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _xla_attention(q, k, v, mask, scale):
+    """Stock attention: einsum -> masked f32 softmax -> einsum.
+
+    q: [B, T, H, Dh], k/v: [B, S, Hkv, Dh], mask: [B, T, S] bool.
+    """
+    B, T, H, Dh = q.shape
+    Hkv = k.shape[2]
+    group = H // Hkv
+    qg = q.reshape(B, T, Hkv, group, Dh)
+    logits = jnp.einsum("bthgd,bshd->bhgts", qg, k).astype(jnp.float32) * scale
+    logits = jnp.where(mask[:, None, None, :, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bhgts,bshd->bthgd", probs, v)
+    return out.reshape(B, T, H, Dh)
+
+
+def attention(q, k, v, mask, scale, impl: str = "xla"):
+    if impl == "pallas":
+        from bcg_tpu.ops.attention import flash_attention
+
+        return flash_attention(q, k, v, mask, scale)
+    return _xla_attention(q, k, v, mask, scale)
+
+
+# ------------------------------------------------------------------ forward
+
+def _block(
+    layer: Dict,
+    spec: ModelSpec,
+    x: jax.Array,              # [B, T, D]
+    cos: jax.Array,
+    sin: jax.Array,
+    kv_write_pos: jax.Array,   # scalar: where in the cache to write
+    k_cache: jax.Array,        # [B, S, Hkv, Dh]
+    v_cache: jax.Array,
+    attn_mask: jax.Array,      # [B, T, S] over the cache
+    impl: str,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    B, T, D = x.shape
+    h = rms_norm(x, layer["attn_norm"], spec.rms_eps)
+    q = (h @ layer["wq"]).reshape(B, T, spec.num_heads, spec.head_dim)
+    k = (h @ layer["wk"]).reshape(B, T, spec.num_kv_heads, spec.head_dim)
+    v = (h @ layer["wv"]).reshape(B, T, spec.num_kv_heads, spec.head_dim)
+    if spec.qk_norm:
+        q = rms_norm(q, layer["q_norm"], spec.rms_eps)
+        k = rms_norm(k, layer["k_norm"], spec.rms_eps)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, kv_write_pos, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, kv_write_pos, 0, 0))
+
+    scale = 1.0 / math.sqrt(spec.head_dim)
+    attn_out = attention(q, k_cache, v_cache, attn_mask, scale, impl)
+    x = x + attn_out.reshape(B, T, spec.q_size) @ layer["wo"]
+
+    h = rms_norm(x, layer["mlp_norm"], spec.rms_eps)
+    gate = jax.nn.silu(h @ layer["w_gate"])
+    x = x + (gate * (h @ layer["w_up"])) @ layer["w_down"]
+    return x, k_cache, v_cache
+
+
+def _logits(params: TransformerParams, spec: ModelSpec, x: jax.Array) -> jax.Array:
+    h = rms_norm(x, params["final_norm"], spec.rms_eps)
+    head = params["embed"].T if spec.tie_embeddings else params["lm_head"]
+    return (h @ head).astype(jnp.float32)
+
+
+def init_kv_cache(spec: ModelSpec, batch: int, max_len: int, dtype=jnp.bfloat16):
+    """Per-layer list of {k, v} leaves ([B, S, Hkv, Dh] each).
+
+    Kept as separate pytree leaves (not one stacked array) so the
+    ``dynamic_update_slice`` in each decode step is a pure per-buffer
+    update XLA can alias in-place inside ``lax.while_loop`` — a stacked
+    layout would force a gather + restack copy of the whole cache every
+    token."""
+    shape = (batch, max_len, spec.num_kv_heads, spec.head_dim)
+    return [
+        {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+        for _ in range(spec.num_layers)
+    ]
+
+
+def prefill(
+    params: TransformerParams,
+    spec: ModelSpec,
+    tokens: jax.Array,        # [B, L] left-padded
+    valid: jax.Array,         # [B, L] bool, False on pads
+    cache: Dict,              # from init_kv_cache, written at [0, L)
+    impl: str = "xla",
+) -> Tuple[jax.Array, Dict]:
+    """Process the full prompt; returns last-position logits and the cache.
+
+    Left-padding: positions count only valid tokens, so RoPE sees each
+    sequence starting at 0; pads are masked out of attention entirely.
+    """
+    B, L = tokens.shape
+    S = cache[0]["k"].shape[1]
+    positions = jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1
+    positions = jnp.maximum(positions, 0)
+    cos, sin = rope_table(positions, spec.head_dim, spec.rope_theta)
+
+    causal = jnp.tril(jnp.ones((L, L), bool))
+    mask_ll = causal[None] & valid[:, None, :] & valid[:, :, None]  # [B, L, L]
+    # Mask over the full cache length S (beyond L nothing is valid yet).
+    attn_mask = jnp.zeros((B, L, S), bool).at[:, :, :L].set(mask_ll)
+
+    x = params["embed"][tokens]
+    new_cache = []
+    for layer_idx, layer in enumerate(params["layers"]):
+        x, k_l, v_l = _block(
+            layer, spec, x, cos, sin, jnp.int32(0),
+            cache[layer_idx]["k"], cache[layer_idx]["v"], attn_mask, impl,
+        )
+        new_cache.append({"k": k_l, "v": v_l})
+    logits = _logits(params, spec, x[:, -1:, :])[:, 0, :]  # [B, V]
+    return logits, new_cache
+
+
+def decode_step(
+    params: TransformerParams,
+    spec: ModelSpec,
+    token: jax.Array,          # [B] current tokens
+    write_pos: jax.Array,      # scalar int32: cache slot to write
+    seq_positions: jax.Array,  # [B] RoPE positions of these tokens
+    cache: Dict,
+    valid_mask: jax.Array,     # [B, S] which cache slots are attendable
+    impl: str = "xla",
+) -> Tuple[jax.Array, Dict]:
+    """One autoregressive step for the whole batch."""
+    B = token.shape[0]
+    cos, sin = rope_table(seq_positions[:, None], spec.head_dim, spec.rope_theta)
+    x = params["embed"][token][:, None, :]  # [B, 1, D]
+    attn_mask = valid_mask[:, None, :]      # [B, 1, S]
+
+    new_cache = []
+    for layer_idx, layer in enumerate(params["layers"]):
+        x, k_l, v_l = _block(
+            layer, spec, x, cos, sin, write_pos,
+            cache[layer_idx]["k"], cache[layer_idx]["v"], attn_mask, impl,
+        )
+        new_cache.append({"k": k_l, "v": v_l})
+    logits = _logits(params, spec, x)[:, 0, :]
+    return logits, new_cache
+
+
+def param_count(params: TransformerParams) -> int:
+    return sum(p.size for p in jax.tree.leaves(params))

@@ -1,0 +1,19 @@
+"""Parallelism: device meshes, weight sharding, collective game step.
+
+Replaces the reference's delegated distribution (vLLM tensor_parallel +
+torch.distributed/NCCL, vllm_agent.py:139-145, 541-545) with native JAX
+SPMD: a named Mesh over ICI, NamedSharding partition specs for weights
+and KV caches, and XLA collectives (all_gather/psum) inserted by the
+compiler from sharding annotations.
+"""
+
+from bcg_tpu.parallel.mesh import build_mesh, mesh_axes
+from bcg_tpu.parallel.sharding import param_sharding, shard_params, kv_cache_sharding
+
+__all__ = [
+    "build_mesh",
+    "mesh_axes",
+    "param_sharding",
+    "shard_params",
+    "kv_cache_sharding",
+]

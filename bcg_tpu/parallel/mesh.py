@@ -1,0 +1,50 @@
+"""Device mesh construction.
+
+Axes (scaling-book layout):
+
+* ``dp`` — data parallel: independent agent groups / replicated weights
+* ``tp`` — tensor parallel: heads + MLP intermediate dim over ICI
+* ``sp`` — sequence parallel: ring-attention shards of the KV sequence
+
+Single chip = 1x1x1 mesh; the same code path runs everywhere.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("dp", "tp", "sp")
+
+
+def mesh_axes() -> Sequence[str]:
+    return AXES
+
+
+def build_mesh(
+    dp: int = 1,
+    tp: int = 1,
+    sp: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    need = dp * tp * sp
+    if need > len(devices):
+        raise ValueError(
+            f"mesh dp={dp} tp={tp} sp={sp} needs {need} devices, "
+            f"have {len(devices)}"
+        )
+    grid = np.asarray(devices[:need]).reshape(dp, tp, sp)
+    return Mesh(grid, AXES)
+
+
+def mesh_from_engine_config(engine_config, devices=None) -> Mesh:
+    return build_mesh(
+        dp=engine_config.data_parallel_size,
+        tp=engine_config.tensor_parallel_size,
+        sp=engine_config.sequence_parallel_size,
+        devices=devices,
+    )

@@ -1,0 +1,79 @@
+"""Weight / activation partition specs (Megatron-style TP over the mesh).
+
+Column-parallel in-projections (wq/wk/wv/w_gate/w_up shard their OUTPUT
+dim over ``tp``), row-parallel out-projections (wo/w_down shard their
+INPUT dim) — XLA inserts the single all-reduce per block that this layout
+implies.  Embedding and lm_head shard the vocab dim; norms replicate.
+
+KV caches shard heads over ``tp`` and batch over ``dp``; with ``sp`` the
+sequence dim shards for ring attention (:mod:`bcg_tpu.ops.ring_attention`).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bcg_tpu.models.configs import ModelSpec
+
+# Logical leaf name (last path component) -> PartitionSpec.
+_SPECS = {
+    # [V, D] vocab-sharded embedding
+    "embed": P("tp", None),
+    "final_norm": P(None),
+    # [D, V]
+    "lm_head": P(None, "tp"),
+    "attn_norm": P(None),
+    "mlp_norm": P(None),
+    # column-parallel: output dim sharded
+    "wq": P(None, "tp"),
+    "wk": P(None, "tp"),
+    "wv": P(None, "tp"),
+    "w_gate": P(None, "tp"),
+    "w_up": P(None, "tp"),
+    # row-parallel: input dim sharded
+    "wo": P("tp", None),
+    "w_down": P("tp", None),
+    # per-head norms replicate
+    "q_norm": P(None),
+    "k_norm": P(None),
+}
+
+
+def param_sharding(logical_name: str, spec: ModelSpec, mesh: Mesh) -> NamedSharding:
+    """Sharding for a logical parameter path like ``layers.3.wq``."""
+    leaf = logical_name.split(".")[-1]
+    pspec = _SPECS.get(leaf, P(None))
+    # Head-count must divide tp; otherwise replicate rather than crash.
+    tp = mesh.shape.get("tp", 1)
+    if leaf in ("wq", "wo") and spec.num_heads % tp != 0:
+        pspec = P(None)
+    if leaf in ("wk", "wv") and spec.num_kv_heads % tp != 0:
+        pspec = P(None)
+    return NamedSharding(mesh, pspec)
+
+
+def shard_params(params: Dict, spec: ModelSpec, mesh: Mesh) -> Dict:
+    """Apply partition specs to every leaf of the param pytree."""
+
+    def place(path_parts, subtree):
+        if isinstance(subtree, dict):
+            return {k: place(path_parts + [k], v) for k, v in subtree.items()}
+        if isinstance(subtree, list):
+            return [place(path_parts + [str(i)], v) for i, v in enumerate(subtree)]
+        logical = ".".join(path_parts)
+        return jax.device_put(subtree, param_sharding(logical, spec, mesh))
+
+    return place([], params)
+
+
+def kv_cache_sharding(mesh: Mesh) -> NamedSharding:
+    """[B, S, Hkv, Dh]: batch over dp, sequence over sp, heads over tp."""
+    return NamedSharding(mesh, P("dp", "sp", "tp", None))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """[B, ...] activations: batch over dp."""
+    return NamedSharding(mesh, P("dp"))

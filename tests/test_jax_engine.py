@@ -1,0 +1,154 @@
+"""JAX engine tests: guided generation with the tiny random-weight model.
+
+The decisive property: even with RANDOM weights, guided decoding must
+yield schema-valid JSON for every sequence — the automaton, not the
+model, guarantees structure.  This is also the full-system integration
+test: BCGSimulation runs end-to-end on the JAX engine.
+"""
+
+import dataclasses
+import json
+
+import pytest
+
+from bcg_tpu.config import BCGConfig, EngineConfig, GameConfig, MetricsConfig
+from bcg_tpu.engine.chat_template import format_chat_prompt
+from bcg_tpu.engine.jax_engine import JaxEngine
+from bcg_tpu.engine.tokenizer import ByteTokenizer
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return JaxEngine(EngineConfig(backend="jax", model_name="bcg-tpu/tiny-test",
+                                  max_model_len=2048))
+
+
+VOTE_SCHEMA = {
+    "type": "object",
+    "properties": {"decision": {"type": "string", "enum": ["stop", "continue"]}},
+    "required": ["decision"],
+    "additionalProperties": False,
+}
+# Bounded strings keep random-weight generation inside the token budget
+# (a real model closes its strings; a random one rambles to max_tokens).
+DECISION_SCHEMA = {
+    "type": "object",
+    "properties": {
+        "internal_strategy": {"type": "string", "minLength": 1, "maxLength": 30},
+        "value": {"type": "integer", "minimum": 0, "maximum": 50},
+        "public_reasoning": {"type": "string", "minLength": 1, "maxLength": 30},
+    },
+    "required": ["internal_strategy", "value", "public_reasoning"],
+    "additionalProperties": False,
+}
+
+
+class TestChatTemplate:
+    def test_qwen3_no_think(self):
+        p = format_chat_prompt("Qwen/Qwen3-14B", "sys", "user")
+        assert "<|im_start|>system\nsys<|im_end|>" in p
+        assert "user /no_think<|im_end|>" in p
+        assert p.endswith("<|im_start|>assistant\n")
+
+    def test_qwen3_instruct_2507_no_soft_switch(self):
+        p = format_chat_prompt("Qwen/Qwen3-4B-Instruct-2507", "sys", "user")
+        assert "/no_think" not in p
+
+    def test_llama3(self):
+        p = format_chat_prompt("meta-llama/Meta-Llama-3.1-8B-Instruct", "s", "u")
+        assert "<|start_header_id|>assistant<|end_header_id|>" in p
+
+    def test_mistral(self):
+        p = format_chat_prompt("mistralai/Mistral-Small-Instruct-2409", "s", "u")
+        assert p.startswith("<s>[INST]") and p.endswith("[/INST]")
+
+
+class TestByteTokenizer:
+    def test_roundtrip(self):
+        tk = ByteTokenizer()
+        ids = tk.encode("hello {}")
+        assert tk.decode(ids) == "hello {}"
+
+    def test_token_bytes_layout(self):
+        tk = ByteTokenizer(512)
+        tb = tk.token_bytes()
+        assert len(tb) == 512
+        assert tb[65] == b"A"
+        assert tb[tk.eos_id] == b""
+
+
+class TestGuidedGeneration:
+    def test_vote_batch_valid_json(self, engine):
+        prompts = [("you vote", f"agent {i}: stop or continue?", VOTE_SCHEMA) for i in range(3)]
+        results = engine.batch_generate_json(prompts, temperature=0.7, max_tokens=48)
+        assert len(results) == 3
+        for r in results:
+            assert r.get("decision") in ("stop", "continue"), r
+
+    def test_decision_schema_with_random_weights(self, engine):
+        results = engine.batch_generate_json(
+            [("sys", "round 1", DECISION_SCHEMA)], temperature=0.9, max_tokens=220
+        )
+        r = results[0]
+        assert "error" not in r, r
+        assert isinstance(r["value"], int) and 0 <= r["value"] <= 50
+        assert isinstance(r["internal_strategy"], str)
+
+    def test_heterogeneous_schemas_one_batch(self, engine):
+        byz = {
+            "type": "object",
+            "properties": {"decision": {"type": "string",
+                                        "enum": ["stop", "continue", "abstain"]}},
+            "required": ["decision"],
+            "additionalProperties": False,
+        }
+        results = engine.batch_generate_json(
+            [("s", "u", VOTE_SCHEMA), ("s", "u", byz), ("s", "u", VOTE_SCHEMA)],
+            temperature=0.8, max_tokens=48,
+        )
+        assert results[0]["decision"] in ("stop", "continue")
+        assert results[1]["decision"] in ("stop", "continue", "abstain")
+
+    def test_greedy_is_deterministic(self, engine):
+        p = [("s", "u", VOTE_SCHEMA)]
+        a = engine.batch_generate_json(p, temperature=0.0, max_tokens=48)
+        b = engine.batch_generate_json(p, temperature=0.0, max_tokens=48)
+        assert a == b
+
+    def test_generate_free_text(self, engine):
+        out = engine.generate("hello", temperature=0.5, max_tokens=12)
+        assert isinstance(out, str)
+
+    def test_prompt_too_long_reports_error(self):
+        eng = JaxEngine(EngineConfig(backend="jax", model_name="bcg-tpu/tiny-test",
+                                     max_model_len=160))
+        res = eng.batch_generate_json(
+            [("s" * 400, "u" * 400, VOTE_SCHEMA)], max_tokens=64
+        )
+        # Prompt is truncated to fit; generation still succeeds.
+        assert res[0].get("decision") in ("stop", "continue") or "error" in res[0]
+
+
+class TestSimulationOnJaxEngine:
+    def test_full_game_on_tiny_model(self):
+        """Complete BCG game over the JAX engine with random weights:
+        guided decoding keeps every response schema-valid, so the game
+        must run to a clean termination."""
+        from bcg_tpu.runtime.orchestrator import BCGSimulation
+
+        cfg = BCGConfig(
+            game=GameConfig(num_honest=2, num_byzantine=1, max_rounds=2, seed=3),
+            engine=EngineConfig(backend="jax", model_name="bcg-tpu/tiny-test",
+                                max_model_len=2048),
+            metrics=MetricsConfig(save_results=False),
+        )
+        sim = BCGSimulation(config=cfg)
+        stats = sim.run()
+        assert stats["total_rounds"] >= 1
+        assert stats["termination_reason"] in (
+            "vote_with_consensus", "vote_without_consensus", "max_rounds",
+        )
+        # Proposals that were made must be in range.
+        for r in stats["rounds_data"]:
+            for v in r["honest_values"] + r["byzantine_values"]:
+                assert 0 <= v <= 50

@@ -1,0 +1,88 @@
+"""Transformer model tests (CPU, tiny spec)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bcg_tpu.models import init_params, prefill, decode_step, spec_for_model
+from bcg_tpu.models.transformer import init_kv_cache, param_count
+
+SPEC = spec_for_model("bcg-tpu/tiny-test")
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(SPEC, jax.random.PRNGKey(0))
+
+
+def test_param_shapes(params):
+    assert params["embed"].shape == (SPEC.vocab_size, SPEC.hidden_size)
+    assert len(params["layers"]) == SPEC.num_layers
+    l0 = params["layers"][0]
+    assert l0["wq"].shape == (SPEC.hidden_size, SPEC.q_size)
+    assert l0["wk"].shape == (SPEC.hidden_size, SPEC.kv_size)
+    assert l0["w_gate"].shape == (SPEC.hidden_size, SPEC.intermediate_size)
+    assert "q_norm" in l0  # qk_norm model
+    assert param_count(params) > 0
+
+
+def test_prefill_shapes_and_finiteness(params):
+    B, L, S = 2, 8, 16
+    tokens = jnp.arange(B * L).reshape(B, L) % SPEC.vocab_size
+    valid = jnp.ones((B, L), bool)
+    cache = init_kv_cache(SPEC, B, S)
+    logits, cache = prefill(params, SPEC, tokens, valid, cache)
+    assert logits.shape == (B, SPEC.vocab_size)
+    assert np.isfinite(np.asarray(logits)).all()
+    assert cache[0]["k"].shape == (B, S, SPEC.num_kv_heads, SPEC.head_dim)
+
+
+def test_decode_step_matches_prefill(params):
+    """Teacher-forcing equivalence: running the prompt token-by-token
+    through decode_step must give the same final logits as one prefill."""
+    B, L, S = 1, 6, 12
+    tokens = jnp.asarray([[3, 7, 11, 13, 17, 19]], dtype=jnp.int32)
+    valid = jnp.ones((B, L), bool)
+
+    cache = init_kv_cache(SPEC, B, S)
+    ref_logits, _ = prefill(params, SPEC, tokens, valid, cache)
+
+    cache = init_kv_cache(SPEC, B, S)
+    valid_mask = np.zeros((B, S), bool)
+    logits = None
+    for t in range(L):
+        valid_mask[:, t] = True
+        logits, cache = decode_step(
+            params, SPEC,
+            tokens[:, t], jnp.int32(t), jnp.asarray([t]),
+            cache, jnp.asarray(valid_mask),
+        )
+    np.testing.assert_allclose(
+        np.asarray(ref_logits), np.asarray(logits), rtol=2e-2, atol=2e-2
+    )
+
+
+def test_left_padding_equivalence(params):
+    """A left-padded prompt must produce the same last-token logits as the
+    unpadded prompt (pads masked out + positions shifted)."""
+    toks = [5, 9, 2, 31]
+    B = 1
+    unpadded = jnp.asarray([toks], dtype=jnp.int32)
+    cache = init_kv_cache(SPEC, B, 8)
+    ref, _ = prefill(params, SPEC, unpadded, jnp.ones((1, 4), bool), cache)
+
+    pad = 3
+    padded = jnp.asarray([[0] * pad + toks], dtype=jnp.int32)
+    valid = jnp.asarray([[False] * pad + [True] * 4])
+    cache = init_kv_cache(SPEC, B, 8 + pad)
+    out, _ = prefill(params, SPEC, padded, valid, cache)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), rtol=2e-2, atol=2e-2)
+
+
+def test_real_model_specs_registered():
+    for name in ("Qwen/Qwen3-8B", "Qwen/Qwen3-14B", "Qwen/Qwen3-32B",
+                 "mistralai/Mistral-Small-Instruct-2409"):
+        spec = spec_for_model(name)
+        assert spec is not None
+        assert spec.num_heads % spec.num_kv_heads == 0
