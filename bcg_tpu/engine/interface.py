@@ -120,5 +120,15 @@ def create_engine(engine_config, llm_config=None) -> InferenceEngine:
     if engine_config.backend == "jax":
         from bcg_tpu.engine.jax_engine import JaxEngine
 
-        return JaxEngine(engine_config)
+        mesh = None
+        if (
+            engine_config.tensor_parallel_size
+            * engine_config.data_parallel_size
+            * engine_config.sequence_parallel_size
+            > 1
+        ):
+            from bcg_tpu.parallel.mesh import mesh_from_engine_config
+
+            mesh = mesh_from_engine_config(engine_config)
+        return JaxEngine(engine_config, mesh=mesh)
     raise ValueError(f"Unknown engine backend: {engine_config.backend!r}")

@@ -73,7 +73,17 @@ class JaxEngine(InferenceEngine):
             self.params = shard_params(self.params, self.spec, mesh)
 
         self._key = jax.random.PRNGKey(config.fake_seed if hasattr(config, "fake_seed") else 0)
+        # Pad the token-byte table to the MODEL vocab (embedding tables are
+        # padded past the tokenizer vocab, e.g. Qwen3 151669 -> 151936);
+        # padding entries are b'' = forbidden, so logits and masks agree.
         self._token_bytes = self.tokenizer.token_bytes()
+        if len(self._token_bytes) < self.spec.vocab_size:
+            self._token_bytes += [b""] * (self.spec.vocab_size - len(self._token_bytes))
+        elif len(self._token_bytes) > self.spec.vocab_size:
+            raise ValueError(
+                f"tokenizer vocab {len(self._token_bytes)} exceeds model vocab "
+                f"{self.spec.vocab_size}"
+            )
 
         # jit entry points (shape-polymorphic via jax.jit's trace cache).
         self._prefill = jax.jit(
@@ -84,13 +94,22 @@ class JaxEngine(InferenceEngine):
 
     # ------------------------------------------------------------- tokenizing
 
-    def _prepare_batch(self, full_prompts: List[str]) -> Tuple[np.ndarray, np.ndarray, int]:
-        """Tokenize + LEFT-pad into a bucketed [B, L] batch."""
-        token_lists = [self.tokenizer.encode(p) for p in full_prompts]
-        limit = self.max_model_len - 8
-        token_lists = [t[-limit:] for t in token_lists]
+    def _prepare_batch(
+        self, full_prompts: List[str], max_new: int
+    ) -> Tuple[np.ndarray, np.ndarray, int]:
+        """Tokenize + LEFT-pad into a bucketed [B, L] batch, reserving
+        ``max_new`` decode slots: prompt + output always fit max_model_len
+        (bucket rounding is capped so it can never eat the decode budget)."""
+        limit = self.max_model_len - max_new - 1
+        if limit < 1:
+            raise ValueError(
+                f"max_tokens={max_new} leaves no room for a prompt within "
+                f"max_model_len={self.max_model_len}"
+            )
+        token_lists = [self.tokenizer.encode(p)[-limit:] for p in full_prompts]
         max_len = max(len(t) for t in token_lists)
         L = max(_LEN_BUCKET, ((max_len + _LEN_BUCKET - 1) // _LEN_BUCKET) * _LEN_BUCKET)
+        L = max(min(L, limit), max_len)
         B = len(token_lists)
         tokens = np.full((B, L), self.tokenizer.pad_id, dtype=np.int32)
         valid = np.zeros((B, L), dtype=bool)
@@ -101,11 +120,13 @@ class JaxEngine(InferenceEngine):
 
     # ------------------------------------------------------------ decode loop
 
-    def _get_decode_loop(self, guided_sig: Tuple, temperature: float, max_new: int):
+    def _get_decode_loop(self, guided_sig: Tuple, temperature: float, max_new: int,
+                         top_p: float = 1.0):
         """Build (or fetch) the compiled guided decode loop for a shape
         signature.  The whole token loop is one ``lax.while_loop`` on
         device; ``io_callback``-free and host-sync-free."""
-        key = (guided_sig, float(temperature), int(max_new), self.attention_impl)
+        key = (guided_sig, float(temperature), int(max_new), float(top_p),
+               self.attention_impl)
         if key in self._decode_loops:
             return self._decode_loops[key]
 
@@ -113,6 +134,7 @@ class JaxEngine(InferenceEngine):
         impl = self.attention_impl
         eos_id = self.tokenizer.eos_id
         greedy = temperature <= 0.0
+        use_top_p = (not greedy) and top_p < 1.0
 
         def loop(params, cache, first_logits, valid_mask, prompt_lens, L,
                  tables, accepting, dfa_ids, init_states, rng):
@@ -132,6 +154,15 @@ class JaxEngine(InferenceEngine):
                 lg = lg.at[:, eos_id].set(
                     jnp.where(eos_ok, scaled[:, eos_id], -jnp.inf)
                 )
+                if use_top_p:
+                    # Nucleus filter: keep the smallest prefix of the
+                    # sorted distribution whose mass reaches top_p.
+                    probs = jax.nn.softmax(lg, axis=-1)
+                    sorted_probs = jnp.sort(probs, axis=-1)[:, ::-1]
+                    cum = jnp.cumsum(sorted_probs, axis=-1)
+                    cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+                    cutoff = jnp.take_along_axis(sorted_probs, cutoff_idx, axis=-1)
+                    lg = jnp.where(probs >= cutoff, lg, -jnp.inf)
                 rng, sub = jax.random.split(rng)
                 if greedy:
                     tok = jnp.argmax(lg, axis=-1)
@@ -187,17 +218,16 @@ class JaxEngine(InferenceEngine):
         schemas: List[Dict],
         temperature: float,
         max_tokens: int,
+        top_p: float = 1.0,
     ) -> List[str]:
-        tokens, valid, L = self._prepare_batch(full_prompts)
+        max_new = max_tokens
+        tokens, valid, L = self._prepare_batch(full_prompts, max_new)
         B = tokens.shape[0]
         guides = [
             compile_schema(s, self._token_bytes, vocab_id=self.tokenizer.vocab_id)
             for s in schemas
         ]
         batch = GuidedBatch(guides)
-        max_new = min(max_tokens, self.max_model_len - L)
-        if max_new <= 0:
-            raise ValueError(f"prompt length {L} exhausts max_model_len")
 
         cache = init_kv_cache(self.spec, B, L + max_new + 1)
         first_logits, cache = self._prefill(
@@ -210,7 +240,7 @@ class JaxEngine(InferenceEngine):
         prompt_lens = valid.sum(axis=1).astype(np.int32)
 
         guided_sig = (batch.num_unique, batch.tables.shape[1], batch.tables.shape[2], B, L)
-        loop = self._get_decode_loop(guided_sig, temperature, max_new)
+        loop = self._get_decode_loop(guided_sig, temperature, max_new, top_p)
         self._key, sub = jax.random.split(self._key)
         out, _ = loop(
             self.params, cache, first_logits, jnp.asarray(valid_mask),
@@ -279,13 +309,13 @@ class JaxEngine(InferenceEngine):
     def batch_generate(self, prompts, temperature=0.0, max_tokens=256, top_p=1.0):
         """Unguided generation: same loop with a permissive one-state DFA
         that allows every token and EOS everywhere."""
-        return self._run_free(prompts, temperature, max_tokens)
+        return self._run_free(prompts, temperature, max_tokens, top_p)
 
-    def _run_free(self, full_prompts, temperature, max_tokens):
-        tokens, valid, L = self._prepare_batch(full_prompts)
+    def _run_free(self, full_prompts, temperature, max_tokens, top_p=1.0):
+        max_new = max_tokens
+        tokens, valid, L = self._prepare_batch(full_prompts, max_new)
         B = tokens.shape[0]
-        V = self.tokenizer.vocab_size
-        max_new = min(max_tokens, self.max_model_len - L)
+        V = self.spec.vocab_size
 
         # Permissive automaton: single always-accepting state allowing all.
         class _Free:
@@ -306,7 +336,7 @@ class JaxEngine(InferenceEngine):
         valid_mask[:, :L] = valid
         prompt_lens = valid.sum(axis=1).astype(np.int32)
         guided_sig = ("free", 1, V, B, L)
-        loop = self._get_decode_loop(guided_sig, temperature, max_new)
+        loop = self._get_decode_loop(guided_sig, temperature, max_new, top_p)
         self._key, sub = jax.random.split(self._key)
         out, _ = loop(
             self.params, cache, first_logits, jnp.asarray(valid_mask),

@@ -118,16 +118,13 @@ def int_range_ast(lo: Any = None, hi: Any = None) -> Node:
         raise ValueError(f"empty integer range [{lo}, {hi}]")
 
     parts = []
-    # Non-negative side.
+    # Non-negative side: allowed iff hi (when given) admits it.
     if hi is None:
         parts.append(_nonneg_at_least(max(int(lo), 0)))
     elif int(hi) >= 0:
         parts.append(_nonneg_range(max(int(lo), 0) if lo is not None else 0, int(hi)))
-    # Negative side: -m where m ranges over the mirrored magnitudes.
-    neg_needed = (lo is None and (hi is None or int(hi) < 0)) or (
-        lo is not None and int(lo) < 0
-    )
-    if neg_needed:
+    # Negative side (-m): allowed iff lo is open or negative.
+    if lo is None or int(lo) < 0:
         mag_hi = None if lo is None else -int(lo)           # largest magnitude
         mag_lo = 1 if (hi is None or int(hi) >= 0) else -int(hi)  # smallest
         if mag_hi is None:

@@ -109,6 +109,9 @@ def load_checkpoint_params(
         if path not in open_files:
             open_files[path] = safe_open(path, framework="numpy")
         arr = open_files[path].get_tensor(hf_name)
+        return _convert(arr, logical)
+
+    def _convert(arr, logical: str):
         if arr.dtype == np.uint16:  # raw bf16 storage
             arr = arr.view(np.uint16)
             tensor = jax.lax.bitcast_convert_type(jnp.asarray(arr), jnp.bfloat16)
@@ -122,20 +125,33 @@ def load_checkpoint_params(
         return tensor
 
     params: Dict = {"layers": []}
-    for logical, hf_name in _TOP_MAP.items():
-        if logical == "lm_head" and spec.tie_embeddings:
-            continue
-        if hf_name not in name_to_file:
-            if logical == "lm_head":
-                continue  # tied embeddings checkpoint
-            raise KeyError(f"{hf_name} missing from checkpoint {ckpt_dir}")
-        params[logical] = fetch(hf_name, logical)
-    for i in range(spec.num_layers):
-        layer = {}
-        for logical, template in _LAYER_MAP.items():
-            if logical in ("q_norm", "k_norm") and not spec.qk_norm:
+    try:
+        for logical, hf_name in _TOP_MAP.items():
+            if logical == "lm_head" and spec.tie_embeddings:
                 continue
-            hf_name = template.format(i=i)
-            layer[logical] = fetch(hf_name, f"layers.{i}.{logical}")
-        params["layers"].append(layer)
+            if hf_name not in name_to_file:
+                if logical == "lm_head":
+                    continue  # tied embeddings checkpoint
+                raise KeyError(f"{hf_name} missing from checkpoint {ckpt_dir}")
+            params[logical] = fetch(hf_name, logical)
+        for i in range(spec.num_layers):
+            layer = {}
+            for logical, template in _LAYER_MAP.items():
+                if logical in ("q_norm", "k_norm") and not spec.qk_norm:
+                    continue
+                hf_name = template.format(i=i)
+                layer[logical] = fetch(hf_name, f"layers.{i}.{logical}")
+            params["layers"].append(layer)
+    finally:
+        # Release shard handles/mmaps deterministically.
+        for handle in open_files.values():
+            close = getattr(handle, "close", None) or getattr(handle, "__exit__", None)
+            try:
+                if close is getattr(handle, "__exit__", None) and close is not None:
+                    close(None, None, None)
+                elif close is not None:
+                    close()
+            except Exception:
+                pass
+        open_files.clear()
     return params

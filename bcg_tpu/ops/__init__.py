@@ -1,0 +1,1 @@
+"""TPU kernels and collective ops: Pallas attention, ring attention."""

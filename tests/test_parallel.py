@@ -1,0 +1,177 @@
+"""Multi-device tests on the virtual 8-CPU mesh: mesh/sharding, ring
+attention exactness, SPMD game step parity with the host game."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bcg_tpu.comm import NetworkTopology
+from bcg_tpu.game import ByzantineConsensusGame
+from bcg_tpu.models import init_params, spec_for_model
+from bcg_tpu.parallel import build_mesh, shard_params
+from bcg_tpu.parallel.game_step import (
+    check_consensus_spmd,
+    exchange_values,
+    spmd_round_arrays,
+    tally_votes,
+)
+from bcg_tpu.ops.ring_attention import ring_attention
+from bcg_tpu.models.transformer import _xla_attention
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices"
+)
+
+
+class TestMesh:
+    def test_build_mesh_shapes(self):
+        mesh = build_mesh(dp=2, tp=2, sp=2)
+        assert dict(mesh.shape) == {"dp": 2, "tp": 2, "sp": 2}
+
+    def test_too_many_devices_raises(self):
+        with pytest.raises(ValueError, match="devices"):
+            build_mesh(dp=4, tp=4, sp=4)
+
+    def test_shard_params_tp(self):
+        spec = spec_for_model("bcg-tpu/tiny-test")
+        params = init_params(spec, jax.random.PRNGKey(0))
+        mesh = build_mesh(dp=1, tp=2, sp=1)
+        sharded = shard_params(params, spec, mesh)
+        wq = sharded["layers"][0]["wq"]
+        # Column-parallel: output dim split over tp.
+        assert wq.sharding.spec == jax.sharding.PartitionSpec(None, "tp")
+        norm = sharded["layers"][0]["attn_norm"]
+        assert norm.sharding.spec == jax.sharding.PartitionSpec(None)
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("sp", [2, 4, 8])
+    def test_matches_full_attention(self, sp):
+        mesh = build_mesh(dp=1, tp=1, sp=sp)
+        B, T, H, Hkv, Dh = 2, 32, 4, 2, 16
+        key = jax.random.PRNGKey(1)
+        kq, kk, kv = jax.random.split(key, 3)
+        q = jax.random.normal(kq, (B, T, H, Dh), jnp.float32)
+        k = jax.random.normal(kk, (B, T, Hkv, Dh), jnp.float32)
+        v = jax.random.normal(kv, (B, T, Hkv, Dh), jnp.float32)
+
+        ring = ring_attention(q, k, v, mesh, axis_name="sp", causal=True)
+        causal = jnp.tril(jnp.ones((T, T), bool))[None]
+        full = _xla_attention(q, k, v, jnp.broadcast_to(causal, (B, T, T)),
+                              1.0 / np.sqrt(Dh))
+        np.testing.assert_allclose(np.asarray(ring), np.asarray(full),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_non_causal(self):
+        mesh = build_mesh(dp=1, tp=1, sp=4)
+        B, T, H, Dh = 1, 16, 2, 8
+        q = jax.random.normal(jax.random.PRNGKey(0), (B, T, H, Dh))
+        k = jax.random.normal(jax.random.PRNGKey(1), (B, T, H, Dh))
+        v = jax.random.normal(jax.random.PRNGKey(2), (B, T, H, Dh))
+        ring = ring_attention(q, k, v, mesh, causal=False)
+        full = _xla_attention(q, k, v, jnp.ones((B, T, T), bool), 1.0 / np.sqrt(Dh))
+        np.testing.assert_allclose(np.asarray(ring), np.asarray(full),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_indivisible_length_raises(self):
+        mesh = build_mesh(dp=1, tp=1, sp=8)
+        x = jnp.zeros((1, 12, 2, 8))
+        with pytest.raises(ValueError, match="divisible"):
+            ring_attention(x, x, x, mesh)
+
+
+class TestSPMDGameStep:
+    def setup_method(self):
+        self.mesh = build_mesh(dp=8, tp=1, sp=1)
+
+    def test_exchange_matches_topology(self):
+        topo = NetworkTopology.ring(8)
+        mask = jnp.asarray(topo.neighbor_mask())
+        values = jnp.asarray([10, 11, 12, 13, 14, -1, 16, 17], jnp.int32)
+        received = np.asarray(exchange_values(values, mask, self.mesh))
+        # agent 0 hears only ring neighbours 1 and 7
+        assert received[0, 1] == 11 and received[0, 7] == 17
+        assert received[0, 2] == -1  # non-neighbour
+        assert received[4, 5] == -1  # agent 5 abstained
+        assert received[3, 3] == -1  # no self-delivery
+
+    def test_tally_matches_host_game(self):
+        game = ByzantineConsensusGame(num_honest=8, num_byzantine=0, seed=0)
+        votes_py = {f"agent_{i}": (True if i < 6 else (None if i == 6 else False))
+                    for i in range(8)}
+        info = game.get_all_termination_votes(votes_py)
+        votes = jnp.asarray([1] * 6 + [-1, 0], jnp.int32)
+        tally = tally_votes(votes, self.mesh)
+        assert int(tally["stop"]) == info["total_stop_votes"]
+        assert int(tally["abstain"]) == info["total_abstentions"]
+        assert bool(tally["terminate"]) == game.should_terminate_by_vote(votes_py)
+
+    def test_termination_threshold_edge(self):
+        # 5/8 < 2/3, 6/8 >= 2/3 — must match reference arithmetic.
+        for stops, expect in ((5, False), (6, True)):
+            votes = jnp.asarray([1] * stops + [0] * (8 - stops), jnp.int32)
+            assert bool(tally_votes(votes, self.mesh)["terminate"]) is expect
+
+    def test_consensus_check_matches_host_game(self):
+        game = ByzantineConsensusGame(num_honest=6, num_byzantine=2, seed=5)
+        ids = sorted(game.agents)
+        target = next(
+            st.initial_value for st in game.agents.values() if not st.is_byzantine
+        )
+        for aid in ids:
+            game.update_agent_proposal(aid, target)
+        game.apply_proposals()
+        expect_ok, expect_pct = game.check_consensus()
+
+        values = jnp.asarray(
+            [game.agents[a].current_value for a in ids], jnp.int32
+        )
+        byz = jnp.asarray([game.agents[a].is_byzantine for a in ids])
+        inits = jnp.asarray(
+            [game.agents[a].initial_value if game.agents[a].initial_value is not None
+             else -1 for a in ids], jnp.int32,
+        )
+        out = check_consensus_spmd(values, byz, inits, self.mesh)
+        assert bool(out["has_consensus"]) == expect_ok
+        assert abs(float(out["agreement_pct"]) - expect_pct) < 1e-5
+
+    def test_agreement_pct_uses_modal_value(self):
+        # Host: Counter([1,2,2,...]).most_common -> agreement = mode share.
+        game = ByzantineConsensusGame(num_honest=8, num_byzantine=0, seed=2)
+        ids = sorted(game.agents)
+        vals = [1, 2, 2, 2, 3, 3, 2, 1]
+        for aid, v in zip(ids, vals):
+            game.update_agent_proposal(aid, v)
+        game.apply_proposals()
+        _, expect_pct = game.check_consensus()
+
+        values = jnp.asarray(vals, jnp.int32)
+        byz = jnp.zeros(8, bool)
+        inits = jnp.asarray(
+            [game.agents[a].initial_value for a in ids], jnp.int32
+        )
+        out = check_consensus_spmd(values, byz, inits, self.mesh)
+        assert abs(float(out["agreement_pct"]) - expect_pct) < 1e-4
+        assert int(out["consensus_value"]) == 2  # modal value
+
+    def test_consensus_rejects_non_initial_value(self):
+        byz = jnp.zeros(8, bool)
+        inits = jnp.asarray([1, 2, 3, 4, 5, 6, 7, 8], jnp.int32)
+        values = jnp.full((8,), 25, jnp.int32)  # unanimous but not initial
+        out = check_consensus_spmd(values, byz, inits, self.mesh)
+        assert not bool(out["has_consensus"])
+
+    def test_full_round_arrays_jit(self):
+        topo = NetworkTopology.fully_connected(8)
+        mask = jnp.asarray(topo.neighbor_mask())
+        proposals = jnp.full((8,), 7, jnp.int32)
+        votes = jnp.ones((8,), jnp.int32)
+        byz = jnp.zeros(8, bool)
+        inits = jnp.asarray([7, 3, 9, 7, 5, 2, 8, 4], jnp.int32)
+        received, tally, consensus = spmd_round_arrays(
+            proposals, votes, mask, byz, inits, self.mesh
+        )
+        assert received.shape == (8, 8)
+        assert bool(tally["terminate"])
+        assert bool(consensus["has_consensus"])  # 7 is agent_0's initial
