@@ -139,7 +139,7 @@ Respond: {{"decision": "stop"}}, {{"decision": "continue"}}, or {{"decision": "a
         return {
             "type": "object",
             "properties": {
-                "internal_strategy": {"type": "string"},
+                "internal_strategy": {"type": "string", "minLength": 3},
                 "value": {
                     "anyOf": [
                         {"type": "integer", "minimum": lo, "maximum": hi},

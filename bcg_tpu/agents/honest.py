@@ -198,13 +198,23 @@ Respond: {{"decision": "stop"}} or {{"decision": "continue"}}"""
     # ---------------------------------------------------------------- schemas
 
     def decision_schema(self) -> Dict[str, Any]:
+        """Reference schema (bcg_agents.py:590-599) plus constraint
+        pushdown: the orchestrator's validity predicate (reference
+        main.py:232-247 — strategy >=3 chars, reasoning >=10 chars) is
+        encoded as ``minLength``, so too-short strings — the dominant
+        validity-retry class — can't be emitted at all.  (Not airtight:
+        the validator counts stripped length, and a DFA can't see
+        "non-whitespace", so an all-spaces string could still bounce;
+        the retry ladder stays as the backstop.)  vLLM can't express even
+        this much — its guided decoding and the validity check are
+        separate layers, and every invalid output costs a full re-batch."""
         lo, hi = self.value_range
         return {
             "type": "object",
             "properties": {
-                "internal_strategy": {"type": "string"},
+                "internal_strategy": {"type": "string", "minLength": 3},
                 "value": {"type": "integer", "minimum": lo, "maximum": hi},
-                "public_reasoning": {"type": "string"},
+                "public_reasoning": {"type": "string", "minLength": 10},
             },
             "required": ["internal_strategy", "value", "public_reasoning"],
             "additionalProperties": False,

@@ -19,6 +19,8 @@ SURVEY.md §7 hard part 2):
 from __future__ import annotations
 
 import json
+import os
+import time
 from functools import partial
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -38,7 +40,35 @@ from bcg_tpu.models.transformer import (
     prefill,
 )
 
-_LEN_BUCKET = 128
+# Coarse prompt-length ladder.  Every distinct (B, L) pair compiles its
+# own prefill + decode loop — on a remote-attached TPU a compile costs
+# tens of seconds, so shapes must stabilize after the first round even
+# though prompts keep growing with game history.  A fine-grained bucket
+# (the first design used 128) recompiled nearly every round.
+_LEN_BUCKETS = (512, 1024, 2048, 4096, 6144, 8192)
+
+# BCG_TPU_TIMING=1 prints per-call prefill/decode wall times.
+_TIMING = os.environ.get("BCG_TPU_TIMING", "") not in ("", "0")
+
+
+def _pad_batch(real_B: int) -> int:
+    """Batch-size bucketing: small (retry) batches round up to a power of
+    two to reuse compiled loops; full-size game batches stay exact."""
+    return real_B if real_B >= 8 else 1 << (real_B - 1).bit_length()
+
+
+def _pad_rows(*lists):
+    """Pad parallel per-sequence lists to the bucketed batch size by
+    repeating row 0 (results for padding rows are discarded).  Small
+    batches (retry sub-batches, sequential fallbacks) pad to a power of
+    two so they share compiled decode loops instead of each paying a
+    tens-of-seconds remote compile; the main game batch (all agents, a
+    stable size every round) runs exact — decode is KV-bandwidth-bound,
+    so padding IT would cost real HBM traffic.  Returns
+    (real_B, B, *padded_lists)."""
+    real_B = len(lists[0])
+    B = _pad_batch(real_B)
+    return (real_B, B) + tuple(l + [l[0]] * (B - real_B) for l in lists)
 
 
 class JaxEngine(InferenceEngine):
@@ -52,8 +82,19 @@ class JaxEngine(InferenceEngine):
             )
         self.tokenizer: Tokenizer = tokenizer_for_model(config.model_name)
         self.mesh = mesh
-        self.attention_impl = (
-            "xla" if config.attention_impl == "auto" else config.attention_impl
+        # Prefill is the memory-critical path: the stock XLA einsum
+        # attention materializes B*H*T*S f32 scores, which OOMs a single
+        # v5e chip at game batch sizes — flash (Pallas) is the default on
+        # TPU.  Decode is T=1, where the einsum path is already a cheap
+        # fused GEMV; flash's 128-row query padding would waste MXU work.
+        if config.attention_impl == "auto":
+            self.attention_impl = (
+                "pallas" if jax.default_backend() == "tpu" else "xla"
+            )
+        else:
+            self.attention_impl = config.attention_impl
+        self.decode_attention_impl = (
+            "xla" if self.attention_impl == "pallas" else self.attention_impl
         )
         self.max_model_len = config.max_model_len
 
@@ -88,7 +129,7 @@ class JaxEngine(InferenceEngine):
         # jit entry points (shape-polymorphic via jax.jit's trace cache).
         self._prefill = jax.jit(
             partial(prefill, spec=self.spec, impl=self.attention_impl),
-            static_argnames=(),
+            donate_argnames=("cache",),
         )
         self._decode_loops: Dict[Tuple, Any] = {}
 
@@ -108,7 +149,13 @@ class JaxEngine(InferenceEngine):
             )
         token_lists = [self.tokenizer.encode(p)[-limit:] for p in full_prompts]
         max_len = max(len(t) for t in token_lists)
-        L = max(_LEN_BUCKET, ((max_len + _LEN_BUCKET - 1) // _LEN_BUCKET) * _LEN_BUCKET)
+        # Ladder extends by doubling past its static tail so a raised
+        # max_model_len still lands on stable buckets; anything beyond the
+        # last bucket uses `limit` itself (one stable shape, not ragged).
+        buckets = list(_LEN_BUCKETS)
+        while buckets[-1] < limit:
+            buckets.append(buckets[-1] * 2)
+        L = next((b for b in buckets if b >= max_len), limit)
         L = max(min(L, limit), max_len)
         B = len(token_lists)
         tokens = np.full((B, L), self.tokenizer.pad_id, dtype=np.int32)
@@ -126,25 +173,38 @@ class JaxEngine(InferenceEngine):
         signature.  The whole token loop is one ``lax.while_loop`` on
         device; ``io_callback``-free and host-sync-free."""
         key = (guided_sig, float(temperature), int(max_new), float(top_p),
-               self.attention_impl)
+               self.decode_attention_impl)
         if key in self._decode_loops:
             return self._decode_loops[key]
 
         spec = self.spec
-        impl = self.attention_impl
+        impl = self.decode_attention_impl
         eos_id = self.tokenizer.eos_id
         greedy = temperature <= 0.0
         use_top_p = (not greedy) and top_p < 1.0
 
         def loop(params, cache, first_logits, valid_mask, prompt_lens, L,
-                 tables, accepting, dfa_ids, init_states, rng):
+                 tables, accepting, dist, dfa_ids, init_states, rng):
             B = first_logits.shape[0]
             V = first_logits.shape[1]
 
-            def masked_sample(logits, states, rng):
+            def masked_sample(logits, states, rng, pos):
                 clamped = jnp.maximum(states, 0)
                 rows = tables[dfa_ids, clamped]              # [B, V]
-                allowed = rows >= 0
+                # Guaranteed parse: a token is only allowed if the state
+                # it leads to can still reach acceptance within the
+                # remaining budget (distances precomputed in
+                # guided/token_dfa.py completion_paths).  The sampler can
+                # therefore never truncate into invalid JSON — e.g. with 7
+                # tokens left it cannot open a minLength-10 string, and at
+                # the exact boundary only shortest-completion tokens
+                # survive the mask.  vLLM has no equivalent: its guided
+                # output just cuts off at max_tokens and fails to parse,
+                # which is what the reference's 3-attempt retry ladder
+                # (bcg_agents.py:708-759) exists to absorb.
+                next_d = dist[dfa_ids[:, None], jnp.maximum(rows, 0)]
+                budget_left = max_new - pos                  # incl. this token
+                allowed = (rows >= 0) & (next_d + 1 <= budget_left)
                 eos_ok = accepting[dfa_ids, clamped]
                 any_tok = allowed.any(axis=-1)
                 scaled = logits if greedy else logits / temperature
@@ -193,11 +253,11 @@ class JaxEngine(InferenceEngine):
                     jnp.where(done, eos_id, cur_tok),
                     L + i, prompt_lens + i, cache, valid_mask, impl,
                 )
-                tok, states, rng = masked_sample(logits, states, rng)
+                tok, states, rng = masked_sample(logits, states, rng, i + 1)
                 cur_tok = jnp.where(done, cur_tok, tok)
                 return (i + 1, done, cur_tok, states, cache, valid_mask, out, rng)
 
-            tok0, states0, rng = masked_sample(first_logits, init_states, rng)
+            tok0, states0, rng = masked_sample(first_logits, init_states, rng, 0)
             out = jnp.full((B, max_new), eos_id, dtype=jnp.int32)
             carry = (jnp.int32(0), jnp.zeros((B,), bool), tok0, states0,
                      cache, valid_mask, out, rng)
@@ -220,36 +280,57 @@ class JaxEngine(InferenceEngine):
         max_tokens: int,
         top_p: float = 1.0,
     ) -> List[str]:
-        max_new = max_tokens
-        tokens, valid, L = self._prepare_batch(full_prompts, max_new)
-        B = tokens.shape[0]
+        real_B, B, full_prompts, schemas = _pad_rows(full_prompts, schemas)
         guides = [
             compile_schema(s, self._token_bytes, vocab_id=self.tokenizer.vocab_id)
             for s in schemas
         ]
         batch = GuidedBatch(guides)
+        sig = (batch.num_unique, batch.tables.shape[1], batch.tables.shape[2])
+        return self._decode_batch(
+            full_prompts, batch, sig, real_B, temperature, max_tokens, top_p
+        )
 
+    def _decode_batch(
+        self, full_prompts, batch, sig_prefix, real_B, temperature, max_new,
+        top_p,
+    ) -> List[str]:
+        """Shared prefill + guided-decode scaffolding for the guided and
+        free paths; ``full_prompts`` is already batch-padded (_pad_rows)."""
+        B = len(full_prompts)
+        tokens, valid, L = self._prepare_batch(full_prompts, max_new)
+
+        t0 = time.perf_counter()
         cache = init_kv_cache(self.spec, B, L + max_new + 1)
         first_logits, cache = self._prefill(
             self.params, tokens=jnp.asarray(tokens), valid=jnp.asarray(valid),
             cache=cache,
         )
+        if _TIMING:
+            first_logits.block_until_ready()
+        t1 = time.perf_counter()
         S = L + max_new + 1
         valid_mask = np.zeros((B, S), dtype=bool)
         valid_mask[:, :L] = valid
         prompt_lens = valid.sum(axis=1).astype(np.int32)
 
-        guided_sig = (batch.num_unique, batch.tables.shape[1], batch.tables.shape[2], B, L)
-        loop = self._get_decode_loop(guided_sig, temperature, max_new, top_p)
+        loop = self._get_decode_loop(sig_prefix + (B, L), temperature, max_new, top_p)
         self._key, sub = jax.random.split(self._key)
         out, _ = loop(
             self.params, cache, first_logits, jnp.asarray(valid_mask),
             jnp.asarray(prompt_lens), L,
-            batch.tables, batch.accepting, batch.dfa_ids, batch.init_states, sub,
+            batch.tables, batch.accepting, batch.dist,
+            batch.dfa_ids, batch.init_states, sub,
         )
         out_np = np.asarray(out)
+        if _TIMING:
+            print(
+                f"[engine] decode B={B} L={L} max_new={max_new} "
+                f"prefill={t1 - t0:.2f}s decode={time.perf_counter() - t1:.2f}s",
+                flush=True,
+            )
         texts = []
-        for i in range(B):
+        for i in range(real_B):
             row = out_np[i]
             end = np.where(row == self.tokenizer.eos_id)[0]
             row = row[: end[0]] if end.size else row
@@ -312,45 +393,13 @@ class JaxEngine(InferenceEngine):
         return self._run_free(prompts, temperature, max_tokens, top_p)
 
     def _run_free(self, full_prompts, temperature, max_tokens, top_p=1.0):
-        max_new = max_tokens
-        tokens, valid, L = self._prepare_batch(full_prompts, max_new)
-        B = tokens.shape[0]
-        V = self.spec.vocab_size
-
-        # Permissive automaton: single always-accepting state allowing all.
-        class _Free:
-            tables = jnp.zeros((1, 1, V), dtype=jnp.int16)
-            accepting = jnp.ones((1, 1), dtype=bool)
-            dfa_ids = jnp.zeros((B,), dtype=jnp.int32)
-            init_states = jnp.zeros((B,), dtype=jnp.int32)
-            num_unique = 1
-
-        batch = _Free()
-        cache = init_kv_cache(self.spec, B, L + max_new + 1)
-        first_logits, cache = self._prefill(
-            self.params, tokens=jnp.asarray(tokens), valid=jnp.asarray(valid),
-            cache=cache,
+        real_B, B, full_prompts = _pad_rows(full_prompts)
+        batch = GuidedBatch.permissive(B, self.spec.vocab_size)
+        texts = self._decode_batch(
+            full_prompts, batch, ("free", 1, self.spec.vocab_size), real_B,
+            temperature, max_tokens, top_p,
         )
-        S = L + max_new + 1
-        valid_mask = np.zeros((B, S), dtype=bool)
-        valid_mask[:, :L] = valid
-        prompt_lens = valid.sum(axis=1).astype(np.int32)
-        guided_sig = ("free", 1, V, B, L)
-        loop = self._get_decode_loop(guided_sig, temperature, max_new, top_p)
-        self._key, sub = jax.random.split(self._key)
-        out, _ = loop(
-            self.params, cache, first_logits, jnp.asarray(valid_mask),
-            jnp.asarray(prompt_lens), L,
-            batch.tables, batch.accepting, batch.dfa_ids, batch.init_states, sub,
-        )
-        out_np = np.asarray(out)
-        texts = []
-        for i in range(B):
-            row = out_np[i]
-            end = np.where(row == self.tokenizer.eos_id)[0]
-            row = row[: end[0]] if end.size else row
-            texts.append(self.tokenizer.decode(row.tolist()).strip())
-        return texts
+        return [t.strip() for t in texts]
 
     def shutdown(self) -> None:
         self.params = None

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import json
 import threading
+from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Dict, List, Sequence, Tuple
 
@@ -32,6 +33,7 @@ class SchemaGuide:
 
     token_dfa: TokenDFA
     schema_key: str
+    vocab_key: Tuple[int, int]  # (vocab_id, vocab_len) — see compile_schema
 
 
 _cache: Dict[Tuple[str, int], SchemaGuide] = {}
@@ -63,10 +65,25 @@ def compile_schema(
         return hit
     char_dfa = ast_to_dfa(schema_to_ast(schema))
     token_dfa = build_token_dfa(char_dfa, token_bytes, force_numpy=force_numpy)
-    guide = SchemaGuide(token_dfa=token_dfa, schema_key=key[0])
+    guide = SchemaGuide(
+        token_dfa=token_dfa, schema_key=key[0], vocab_key=(vocab_id, len(token_bytes))
+    )
     with _cache_lock:
         _cache[key] = guide
     return guide
+
+
+# Device-resident stacked tables, keyed by the (order-normalized) set of
+# schemas in the batch.  The game re-uses the same schema combos every
+# round (honest+Byzantine decide, honest+Byzantine vote); without this
+# cache each LLM call re-uploads the [dfas, states, vocab] table — tens
+# of MB per call, which dominates wall-clock on a remote-attached TPU.
+_table_cache: "OrderedDict[Tuple, Tuple]" = OrderedDict()
+_table_cache_lock = threading.Lock()
+# The stacked tables are tens of MB of device memory each; bound the
+# cache so long sweeps over many configs (value_range is embedded in the
+# schema text, so every config mints new keys) can't pin HBM without end.
+_TABLE_CACHE_MAX = 8
 
 
 class GuidedBatch:
@@ -74,36 +91,52 @@ class GuidedBatch:
 
     def __init__(self, guides: List[SchemaGuide]):
         """``guides[i]`` is the guide for batch row i.  Distinct guides are
-        deduplicated; tables are padded to the largest state count."""
-        unique: List[SchemaGuide] = []
-        index: Dict[int, int] = {}
-        dfa_ids = []
+        deduplicated (by schema, sorted so combo order doesn't matter);
+        tables are padded to the largest state count."""
+        by_key: Dict[Tuple, SchemaGuide] = {}
         for g in guides:
-            gid = id(g)
-            if gid not in index:
-                index[gid] = len(unique)
-                unique.append(g)
-            dfa_ids.append(index[gid])
-
-        vocab = unique[0].token_dfa.vocab_size
-        s_max = max(g.token_dfa.num_states for g in unique)
-        tables = np.full((len(unique), s_max, vocab), -1, dtype=np.int32)
-        accepting = np.zeros((len(unique), s_max), dtype=bool)
-        starts = np.zeros(len(unique), dtype=np.int32)
-        for i, g in enumerate(unique):
-            td = g.token_dfa
-            tables[i, : td.num_states] = td.transitions
-            accepting[i, : td.num_states] = td.accepting
-            starts[i] = td.start
+            by_key.setdefault((g.schema_key, g.vocab_key), g)
+        unique = [by_key[k] for k in sorted(by_key)]
+        index = {(g.schema_key, g.vocab_key): i for i, g in enumerate(unique)}
+        dfa_ids = [index[(g.schema_key, g.vocab_key)] for g in guides]
 
         import jax.numpy as jnp
 
-        # State counts are small (<100 for the BCG schemas); int16 halves
-        # the HBM footprint of the stacked [dfas, states, vocab] table.
-        if s_max < np.iinfo(np.int16).max:
-            tables = tables.astype(np.int16)
-        self.tables = jnp.asarray(tables)
-        self.accepting = jnp.asarray(accepting)
+        vocab = unique[0].token_dfa.vocab_size
+        # Same safety net as compile_schema: key on the tokenizer identity,
+        # not just the (paddable, collision-prone) vocab size.
+        cache_key = (
+            tuple((g.schema_key, g.vocab_key) for g in unique), vocab
+        )
+        with _table_cache_lock:
+            hit = _table_cache.get(cache_key)
+            if hit is not None:
+                _table_cache.move_to_end(cache_key)
+        if hit is None:
+            s_max = max(g.token_dfa.num_states for g in unique)
+            tables = np.full((len(unique), s_max, vocab), -1, dtype=np.int32)
+            accepting = np.zeros((len(unique), s_max), dtype=bool)
+            dist = np.full((len(unique), s_max), 2**30, dtype=np.int32)
+            starts = np.zeros(len(unique), dtype=np.int32)
+            for i, g in enumerate(unique):
+                td = g.token_dfa
+                tables[i, : td.num_states] = td.transitions
+                accepting[i, : td.num_states] = td.accepting
+                dist[i, : td.num_states] = td.dist
+                starts[i] = td.start
+            # State counts are small (<100 for the BCG schemas); int16
+            # halves the HBM footprint of the stacked table.
+            if s_max < np.iinfo(np.int16).max:
+                tables = tables.astype(np.int16)
+            hit = (
+                jnp.asarray(tables), jnp.asarray(accepting),
+                jnp.asarray(dist), starts,
+            )
+            with _table_cache_lock:
+                _table_cache[cache_key] = hit
+                while len(_table_cache) > _TABLE_CACHE_MAX:
+                    _table_cache.popitem(last=False)
+        self.tables, self.accepting, self.dist, starts = hit
         self.dfa_ids = jnp.asarray(np.array(dfa_ids, dtype=np.int32))
         self.init_states = jnp.asarray(starts[np.array(dfa_ids)])
         self.num_unique = len(unique)
@@ -132,3 +165,19 @@ class GuidedBatch:
         clamped = jnp.maximum(states, 0)
         nxt = self.tables[self.dfa_ids, clamped, tokens].astype(jnp.int32)
         return jnp.where(states < 0, states, nxt)
+
+    @classmethod
+    def permissive(cls, batch_size: int, vocab_size: int) -> "GuidedBatch":
+        """A one-state always-accepting automaton allowing every token —
+        unguided generation running through the same decode loop.  Built
+        here so its field set can never drift from the guided one."""
+        import jax.numpy as jnp
+
+        self = cls.__new__(cls)
+        self.tables = jnp.zeros((1, 1, vocab_size), dtype=jnp.int16)
+        self.accepting = jnp.ones((1, 1), dtype=bool)
+        self.dist = jnp.zeros((1, 1), dtype=jnp.int32)
+        self.dfa_ids = jnp.zeros((batch_size,), dtype=jnp.int32)
+        self.init_states = jnp.zeros((batch_size,), dtype=jnp.int32)
+        self.num_unique = 1
+        return self

@@ -31,6 +31,9 @@ _lib: Optional[ctypes.CDLL] = None
 _lib_tried = False
 
 
+_UNREACHABLE = np.iinfo(np.int32).max // 2  # dist sentinel: accept unreachable
+
+
 @dataclass
 class TokenDFA:
     """Token-level automaton for one schema.
@@ -38,11 +41,16 @@ class TokenDFA:
     transitions: int32 [num_states, vocab]; -1 = token forbidden
     accepting:   bool [num_states]; EOS legal exactly here
     start:       int
+    dist:        int32 [num_states]; tokens on the shortest path to an
+                 accepting state (0 there).  The decode loop masks any
+                 token whose next state cannot finish within the
+                 remaining budget (guaranteed-parse decoding)
     """
 
     transitions: np.ndarray
     accepting: np.ndarray
     start: int
+    dist: np.ndarray
 
     @property
     def num_states(self) -> int:
@@ -51,6 +59,38 @@ class TokenDFA:
     @property
     def vocab_size(self) -> int:
         return self.transitions.shape[1]
+
+
+def completion_paths(
+    transitions: np.ndarray, accepting: np.ndarray
+) -> np.ndarray:
+    """Distance (in tokens) from every state to the nearest accepting
+    state.
+
+    This powers **guaranteed-parse decoding**: the sampler masks any
+    token leading to a state whose distance exceeds the remaining budget,
+    so a guided generation can never run out of budget mid-JSON.  (vLLM
+    has no equivalent — its guided outputs truncate at ``max_tokens`` and
+    fail to parse; the reference burns a 3-attempt retry ladder on
+    exactly this, bcg_agents.py:708-759.)
+
+    Vectorised Bellman relaxation over the [states, vocab] table; the
+    iteration count is the DFA's completion diameter (tens for the BCG
+    schemas), not the state count.
+    """
+    S, V = transitions.shape
+    dist = np.where(accepting, 0, _UNREACHABLE).astype(np.int64)
+    valid = transitions >= 0
+    safe_next = np.clip(transitions, 0, None)
+    for _ in range(S):
+        # cand[s] = 1 + min_v dist[next(s, v)]
+        d = np.where(valid, dist[safe_next], _UNREACHABLE)
+        cand = 1 + d.min(axis=1)
+        improved = cand < dist
+        if not improved.any():
+            break
+        dist = np.where(improved, cand, dist)
+    return np.minimum(dist, _UNREACHABLE).astype(np.int32)
 
 
 def _load_native() -> Optional[ctypes.CDLL]:
@@ -168,4 +208,5 @@ def build_token_dfa(
         transitions=transitions,
         accepting=char_dfa.accepting.copy(),
         start=char_dfa.start,
+        dist=completion_paths(transitions, char_dfa.accepting),
     )

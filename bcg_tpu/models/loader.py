@@ -143,14 +143,19 @@ def load_checkpoint_params(
                 layer[logical] = fetch(hf_name, f"layers.{i}.{logical}")
             params["layers"].append(layer)
     finally:
-        # Release shard handles/mmaps deterministically.
+        # Release shard handles/mmaps deterministically.  safe_open
+        # handles expose the context-manager protocol; some versions also
+        # have .close() — prefer it, else call __exit__ with its three
+        # required args.
         for handle in open_files.values():
-            close = getattr(handle, "close", None) or getattr(handle, "__exit__", None)
             try:
-                if close is getattr(handle, "__exit__", None) and close is not None:
-                    close(None, None, None)
-                elif close is not None:
+                close = getattr(handle, "close", None)
+                if close is not None:
                     close()
+                else:
+                    exit_ = getattr(handle, "__exit__", None)
+                    if exit_ is not None:
+                        exit_(None, None, None)
             except Exception:
                 pass
         open_files.clear()

@@ -128,6 +128,10 @@ def attention(q, k, v, mask, scale, impl: str = "xla"):
         from bcg_tpu.ops.attention import flash_attention
 
         return flash_attention(q, k, v, mask, scale)
+    if impl == "blockwise":
+        from bcg_tpu.ops.attention import blockwise_attention
+
+        return blockwise_attention(q, k, v, mask, scale)
     return _xla_attention(q, k, v, mask, scale)
 
 

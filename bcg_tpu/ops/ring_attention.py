@@ -33,7 +33,9 @@ def _block_attend(q, k, v, q_pos, k_pos, scale, causal):
     Hkv = k.shape[2]
     group = H // Hkv
     qg = q.reshape(B, Tq, Hkv, group, Dh)
-    logits = jnp.einsum("bthgd,bshd->bhgts", qg, k).astype(jnp.float32) * scale
+    logits = jnp.einsum(
+        "bthgd,bshd->bhgts", qg, k, preferred_element_type=jnp.float32
+    ) * scale
     if causal:
         mask = q_pos[:, None] >= k_pos[None, :]  # [Tq, Tk]
         logits = jnp.where(mask[None, None, None], logits, -jnp.inf)
@@ -43,7 +45,10 @@ def _block_attend(q, k, v, q_pos, k_pos, scale, causal):
     p = jnp.exp(logits - safe_m[..., None])
     p = jnp.where(jnp.isfinite(logits), p, 0.0)
     l = jnp.sum(p, axis=-1)  # [B,Hkv,G,Tq]
-    acc = jnp.einsum("bhgts,bshd->bthgd", p.astype(v.dtype), v).astype(jnp.float32)
+    acc = jnp.einsum(
+        "bhgts,bshd->bthgd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
     return safe_m, l, acc
 
 

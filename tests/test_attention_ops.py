@@ -1,0 +1,116 @@
+"""Flash / blockwise attention vs the stock XLA einsum path.
+
+The Pallas kernel itself runs on TPU (and in interpret mode in CI);
+the blockwise scan is its everywhere-fallback — both must match
+``_xla_attention`` bit-for-reasonable-tolerance on random GQA shapes
+with the engine's real masking pattern (left-padded prompts + causal
+over a longer KV cache).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bcg_tpu.models.transformer import _xla_attention
+from bcg_tpu.ops.attention import _pad_to, blockwise_attention, flash_attention
+
+
+def _random_case(key, B, T, S, H, Hkv, Dh, dtype=jnp.float32):
+    ks = jax.random.split(key, 4)
+    q = jax.random.normal(ks[0], (B, T, H, Dh), dtype)
+    k = jax.random.normal(ks[1], (B, S, Hkv, Dh), dtype)
+    v = jax.random.normal(ks[2], (B, S, Hkv, Dh), dtype)
+    # Engine-shaped mask: left-padded valid prompt + causal into a cache
+    # that is longer than the prompt (decode slots not yet written).
+    lens = jax.random.randint(ks[3], (B,), 1, T + 1)
+    t_idx = jnp.arange(T)[None, :, None]
+    s_idx = jnp.arange(S)[None, None, :]
+    start = (T - lens)[:, None, None]
+    mask = (t_idx >= start) & (s_idx >= start) & (s_idx <= t_idx)
+    # Rows with no attendable key (pad rows) are meaningless: the XLA
+    # reference softmaxes uniform over -1e30 there while flash returns 0.
+    # Compare only rows that attend to something.
+    row_valid = mask.any(axis=-1)[..., None, None]  # [B, T, 1, 1]
+    return q, k, v, mask, row_valid
+
+
+@pytest.mark.parametrize("shape", [
+    (2, 64, 64, 4, 2, 32),      # GQA, square
+    (1, 17, 40, 4, 4, 16),      # MHA, ragged sizes, cache longer than T
+    (3, 128, 200, 8, 2, 64),    # cache longer than prompt
+])
+def test_blockwise_matches_xla(shape):
+    B, T, S, H, Hkv, Dh = shape
+    q, k, v, mask, rv = _random_case(jax.random.PRNGKey(0), B, T, S, H, Hkv, Dh)
+    scale = 1.0 / np.sqrt(Dh)
+    ref = np.asarray(_xla_attention(q, k, v, mask, scale) * rv)
+    out = np.asarray(blockwise_attention(q, k, v, mask, scale, block_kv=64) * rv)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_blockwise_fully_masked_rows_are_finite():
+    B, T, S, H, Hkv, Dh = 1, 8, 8, 2, 2, 16
+    q, k, v, _, _ = _random_case(jax.random.PRNGKey(1), B, T, S, H, Hkv, Dh)
+    mask = jnp.zeros((B, T, S), bool)  # pad rows attend to nothing
+    out = blockwise_attention(q, k, v, mask, 0.25, block_kv=8)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_flash_dispatches_to_blockwise_off_tpu():
+    # On CPU (the test backend) flash_attention must silently fall back
+    # and still be correct.
+    B, T, S, H, Hkv, Dh = 2, 32, 48, 4, 2, 32
+    q, k, v, mask, rv = _random_case(jax.random.PRNGKey(2), B, T, S, H, Hkv, Dh)
+    scale = 1.0 / np.sqrt(Dh)
+    ref = np.asarray(_xla_attention(q, k, v, mask, scale) * rv)
+    out = np.asarray(flash_attention(q, k, v, mask, scale) * rv)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_pallas_kernel_interpret_mode():
+    """Run the actual Pallas kernel (interpret=True) on CPU and compare."""
+    from bcg_tpu.ops import attention as A
+
+    B, T, S, H, Hkv, Dh = 1, 128, 256, 2, 1, 128
+    q, k, v, mask, rv = _random_case(jax.random.PRNGKey(3), B, T, S, H, Hkv, Dh)
+    scale = 1.0 / np.sqrt(Dh)
+    ref = _xla_attention(q, k, v, mask, scale) * rv
+
+    import functools
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    block_q, block_kv = 128, 128
+    group = H // Hkv
+    nT, nS = T // block_q, S // block_kv
+    kernel = functools.partial(A._flash_kernel, scale=scale, num_s_blocks=nS)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B, H, nT, nS),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, Dh), lambda b, h, t, s: (b, h, t, 0)),
+            pl.BlockSpec((1, 1, block_kv, Dh), lambda b, h, t, s, g=group: (b, h // g, s, 0)),
+            pl.BlockSpec((1, 1, block_kv, Dh), lambda b, h, t, s, g=group: (b, h // g, s, 0)),
+            pl.BlockSpec((1, block_q, block_kv), lambda b, h, t, s: (b, t, s)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, Dh), lambda b, h, t, s: (b, h, t, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, T, Dh), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, Dh), jnp.float32),
+        ],
+        interpret=True,
+    )(qt, kt, vt, mask)
+    out = out.transpose(0, 2, 1, 3) * rv
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_pad_to():
+    x = jnp.ones((2, 3))
+    assert _pad_to(x, 1, 4).shape == (2, 4)
+    assert _pad_to(x, 0, 2).shape == (2, 3)

@@ -299,3 +299,51 @@ class TestGuidedBatch:
         states = jnp.asarray([-1])
         states = batch.step(states, jnp.asarray([5]))
         assert int(states[0]) == -1
+
+
+class TestCompletionPaths:
+    def test_dist(self):
+        import numpy as np
+        from bcg_tpu.guided.token_dfa import completion_paths
+
+        # 3 states: 0 --t0--> 1 --t1--> 2(accept); t2 loops on 0.
+        trans = np.array([
+            [1, -1, 0],
+            [-1, 2, -1],
+            [-1, -1, -1],
+        ], dtype=np.int32)
+        accepting = np.array([False, False, True])
+        dist = completion_paths(trans, accepting)
+        assert list(dist) == [2, 1, 0]
+
+    def test_unreachable_accept(self):
+        import numpy as np
+        from bcg_tpu.guided.token_dfa import completion_paths
+
+        trans = np.array([[0, -1]], dtype=np.int32)  # loops forever
+        accepting = np.array([False])
+        dist = completion_paths(trans, accepting)
+        assert dist[0] > 1_000_000
+
+    def test_real_schema_distances_small(self):
+        from bcg_tpu.guided.dfa import ast_to_dfa
+        from bcg_tpu.guided.schema_compiler import schema_to_ast
+        from bcg_tpu.guided.token_dfa import build_token_dfa
+
+        schema = {
+            "type": "object",
+            "properties": {
+                "internal_strategy": {"type": "string", "minLength": 3},
+                "value": {"type": "integer", "minimum": 0, "maximum": 50},
+                "public_reasoning": {"type": "string", "minLength": 10},
+            },
+            "required": ["internal_strategy", "value", "public_reasoning"],
+            "additionalProperties": False,
+        }
+        token_bytes = [bytes([b]) for b in range(256)]
+        td = build_token_dfa(ast_to_dfa(schema_to_ast(schema)), token_bytes)
+        # From the start, completing the whole minimal object takes at
+        # most ~60 byte tokens; every reachable state can finish.
+        assert 0 < td.dist[td.start] < 80
+        reachable = td.transitions.max(axis=1) >= 0
+        assert (td.dist[reachable] < 1000).all()
