@@ -184,27 +184,27 @@ class JaxEngine(InferenceEngine):
         use_top_p = (not greedy) and top_p < 1.0
 
         def loop(params, cache, first_logits, valid_mask, prompt_lens, L,
-                 tables, accepting, dist, dfa_ids, init_states, rng):
+                 tables, accepting, min_budget, dfa_ids, init_states, rng):
             B = first_logits.shape[0]
             V = first_logits.shape[1]
 
             def masked_sample(logits, states, rng, pos):
                 clamped = jnp.maximum(states, 0)
-                rows = tables[dfa_ids, clamped]              # [B, V]
                 # Guaranteed parse: a token is only allowed if the state
                 # it leads to can still reach acceptance within the
-                # remaining budget (distances precomputed in
-                # guided/token_dfa.py completion_paths).  The sampler can
-                # therefore never truncate into invalid JSON — e.g. with 7
-                # tokens left it cannot open a minLength-10 string, and at
-                # the exact boundary only shortest-completion tokens
-                # survive the mask.  vLLM has no equivalent: its guided
-                # output just cuts off at max_tokens and fails to parse,
-                # which is what the reference's 3-attempt retry ladder
-                # (bcg_agents.py:708-759) exists to absorb.
-                next_d = dist[dfa_ids[:, None], jnp.maximum(rows, 0)]
+                # remaining budget (min_budget precomputed per (state,
+                # token) in GuidedBatch).  The sampler can therefore never
+                # truncate into invalid JSON — e.g. with 7 tokens left it
+                # cannot open a minLength-10 string, and at the exact
+                # boundary only shortest-completion tokens survive the
+                # mask.  vLLM has no equivalent: its guided output just
+                # cuts off at max_tokens and fails to parse, which is what
+                # the reference's 3-attempt retry ladder
+                # (bcg_agents.py:708-759) exists to absorb.  min_budget
+                # also encodes "forbidden" (sentinel), so this one gather
+                # is the entire mask.
                 budget_left = max_new - pos                  # incl. this token
-                allowed = (rows >= 0) & (next_d + 1 <= budget_left)
+                allowed = min_budget[dfa_ids, clamped] <= budget_left
                 eos_ok = accepting[dfa_ids, clamped]
                 any_tok = allowed.any(axis=-1)
                 scaled = logits if greedy else logits / temperature
@@ -235,16 +235,15 @@ class JaxEngine(InferenceEngine):
                 return tok.astype(jnp.int32), next_states, rng
 
             def cond(carry):
+                # Position max_new-1 is the last output slot, written by
+                # iteration max_new-2 — no trailing forward pass whose
+                # sample would only be discarded.
                 i, done, *_ = carry
-                return (i < max_new) & ~done.all()
+                return (i < max_new - 1) & ~done.all()
 
             def body(carry):
                 i, done, cur_tok, states, cache, valid_mask, out, rng = carry
-                out = jax.lax.dynamic_update_slice(
-                    out, jnp.where(done, eos_id, cur_tok)[:, None], (0, i)
-                )
-                done = done | (cur_tok == eos_id)
-                # Open cache slot L+i, run the step, sample the next token.
+                # Open cache slot L+i, run the step, sample token i+1.
                 valid_mask = jax.lax.dynamic_update_slice(
                     valid_mask, jnp.ones((B, 1), bool), (0, L + i)
                 )
@@ -254,18 +253,24 @@ class JaxEngine(InferenceEngine):
                     L + i, prompt_lens + i, cache, valid_mask, impl,
                 )
                 tok, states, rng = masked_sample(logits, states, rng, i + 1)
+                tok = jnp.where(done, eos_id, tok)
+                out = jax.lax.dynamic_update_slice(out, tok[:, None], (0, i + 1))
+                done = done | (tok == eos_id)
                 cur_tok = jnp.where(done, cur_tok, tok)
                 return (i + 1, done, cur_tok, states, cache, valid_mask, out, rng)
 
             tok0, states0, rng = masked_sample(first_logits, init_states, rng, 0)
             out = jnp.full((B, max_new), eos_id, dtype=jnp.int32)
-            carry = (jnp.int32(0), jnp.zeros((B,), bool), tok0, states0,
+            out = out.at[:, 0].set(tok0)
+            carry = (jnp.int32(0), tok0 == eos_id, tok0, states0,
                      cache, valid_mask, out, rng)
             i, done, cur_tok, states, cache, valid_mask, out, rng = jax.lax.while_loop(
                 cond, body, carry
             )
-            # Tokens sampled beyond the max_new budget are dropped (vLLM
-            # max_tokens semantics); early-exit rows are already EOS-filled.
+            # Early-exit rows are already EOS-filled (out initialized to
+            # EOS); budget-limited rows end in a forced completion whose
+            # last token occupies slot max_new-1 (vLLM max_tokens
+            # semantics).
             return out, rng
 
         compiled = jax.jit(loop, static_argnames=("L",), donate_argnums=(1,))
@@ -319,7 +324,7 @@ class JaxEngine(InferenceEngine):
         out, _ = loop(
             self.params, cache, first_logits, jnp.asarray(valid_mask),
             jnp.asarray(prompt_lens), L,
-            batch.tables, batch.accepting, batch.dist,
+            batch.tables, batch.accepting, batch.min_budget,
             batch.dfa_ids, batch.init_states, sub,
         )
         out_np = np.asarray(out)

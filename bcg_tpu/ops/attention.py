@@ -74,7 +74,8 @@ def _flash_kernel(
         o_ref[0, 0] = (acc_scr[...] / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
 
 
-def _pallas_flash(q, k, v, mask, scale, block_q: int, block_kv: int):
+def _pallas_flash(q, k, v, mask, scale, block_q: int, block_kv: int,
+                  interpret: bool = False):
     """q [B,H,T,Dh], k/v [B,Hkv,S,Dh], mask [B,T,S] — pre-padded so that
     T % block_q == 0, S % block_kv == 0, Dh % 128 == 0."""
     B, H, T, Dh = q.shape
@@ -106,6 +107,7 @@ def _pallas_flash(q, k, v, mask, scale, block_q: int, block_kv: int):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
+        interpret=interpret,
     )(q, k, v, mask)
 
 

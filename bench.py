@@ -78,10 +78,14 @@ def main() -> None:
             engine=engine,
         )
 
-    # Warmup: first round pays XLA compilation for prefill + decode loop.
+    # Warmup: first round pays XLA compilation for prefill + decode loop;
+    # round 2 covers the history-grown prompt bucket.  Terminated games
+    # are replaced so warmup always covers the intended round count.
+    warm_seed = 1000
     for _ in range(warmup_rounds):
         if sim.game.game_over:
-            break
+            sim = fresh_sim(warm_seed)
+            warm_seed += 1
         sim.run_round()
 
     # A game may terminate at any round (random-weight votes are

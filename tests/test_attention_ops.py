@@ -69,43 +69,18 @@ def test_flash_dispatches_to_blockwise_off_tpu():
 
 
 def test_pallas_kernel_interpret_mode():
-    """Run the actual Pallas kernel (interpret=True) on CPU and compare."""
-    from bcg_tpu.ops import attention as A
+    """Run the production Pallas launch config (interpret=True) on CPU."""
+    from bcg_tpu.ops.attention import _pallas_flash
 
     B, T, S, H, Hkv, Dh = 1, 128, 256, 2, 1, 128
     q, k, v, mask, rv = _random_case(jax.random.PRNGKey(3), B, T, S, H, Hkv, Dh)
     scale = 1.0 / np.sqrt(Dh)
     ref = _xla_attention(q, k, v, mask, scale) * rv
-
-    import functools
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
-
-    qt = q.transpose(0, 2, 1, 3)
-    kt = k.transpose(0, 2, 1, 3)
-    vt = v.transpose(0, 2, 1, 3)
-    block_q, block_kv = 128, 128
-    group = H // Hkv
-    nT, nS = T // block_q, S // block_kv
-    kernel = functools.partial(A._flash_kernel, scale=scale, num_s_blocks=nS)
-    out = pl.pallas_call(
-        kernel,
-        grid=(B, H, nT, nS),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, Dh), lambda b, h, t, s: (b, h, t, 0)),
-            pl.BlockSpec((1, 1, block_kv, Dh), lambda b, h, t, s, g=group: (b, h // g, s, 0)),
-            pl.BlockSpec((1, 1, block_kv, Dh), lambda b, h, t, s, g=group: (b, h // g, s, 0)),
-            pl.BlockSpec((1, block_q, block_kv), lambda b, h, t, s: (b, t, s)),
-        ],
-        out_specs=pl.BlockSpec((1, 1, block_q, Dh), lambda b, h, t, s: (b, h, t, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, H, T, Dh), q.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, Dh), jnp.float32),
-        ],
-        interpret=True,
-    )(qt, kt, vt, mask)
+    out = _pallas_flash(
+        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3), mask, scale,
+        block_q=128, block_kv=128, interpret=True,
+    )
     out = out.transpose(0, 2, 1, 3) * rv
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
