@@ -47,6 +47,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--topology", type=str, default=None, choices=["fully_connected", "ring", "grid"], help="Network topology")
     p.add_argument("--results-dir", type=str, default=None, help="Results directory")
     p.add_argument("--no-save", action="store_true", help="Disable result files")
+    p.add_argument("--plots", action="store_true", help="Save per-run plots (value trajectories, agreement)")
+    p.add_argument("--profile-dir", type=str, default=None,
+                   help="Write a jax.profiler trace of the run to this directory")
     p.add_argument("--checkpoint-every-round", action="store_true", help="Write a resumable checkpoint after each round")
     p.add_argument("--resume", type=str, default=None, help="Resume from checkpoint file")
     p.add_argument("--tensor-parallel", type=int, default=None, help="TP mesh axis size")
@@ -94,6 +97,8 @@ def config_from_args(args) -> BCGConfig:
         metrics = dataclasses.replace(metrics, save_results=False)
     if args.checkpoint_every_round:
         metrics = dataclasses.replace(metrics, checkpoint_every_round=True)
+    if args.plots:
+        metrics = dataclasses.replace(metrics, generate_plots=True)
 
     return BCGConfig(
         game=game,
@@ -132,7 +137,10 @@ def main(argv: Optional[list] = None) -> int:
         print(f"Error: {e}", file=sys.stderr)
         return 1
     try:
-        sim.run()
+        from bcg_tpu.runtime.profiler import jax_trace
+
+        with jax_trace(args.profile_dir):
+            sim.run()
     finally:
         sim.engine.shutdown()
         sim.close()

@@ -271,7 +271,7 @@ class JaxEngine(InferenceEngine):
             # EOS); budget-limited rows end in a forced completion whose
             # last token occupies slot max_new-1 (vLLM max_tokens
             # semantics).
-            return out, rng
+            return out, (rng, i)
 
         compiled = jax.jit(loop, static_argnames=("L",), donate_argnums=(1,))
         self._decode_loops[key] = compiled
@@ -321,7 +321,7 @@ class JaxEngine(InferenceEngine):
 
         loop = self._get_decode_loop(sig_prefix + (B, L), temperature, max_new, top_p)
         self._key, sub = jax.random.split(self._key)
-        out, _ = loop(
+        out, (_, steps) = loop(
             self.params, cache, first_logits, jnp.asarray(valid_mask),
             jnp.asarray(prompt_lens), L,
             batch.tables, batch.accepting, batch.min_budget,
@@ -331,6 +331,7 @@ class JaxEngine(InferenceEngine):
         if _TIMING:
             print(
                 f"[engine] decode B={B} L={L} max_new={max_new} "
+                f"steps={int(steps)} "
                 f"prefill={t1 - t0:.2f}s decode={time.perf_counter() - t1:.2f}s",
                 flush=True,
             )

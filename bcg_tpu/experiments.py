@@ -1,0 +1,156 @@
+"""Paper-experiment presets (reference README.md:55-70).
+
+The reference documents its Q1/Q2 experiments as raw CLI invocations;
+this module makes them first-class, repeatable presets with multi-run
+aggregation — plus the BASELINE.json sweep configs the reference never
+scripted:
+
+    python -m bcg_tpu.experiments q1-baseline --backend fake --runs 5
+    python -m bcg_tpu.experiments q2 --model qwen3-14b
+    python -m bcg_tpu.experiments scale-sweep --agents 16,32,64
+
+Each run goes through :func:`bcg_tpu.api.run_simulation` (no files
+written); the aggregate summary (consensus rate, mean rounds, Q2 quality
+scores) prints as JSON so sweeps are scriptable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from bcg_tpu.api import run_simulation
+
+
+@dataclass(frozen=True)
+class Preset:
+    name: str
+    description: str
+    honest: int
+    byzantine: int
+    awareness: str
+    max_rounds: int = 50
+
+
+# Reference README.md:57-70 ("Reproducing Paper Experiments") plus the
+# driver's BASELINE.json configs.
+PRESETS: Dict[str, Preset] = {
+    "q1-baseline": Preset(
+        "q1-baseline",
+        "Q1 cooperative: 4 honest, none_exist prompt (CPU-runnable smoke)",
+        honest=4, byzantine=0, awareness="none_exist",
+    ),
+    "q1-full": Preset(
+        "q1-full",
+        "Q1 cooperative: 8 honest, may_exist prompt",
+        honest=8, byzantine=0, awareness="may_exist",
+    ),
+    "q2": Preset(
+        "q2",
+        "Q2 resilience: 8 honest + 2 Byzantine, may_exist prompt",
+        honest=8, byzantine=2, awareness="may_exist",
+    ),
+}
+
+
+def _mean(xs: List[float]) -> Optional[float]:
+    xs = [x for x in xs if x is not None]
+    return round(statistics.mean(xs), 4) if xs else None
+
+
+def aggregate(metrics: List[Dict]) -> Dict:
+    """Cross-run summary over per-run ``get_statistics()`` payloads —
+    the distribution-level view SURVEY.md §7 calls for (the reference is
+    unseeded + temperature-sampled, so parity lives in aggregates, not
+    transcripts)."""
+    return {
+        "runs": len(metrics),
+        "consensus_rate": _mean([float(m.get("consensus_reached", False)) for m in metrics]),
+        "mean_rounds": _mean([m.get("total_rounds") for m in metrics]),
+        "mean_convergence_speed": _mean([m.get("convergence_speed") for m in metrics]),
+        "mean_quality_score": _mean([m.get("consensus_quality_score") for m in metrics]),
+        "mean_centrality": _mean([m.get("centrality") for m in metrics]),
+        "byzantine_infiltration_rate": _mean(
+            [float(m["byzantine_infiltration"])
+             for m in metrics if m.get("byzantine_infiltration") is not None]
+        ),
+        "outcomes": sorted(
+            {str(m.get("consensus_outcome")) for m in metrics}
+        ),
+    }
+
+
+def run_preset(
+    preset: Preset,
+    runs: int = 1,
+    model_name: Optional[str] = None,
+    backend: Optional[str] = None,
+    max_rounds: Optional[int] = None,
+    seed: Optional[int] = 0,
+    honest: Optional[int] = None,
+    byzantine: Optional[int] = None,
+) -> Dict:
+    per_run = []
+    for r in range(runs):
+        out = run_simulation(
+            n_agents=(honest if honest is not None else preset.honest)
+            + (byzantine if byzantine is not None else preset.byzantine),
+            byzantine_count=byzantine if byzantine is not None else preset.byzantine,
+            max_rounds=max_rounds if max_rounds is not None else preset.max_rounds,
+            byzantine_awareness=preset.awareness,
+            model_name=model_name,
+            backend=backend,
+            seed=None if seed is None else seed + r,
+        )
+        per_run.append(out["metrics"])
+    return {"preset": preset.name, "aggregate": aggregate(per_run), "per_run": per_run}
+
+
+def run_scale_sweep(
+    agent_counts: List[int],
+    byzantine_fraction: float = 0.0,
+    **kwargs,
+) -> List[Dict]:
+    """BASELINE.json config 4: growing agent populations (one-agent-per-
+    chip on real pods via the SPMD game step; batched on one chip here)."""
+    results = []
+    for n in agent_counts:
+        byz = int(n * byzantine_fraction)
+        p = Preset(f"scale-{n}", f"{n - byz}H+{byz}B", honest=n - byz,
+                   byzantine=byz, awareness="may_exist")
+        results.append(run_preset(p, **kwargs))
+    return results
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    p = argparse.ArgumentParser(description="BCG paper-experiment presets")
+    p.add_argument("preset", choices=[*PRESETS, "scale-sweep"])
+    p.add_argument("--runs", type=int, default=1)
+    p.add_argument("--model", type=str, default=None)
+    p.add_argument("--backend", type=str, default=None, choices=["jax", "fake"])
+    p.add_argument("--rounds", type=int, default=None)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--agents", type=str, default="16,32,64",
+                   help="scale-sweep agent counts, comma-separated")
+    p.add_argument("--byzantine-fraction", type=float, default=0.0,
+                   help="scale-sweep Byzantine share of each population")
+    args = p.parse_args(argv)
+
+    common = dict(runs=args.runs, model_name=args.model, backend=args.backend,
+                  max_rounds=args.rounds, seed=args.seed)
+    if args.preset == "scale-sweep":
+        out = run_scale_sweep(
+            [int(x) for x in args.agents.split(",")],
+            byzantine_fraction=args.byzantine_fraction, **common,
+        )
+        print(json.dumps([{k: r[k] for k in ("preset", "aggregate")} for r in out], indent=2))
+    else:
+        out = run_preset(PRESETS[args.preset], **common)
+        print(json.dumps({"preset": out["preset"], "aggregate": out["aggregate"]}, indent=2))
+
+
+if __name__ == "__main__":
+    main()

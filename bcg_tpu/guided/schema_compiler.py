@@ -38,8 +38,18 @@ from bcg_tpu.guided.regex_ast import (
     star,
 )
 
-# Optional whitespace between structural JSON tokens.
-WS = star(char_set(" \n\t"))
+# Optional whitespace between structural JSON tokens — BOUNDED to three
+# characters (the outlines/vLLM convention is similar:
+# whitespace_pattern "[ \n\t]?").  An unbounded \s* gives a weak or
+# adversarial model an infinite non-progress loop inside the automaton:
+# with sharpened sampling it can emit whitespace until the token budget
+# forces completion, turning a 25-token vote into max_tokens of decode.
+# Three chars cover compact output and flat (depth-1) indent<=2
+# pretty-printing; deeper indentation is out of grammar — fine for
+# GENERATION (the mask simply forbids it), a caveat only if the DFA is
+# reused to validate external pretty-printed JSON.
+_WS_CHAR = char_set(" \n\t")
+WS = seq(opt(_WS_CHAR), opt(_WS_CHAR), opt(_WS_CHAR))
 
 # String content byte: printable ASCII except '"' and '\'.
 _CONTENT = CharClass(

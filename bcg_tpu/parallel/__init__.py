@@ -7,13 +7,23 @@ and KV caches, and XLA collectives (all_gather/psum) inserted by the
 compiler from sharding annotations.
 """
 
+from bcg_tpu.parallel.distributed import (
+    build_hybrid_mesh,
+    initialize,
+    process_info,
+    shutdown,
+)
 from bcg_tpu.parallel.mesh import build_mesh, mesh_axes
 from bcg_tpu.parallel.sharding import param_sharding, shard_params, kv_cache_sharding
 
 __all__ = [
     "build_mesh",
+    "build_hybrid_mesh",
+    "initialize",
     "mesh_axes",
     "param_sharding",
+    "process_info",
     "shard_params",
+    "shutdown",
     "kv_cache_sharding",
 ]

@@ -1,0 +1,120 @@
+"""Multi-host distributed runtime.
+
+The reference's only distributed machinery is vLLM's internal
+NCCL/torch.distributed stack, reached through ``tensor_parallel_size``
+and ``distributed_executor_backend='mp'`` (``vllm_agent.py:139-142``)
+and torn down via ``torch.distributed.destroy_process_group``
+(``vllm_agent.py:541-551``).  The TPU-native equivalent is the JAX
+distributed runtime plus XLA collectives: this module initializes the
+process group (GCE metadata auto-detect on Cloud TPU, or explicit
+coordinator for manual clusters) and builds **hybrid meshes** whose
+inner axes (tp, sp) ride ICI within a slice while the outer axis (dp)
+crosses DCN between hosts/slices — the layout where every
+bandwidth-hungry collective (psum/all_gather from tensor and sequence
+parallelism) stays on ICI and only data-parallel traffic touches DCN.
+"""
+
+from __future__ import annotations
+
+import atexit
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from bcg_tpu.parallel.mesh import AXES, build_mesh
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: Optional[Sequence[int]] = None,
+) -> None:
+    """Join (or create) the multi-host process group.
+
+    With no arguments, JAX auto-detects the topology on Cloud TPU (GCE
+    metadata / megascale env).  All hosts must call this before any
+    device computation.  Idempotent; registers shutdown at exit —
+    the analogue of the reference's ``destroy_process_group`` teardown.
+    """
+    global _initialized
+    if _initialized:
+        return
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    if local_device_ids is not None:
+        kwargs["local_device_ids"] = list(local_device_ids)
+    # NOTE: must run before anything touches the XLA backend (even
+    # jax.devices()/process_count()) — jax.distributed.initialize raises
+    # once backends exist, so this function deliberately queries nothing.
+    jax.distributed.initialize(**kwargs)
+    _initialized = True
+    atexit.register(shutdown)
+
+
+def shutdown() -> None:
+    global _initialized
+    if _initialized:
+        try:
+            jax.distributed.shutdown()
+        except Exception:
+            pass  # already torn down (interpreter exit ordering)
+        _initialized = False
+
+
+def build_hybrid_mesh(
+    tp: int = 1,
+    sp: int = 1,
+    dp: Optional[int] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """dp x tp x sp mesh where tp/sp are ICI-contiguous within each host
+    and dp spans hosts over DCN.
+
+    ``jax.devices()`` orders devices host-major, so reshaping to
+    (dp, tp, sp) with tp*sp dividing the per-host device count keeps
+    every tp/sp group inside one host's ICI domain.  ``dp`` defaults to
+    "all remaining devices".  Degenerates to the single-host mesh when
+    process_count == 1 — the same code path runs everywhere.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    inner = tp * sp
+    n_local = len([d for d in devices if d.process_index == devices[0].process_index])
+    multihost = any(
+        d.process_index != devices[0].process_index for d in devices
+    )
+    # tp/sp groups must not straddle a host boundary: with device order
+    # host-major, that requires the per-host device count to be an exact
+    # multiple of tp*sp (otherwise some dp row spans two hosts' devices).
+    if multihost and (inner > n_local or n_local % inner != 0):
+        raise ValueError(
+            f"tp*sp={inner} does not pack into the {n_local} devices of "
+            "one host; a tp/sp collective group would cross DCN — resize "
+            "them or move the extra parallelism to dp"
+        )
+    if dp is None:
+        if len(devices) % inner:
+            raise ValueError(
+                f"{len(devices)} devices not divisible by tp*sp={inner}"
+            )
+        dp = len(devices) // inner
+    return build_mesh(dp=dp, tp=tp, sp=sp, devices=devices)
+
+
+def process_info() -> dict:
+    """Cluster shape summary for logs/metrics."""
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_device_count": jax.local_device_count(),
+        "global_device_count": jax.device_count(),
+    }

@@ -124,6 +124,7 @@ class BCGSimulation:
         self.profiler = SimulationProfiler()
 
         self.agents: Dict = {}
+        self._plotted = False
         self._create_agents()
 
     @staticmethod
@@ -502,6 +503,8 @@ class BCGSimulation:
         self.display_results()
         if self.config.metrics.save_results:
             self.save_results()
+        else:
+            self._maybe_plot()  # --plots without result files still plots
         return self.game.get_statistics()
 
     # ----------------------------------------------------------------- output
@@ -572,7 +575,23 @@ class BCGSimulation:
         self.logger.log(f"  JSON: {json_path}")
         self.logger.echo(f"Results: {json_path}")
         self.logger.echo(f"Metrics: {csv_path}")
+        self._maybe_plot()
         return json_path
+
+    def _maybe_plot(self) -> None:
+        if not self.config.metrics.generate_plots or self._plotted:
+            return
+        self._plotted = True
+        from bcg_tpu.runtime.plots import generate_run_plots
+
+        plot_path = generate_run_plots(
+            self.game, self.config.metrics.results_dir, self.run_number
+        )
+        if plot_path:
+            self.logger.echo(f"Plots: {plot_path}")
+        else:
+            self.logger.echo("Plots requested but not generated "
+                             "(matplotlib unavailable or no rounds)")
 
     def close(self) -> None:
         self.logger.close()

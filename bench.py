@@ -66,9 +66,12 @@ def main() -> None:
     n_agents = cfg.game.num_honest + cfg.game.num_byzantine
     engine = sim.engine  # reuse across games: compiled loops persist
 
-    import jax
+    if backend == "fake":
+        platform = "none"  # fake engine never touches a device
+    else:
+        import jax
 
-    platform = jax.devices()[0].platform
+        platform = jax.devices()[0].platform
 
     def fresh_sim(seed):
         return BCGSimulation(
@@ -78,15 +81,23 @@ def main() -> None:
             engine=engine,
         )
 
-    # Warmup: first round pays XLA compilation for prefill + decode loop;
-    # round 2 covers the history-grown prompt bucket.  Terminated games
-    # are replaced so warmup always covers the intended round count.
+    # Warmup: round 1 pays XLA compilation for the initial shapes; a
+    # round >= 2 covers the history-grown prompt bucket.  Terminated
+    # games are replaced, and warmup keeps going until a round >= 2 has
+    # actually run (a replacement game restarts at round 1), so the
+    # measured window is compile-free.
     warm_seed = 1000
-    for _ in range(warmup_rounds):
+    warmed = 0
+    saw_round2 = False
+    while warmed < warmup_rounds or not saw_round2:
         if sim.game.game_over:
             sim = fresh_sim(warm_seed)
             warm_seed += 1
         sim.run_round()
+        warmed += 1
+        saw_round2 = saw_round2 or len(sim.game.rounds) >= 2
+        if warmed >= warmup_rounds + 6:  # pathological termination streak
+            break
 
     # A game may terminate at any round (random-weight votes are
     # correlated); keep starting fresh games until N rounds are measured.

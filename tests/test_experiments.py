@@ -1,0 +1,72 @@
+"""Experiment presets + multi-host mesh helpers (hermetic, fake engine /
+virtual CPU devices)."""
+
+import jax
+import pytest
+
+from bcg_tpu.experiments import PRESETS, aggregate, run_preset, run_scale_sweep
+from bcg_tpu.parallel.distributed import build_hybrid_mesh, process_info
+
+
+class TestExperiments:
+    def test_q1_baseline_runs_and_aggregates(self):
+        out = run_preset(PRESETS["q1-baseline"], runs=2, backend="fake",
+                         max_rounds=5, seed=0)
+        agg = out["aggregate"]
+        assert agg["runs"] == 2
+        assert 0.0 <= agg["consensus_rate"] <= 1.0
+        assert agg["mean_rounds"] is not None
+        assert len(out["per_run"]) == 2
+
+    def test_q2_has_byzantine_metrics(self):
+        out = run_preset(PRESETS["q2"], runs=1, backend="fake", max_rounds=5, seed=1)
+        m = out["per_run"][0]
+        assert m["num_byzantine"] == 2
+
+    def test_seeded_runs_reproduce(self):
+        a = run_preset(PRESETS["q1-baseline"], runs=1, backend="fake",
+                       max_rounds=5, seed=7)
+        b = run_preset(PRESETS["q1-baseline"], runs=1, backend="fake",
+                       max_rounds=5, seed=7)
+        assert a["per_run"][0]["total_rounds"] == b["per_run"][0]["total_rounds"]
+        assert a["per_run"][0]["consensus_value"] == b["per_run"][0]["consensus_value"]
+
+    def test_scale_sweep_byzantine_fraction(self):
+        outs = run_scale_sweep([8], byzantine_fraction=0.25, runs=1,
+                               backend="fake", max_rounds=3, seed=0)
+        assert outs[0]["per_run"][0]["num_byzantine"] == 2
+        assert outs[0]["per_run"][0]["num_honest"] == 6
+
+    def test_aggregate_empty_values(self):
+        agg = aggregate([{"consensus_reached": True, "total_rounds": 3}])
+        assert agg["byzantine_infiltration_rate"] is None
+        assert agg["consensus_rate"] == 1.0
+
+
+class TestHybridMesh:
+    # conftest forces 8 virtual CPU devices.
+
+    def test_full_dp(self):
+        mesh = build_hybrid_mesh(tp=1, sp=1)
+        assert mesh.shape == {"dp": 8, "tp": 1, "sp": 1}
+
+    def test_tp_sp_inner(self):
+        mesh = build_hybrid_mesh(tp=2, sp=2)
+        assert mesh.shape == {"dp": 2, "tp": 2, "sp": 2}
+
+    def test_explicit_dp_subset(self):
+        mesh = build_hybrid_mesh(tp=2, sp=1, dp=2)
+        assert mesh.shape == {"dp": 2, "tp": 2, "sp": 1}
+
+    def test_indivisible_raises(self):
+        with pytest.raises(ValueError):
+            build_hybrid_mesh(tp=3, sp=1)
+
+    def test_oversize_raises(self):
+        with pytest.raises(ValueError):
+            build_hybrid_mesh(tp=2, sp=2, dp=4)
+
+    def test_process_info_single_host(self):
+        info = process_info()
+        assert info["process_count"] == 1
+        assert info["global_device_count"] == jax.device_count()

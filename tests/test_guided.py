@@ -97,9 +97,12 @@ class TestSchemaDFA:
             "public_reasoning": "converging to the majority",
         }
         assert accepts(dfa, json.dumps(obj))
-        # whitespace variants
+        # Whitespace is bounded (<=3 chars between structural tokens) so a
+        # weak model can't loop on separators: compact and indent<=2 forms
+        # are in-grammar, deeper indentation is not.
         assert accepts(dfa, json.dumps(obj, indent=2))
         assert accepts(dfa, json.dumps(obj, separators=(",", ":")))
+        assert not accepts(dfa, json.dumps(obj, indent=8))
 
     def test_honest_decision_rejects_bad_json(self):
         dfa = dfa_for(HONEST_DECISION)

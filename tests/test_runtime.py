@@ -275,3 +275,36 @@ class TestBatchAPI:
         assert stats["num_honest"] == 3 and stats["num_byzantine"] == 1
         assert stats["byzantine_awareness"] == "may_exist"
         assert "consensus_outcome" in stats
+
+
+class TestPlots:
+    def test_generate_plots_flag_writes_png(self, tmp_path):
+        import dataclasses
+
+        import pytest
+        pytest.importorskip("matplotlib")
+
+        from bcg_tpu.config import BCGConfig
+        from bcg_tpu.runtime.orchestrator import BCGSimulation
+
+        base = BCGConfig()
+        cfg = dataclasses.replace(
+            base,
+            game=dataclasses.replace(
+                base.game, num_honest=3, num_byzantine=1, max_rounds=4, seed=0
+            ),
+            engine=dataclasses.replace(base.engine, backend="fake"),
+            metrics=dataclasses.replace(
+                base.metrics,
+                save_results=True,
+                generate_plots=True,
+                results_dir=str(tmp_path),
+            ),
+        )
+        sim = BCGSimulation(config=cfg)
+        try:
+            sim.run()
+        finally:
+            sim.close()
+        pngs = list((tmp_path / "plots").glob("run_*.png"))
+        assert len(pngs) == 1 and pngs[0].stat().st_size > 1000
