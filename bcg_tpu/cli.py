@@ -45,6 +45,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--model", type=str, default=None, help="Model preset key or full path")
     p.add_argument("--seed", type=int, default=None, help="Game RNG seed (reproducible runs)")
     p.add_argument("--topology", type=str, default=None, choices=["fully_connected", "ring", "grid"], help="Network topology")
+    p.add_argument("--spmd-exchange", action="store_true",
+                   help="Exchange values via XLA collectives (one all_gather) instead of the host message loop")
     p.add_argument("--results-dir", type=str, default=None, help="Results directory")
     p.add_argument("--no-save", action="store_true", help="Disable result files")
     p.add_argument("--plots", action="store_true", help="Save per-run plots (value trajectories, agreement)")
@@ -90,6 +92,8 @@ def config_from_args(args) -> BCGConfig:
     network = base.network
     if args.topology:
         network = dataclasses.replace(network, topology_type=args.topology)
+    if args.spmd_exchange:
+        network = dataclasses.replace(network, spmd_exchange=True)
     metrics = base.metrics
     if args.results_dir:
         metrics = dataclasses.replace(metrics, results_dir=args.results_dir)

@@ -48,6 +48,11 @@ class NetworkConfig:
     topology_type: str = "fully_connected"  # fully_connected | ring | grid | custom
     custom_adjacency: Optional[Dict[int, List[int]]] = None
     grid_shape: Optional[Tuple[int, int]] = None  # (rows, cols) for grid
+    # Route the numeric broadcast/receive phase through XLA collectives
+    # (one all_gather over the mesh) instead of the O(n^2) host message
+    # loop — the one-agent-per-chip scale path.  Reasoning strings stay
+    # host-side; game results are identical either way (tested).
+    spmd_exchange: bool = False
 
 
 @dataclass(frozen=True)

@@ -126,6 +126,13 @@ class BCGSimulation:
         self.agents: Dict = {}
         self._plotted = False
         self._create_agents()
+        # SPMD value-exchange path (NetworkConfig.spmd_exchange): lazily
+        # built mesh + static topology mask; host-protocol-equivalent
+        # message accounting.
+        self._spmd_mesh = None
+        self._spmd_mask = None
+        self._spmd_mask_np = None
+        self._spmd_message_count = 0
 
     @staticmethod
     def _next_run_number(json_dir: str) -> str:
@@ -404,41 +411,47 @@ class BCGSimulation:
                     self.game.update_agent_proposal(aid, int(round(new_value)))
                     self.logger.log(f"  {aid}: -> {int(round(new_value))}")
 
-        # 2. Broadcast
-        self.logger.log("[Broadcast Phase]")
-        with self.profiler.phase("broadcast"):
-            for aid, agent in self.agents.items():
-                proposed = self.game.agents[aid].proposed_value
-                if proposed is None:
-                    self.logger.log(f"  {aid}: (abstaining, no broadcast)")
-                    continue
-                self.network.broadcast_message(
-                    sender_id=aid,
-                    round_num=round_num,
-                    phase=phase,
-                    decision=Decision(type=DecisionType.VALUE.value, value=int(proposed)),
-                    reasoning=agent.last_reasoning
-                    or f"Proposing value: {int(proposed)}",
-                )
-                tag = " (Byzantine)" if agent.is_byzantine else ""
-                self.logger.log(f"  {aid}{tag}: broadcasts value {int(proposed)}")
-
-        # 3. Receive
-        self.logger.log("[Receive Phase - Updating State]")
-        with self.profiler.phase("receive"):
-            for aid, agent in self.agents.items():
-                messages = self.network.get_messages(aid, round_num, phase)
-                proposals = [
-                    (
-                        self.network.index_to_agent_id[m.sender_id],
-                        m.decision.value,
-                        m.reasoning,
+        # 2 + 3. Broadcast / Receive
+        if self.config.network.spmd_exchange:
+            self.logger.log("[Broadcast/Receive Phase - SPMD all_gather]")
+            # One collective covers both host phases; timed as a single
+            # "exchange" phase (broadcast/receive split has no meaning here).
+            with self.profiler.phase("exchange"):
+                self._broadcast_receive_spmd()
+        else:
+            self.logger.log("[Broadcast Phase]")
+            with self.profiler.phase("broadcast"):
+                for aid, agent in self.agents.items():
+                    proposed = self.game.agents[aid].proposed_value
+                    if proposed is None:
+                        self.logger.log(f"  {aid}: (abstaining, no broadcast)")
+                        continue
+                    self.network.broadcast_message(
+                        sender_id=aid,
+                        round_num=round_num,
+                        phase=phase,
+                        decision=Decision(type=DecisionType.VALUE.value, value=int(proposed)),
+                        reasoning=agent.last_reasoning
+                        or f"Proposing value: {int(proposed)}",
                     )
-                    for m in messages
-                ]
-                agent.receive_proposals(proposals)
-                agent.my_value = self.game.agents[aid].proposed_value
-                self.logger.log(f"  {aid}: received {len(proposals)} proposals, updated state")
+                    tag = " (Byzantine)" if agent.is_byzantine else ""
+                    self.logger.log(f"  {aid}{tag}: broadcasts value {int(proposed)}")
+
+            self.logger.log("[Receive Phase - Updating State]")
+            with self.profiler.phase("receive"):
+                for aid, agent in self.agents.items():
+                    messages = self.network.get_messages(aid, round_num, phase)
+                    proposals = [
+                        (
+                            self.network.index_to_agent_id[m.sender_id],
+                            m.decision.value,
+                            m.reasoning,
+                        )
+                        for m in messages
+                    ]
+                    agent.receive_proposals(proposals)
+                    agent.my_value = self.game.agents[aid].proposed_value
+                    self.logger.log(f"  {aid}: received {len(proposals)} proposals, updated state")
 
         # 3.5 Round summaries + Q3 reasoning capture
         self._update_round_summaries(round_num)
@@ -507,6 +520,86 @@ class BCGSimulation:
             self._maybe_plot()  # --plots without result files still plots
         return self.game.get_statistics()
 
+    # ------------------------------------------------------------ SPMD path
+
+    def _broadcast_receive_spmd(self) -> None:
+        """Value exchange as ONE ``all_gather`` over the mesh instead of
+        the host protocol's O(n^2) per-message loop (BASELINE north star:
+        'message exchange is a jax.lax.all_gather over the ICI mesh').
+
+        Values ride the collective; reasoning strings (<=500 chars, the
+        A2A cap) stay host-side — they feed prompts and Q3 metrics, not
+        the consensus math.  Proposal ordering matches the A2A inbox sort
+        (by sender index), so agents see byte-identical state either way.
+        """
+        import jax.numpy as jnp
+        import numpy as np
+
+        from bcg_tpu.comm.a2a_sim import REASONING_CHAR_LIMIT
+        from bcg_tpu.parallel.game_step import exchange_values
+        from bcg_tpu.parallel.mesh import build_mesh
+
+        ids = sorted(self.agents)
+        n = len(ids)
+        if self._spmd_mesh is None:
+            import jax
+
+            # Largest device count that divides n: one-agent-per-chip
+            # when n == device count, graceful degradation down to dp=1.
+            n_dev = len(jax.devices())
+            dp = next(d for d in range(min(n, n_dev), 0, -1) if n % d == 0)
+            self._spmd_mesh = build_mesh(dp=dp)
+            # Receiver view: row i holds the senders whose OUT-edges
+            # reach i — the transpose of neighbor_mask()'s mask[s, adj[s]]
+            # — matching the host protocol's broadcast_to_neighbors
+            # delivery for asymmetric custom adjacency.
+            self._spmd_mask_np = self.topology.neighbor_mask().T.copy()
+            self._spmd_mask = jnp.asarray(self._spmd_mask_np)
+
+        lo = self.config.game.value_range[0]
+        encoded = jnp.asarray(
+            [
+                (self.game.agents[a].proposed_value - lo)
+                if self.game.agents[a].proposed_value is not None
+                else -1
+                for a in ids
+            ],
+            dtype=jnp.int32,
+        )
+        received = np.asarray(
+            exchange_values(encoded, self._spmd_mask, self._spmd_mesh)
+        )
+
+        def _cap(text):  # A2AMessage.__post_init__ truncation, verbatim
+            if len(text) > REASONING_CHAR_LIMIT:
+                return text[: REASONING_CHAR_LIMIT - 3] + "..."
+            return text
+
+        reasonings = {
+            aid: _cap(agent.last_reasoning
+                      or f"Proposing value: {self.game.agents[aid].proposed_value}")
+            for aid, agent in self.agents.items()
+        }
+        mask_np = self._spmd_mask_np
+        for i, aid in enumerate(ids):
+            proposals = [
+                (ids[j], int(received[i, j]) + lo, reasonings[ids[j]])
+                for j in range(n)
+                if received[i, j] >= 0
+            ]
+            agent = self.agents[aid]
+            agent.receive_proposals(proposals)
+            agent.my_value = self.game.agents[aid].proposed_value
+            self.logger.log(
+                f"  {aid}: received {len(proposals)} proposals (spmd), updated state"
+            )
+        # Host-protocol-equivalent accounting: one message per delivered
+        # (proposer -> neighbour) edge.
+        proposed = np.array(
+            [self.game.agents[a].proposed_value is not None for a in ids]
+        )
+        self._spmd_message_count += int((mask_np & proposed[None, :]).sum())
+
     # ----------------------------------------------------------------- output
 
     def display_results(self) -> None:
@@ -539,7 +632,7 @@ class BCGSimulation:
         log(f"  Honest: {', '.join(stats['honest_agent_ids'])}")
         net = self.network.get_network_stats()
         log("[Communication Statistics]")
-        log(f"  Total messages: {net['total_messages']}")
+        log(f"  Total messages: {net['total_messages'] + self._spmd_message_count}")
         log(f"  Topology: {net['topology_type']} (avg degree {net['avg_degree']:.1f})")
         perf = self.profiler.summary()
         log("[Performance]")
@@ -551,7 +644,10 @@ class BCGSimulation:
         """Persist the three sinks: JSON, CSV metrics, log (reference
         main.py:792-995; layout byte-compatible)."""
         stats = self.game.get_statistics()
-        message_count = self.network.protocol.get_total_message_count()
+        message_count = (
+            self.network.protocol.get_total_message_count()
+            + self._spmd_message_count
+        )
         metrics = build_metrics_payload(
             run_number=int(self.run_number),
             stats=stats,

@@ -175,3 +175,89 @@ class TestSPMDGameStep:
         assert received.shape == (8, 8)
         assert bool(tally["terminate"])
         assert bool(consensus["has_consensus"])  # 7 is agent_0's initial
+
+
+class TestSPMDExchangeIntegration:
+    """The orchestrator's SPMD broadcast/receive path must be
+    indistinguishable from the host A2A protocol at the game level."""
+
+    def _run(self, spmd: bool, topology: str = "fully_connected"):
+        import dataclasses
+
+        from bcg_tpu.config import BCGConfig
+        from bcg_tpu.runtime.orchestrator import BCGSimulation
+
+        base = BCGConfig()
+        cfg = dataclasses.replace(
+            base,
+            game=dataclasses.replace(
+                base.game, num_honest=6, num_byzantine=2, max_rounds=6, seed=3
+            ),
+            network=dataclasses.replace(
+                base.network, topology_type=topology, spmd_exchange=spmd
+            ),
+            engine=dataclasses.replace(base.engine, backend="fake"),
+            metrics=dataclasses.replace(base.metrics, save_results=False),
+        )
+        sim = BCGSimulation(config=cfg)
+        try:
+            while not sim.game.game_over:
+                sim.run_round()
+            stats = sim.game.get_statistics()
+            msgs = (sim.network.protocol.get_total_message_count()
+                    + sim._spmd_message_count)
+            return stats, msgs
+        finally:
+            sim.close()
+
+    def test_identical_game_stats_fully_connected(self):
+        host_stats, host_msgs = self._run(spmd=False)
+        spmd_stats, spmd_msgs = self._run(spmd=True)
+        assert spmd_stats == host_stats
+        assert spmd_msgs == host_msgs
+
+    def test_identical_game_stats_ring(self):
+        host_stats, host_msgs = self._run(spmd=False, topology="ring")
+        spmd_stats, spmd_msgs = self._run(spmd=True, topology="ring")
+        assert spmd_stats == host_stats
+        assert spmd_msgs == host_msgs
+
+    def test_identical_game_stats_asymmetric_custom(self):
+        # Directed adjacency: delivery must follow the SENDER's out-edges
+        # (host protocol semantics), not the receiver's rows.
+        import dataclasses
+
+        from bcg_tpu.config import BCGConfig
+        from bcg_tpu.runtime.orchestrator import BCGSimulation
+
+        adj = {0: [1, 2], 1: [2], 2: [0], 3: [0, 1, 2]}
+        results = []
+        for spmd in (False, True):
+            base = BCGConfig()
+            cfg = dataclasses.replace(
+                base,
+                game=dataclasses.replace(
+                    base.game, num_honest=3, num_byzantine=1, max_rounds=5, seed=9
+                ),
+                network=dataclasses.replace(
+                    base.network, topology_type="custom",
+                    custom_adjacency=adj, spmd_exchange=spmd,
+                ),
+                engine=dataclasses.replace(base.engine, backend="fake"),
+                metrics=dataclasses.replace(base.metrics, save_results=False),
+            )
+            sim = BCGSimulation(config=cfg)
+            try:
+                while not sim.game.game_over:
+                    sim.run_round()
+                results.append((
+                    sim.game.get_statistics(),
+                    sim.network.protocol.get_total_message_count()
+                    + sim._spmd_message_count,
+                    {aid: a.received_proposals for aid, a in sim.agents.items()},
+                ))
+            finally:
+                sim.close()
+        assert results[0][0] == results[1][0]
+        assert results[0][1] == results[1][1]
+        assert results[0][2] == results[1][2]
