@@ -26,6 +26,15 @@ from bcg_tpu.comm.protocol import CommunicationProtocol, Message, ProtocolClient
 REASONING_CHAR_LIMIT = 500  # a2a_sim.py:69-73
 
 
+def truncate_reasoning(text: str) -> str:
+    """The protocol's reasoning cap (reference a2a_sim.py:69-73) — the
+    single definition both the message type and the SPMD exchange path
+    use, so the two delivery paths stay byte-identical."""
+    if len(text) > REASONING_CHAR_LIMIT:
+        return text[: REASONING_CHAR_LIMIT - 3] + "..."
+    return text
+
+
 class Phase(str, Enum):
     """Protocol phases (reference a2a_sim.py:20-26)."""
 
@@ -76,8 +85,7 @@ class A2AMessage(Message):
     timestamp: int
 
     def __post_init__(self):
-        if len(self.reasoning) > REASONING_CHAR_LIMIT:
-            self.reasoning = self.reasoning[: REASONING_CHAR_LIMIT - 3] + "..."
+        self.reasoning = truncate_reasoning(self.reasoning)
 
     def to_dict(self) -> Dict[str, Any]:
         return {

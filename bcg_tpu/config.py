@@ -75,6 +75,10 @@ class EngineConfig:
     sequence_parallel_size: int = 1
     max_num_seqs: int = 4
     dtype: str = "bfloat16"
+    # "int8" stores the KV cache quantized (per-position-per-head absmax
+    # scales); the Pallas decode kernel dequantizes in VMEM, halving the
+    # HBM traffic of the bandwidth-bound decode step.
+    kv_cache_dtype: str = "bfloat16"  # bfloat16 | int8
     quantization: Optional[str] = None
     disable_qwen3_thinking: bool = True
     attention_impl: str = "auto"  # auto | pallas | xla

@@ -93,9 +93,29 @@ class JaxEngine(InferenceEngine):
             )
         else:
             self.attention_impl = config.attention_impl
-        self.decode_attention_impl = (
-            "xla" if self.attention_impl == "pallas" else self.attention_impl
-        )
+        # Decode runs the dedicated cache-streaming kernel on TPU (it
+        # also handles int8 KV in-kernel); elsewhere the einsum path.
+        self.decode_attention_impl = self.attention_impl
+        if config.kv_cache_dtype not in ("bfloat16", "int8"):
+            raise ValueError(
+                f"kv_cache_dtype={config.kv_cache_dtype!r}: expected "
+                "'bfloat16' or 'int8'"
+            )
+        self.kv_quantized = config.kv_cache_dtype == "int8"
+        if self.kv_quantized and (
+            self.decode_attention_impl != "pallas"
+            or jax.default_backend() != "tpu"
+            or self.spec.head_dim % 128 != 0
+        ):
+            import warnings
+
+            warnings.warn(
+                "int8 KV cache without the Pallas decode kernel (non-TPU "
+                "backend, attention_impl != pallas, or head_dim not a "
+                "multiple of 128): the fallback dequantizes the whole "
+                "cache per step, which is SLOWER than bfloat16",
+                stacklevel=2,
+            )
         self.max_model_len = config.max_model_len
 
         if params is not None:
@@ -306,7 +326,9 @@ class JaxEngine(InferenceEngine):
         tokens, valid, L = self._prepare_batch(full_prompts, max_new)
 
         t0 = time.perf_counter()
-        cache = init_kv_cache(self.spec, B, L + max_new + 1)
+        cache = init_kv_cache(
+            self.spec, B, L + max_new + 1, quantized=self.kv_quantized
+        )
         first_logits, cache = self._prefill(
             self.params, tokens=jnp.asarray(tokens), valid=jnp.asarray(valid),
             cache=cache,

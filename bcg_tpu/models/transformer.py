@@ -137,6 +137,49 @@ def attention(q, k, v, mask, scale, impl: str = "xla"):
 
 # ------------------------------------------------------------------ forward
 
+def _write_cache(entry: Dict, k, v, pos) -> Dict:
+    """Write fresh k/v into the cache entry (quantizing if it is int8)."""
+    new = dict(entry)
+    if "k_scale" in entry:
+        from bcg_tpu.ops.decode_attention import quantize_kv
+
+        kq, ksc = quantize_kv(k)
+        vq, vsc = quantize_kv(v)
+        new["k"] = jax.lax.dynamic_update_slice(entry["k"], kq, (0, pos, 0, 0))
+        new["v"] = jax.lax.dynamic_update_slice(entry["v"], vq, (0, pos, 0, 0))
+        new["k_scale"] = jax.lax.dynamic_update_slice(entry["k_scale"], ksc, (0, pos, 0))
+        new["v_scale"] = jax.lax.dynamic_update_slice(entry["v_scale"], vsc, (0, pos, 0))
+    else:
+        new["k"] = jax.lax.dynamic_update_slice(entry["k"], k.astype(entry["k"].dtype), (0, pos, 0, 0))
+        new["v"] = jax.lax.dynamic_update_slice(entry["v"], v.astype(entry["v"].dtype), (0, pos, 0, 0))
+    return new
+
+
+def _cache_attention(q, entry: Dict, mask, scale, impl: str):
+    """Decode-step attention over the (possibly int8) cache.
+
+    q: [B, 1, H, Dh]; mask: [B, S] attendable slots.  The Pallas decode
+    kernel streams the cache once and dequantizes in VMEM; off-TPU (or
+    non-lane-aligned head dims) falls back to dequantize + stock einsum.
+    """
+    quantized = "k_scale" in entry
+    Dh = q.shape[-1]
+    if impl == "pallas" and jax.default_backend() == "tpu" and Dh % 128 == 0:
+        from bcg_tpu.ops.decode_attention import decode_attention
+
+        return decode_attention(
+            q[:, 0], entry["k"], entry["v"], mask, scale,
+            k_scale=entry.get("k_scale"), v_scale=entry.get("v_scale"),
+        )[:, None]
+    k, v = entry["k"], entry["v"]
+    if quantized:
+        from bcg_tpu.ops.decode_attention import dequantize_kv
+
+        k = dequantize_kv(k, entry["k_scale"]).astype(q.dtype)
+        v = dequantize_kv(v, entry["v_scale"]).astype(q.dtype)
+    return _xla_attention(q, k, v, mask[:, None, :], scale)
+
+
 def _block(
     layer: Dict,
     spec: ModelSpec,
@@ -144,11 +187,11 @@ def _block(
     cos: jax.Array,
     sin: jax.Array,
     kv_write_pos: jax.Array,   # scalar: where in the cache to write
-    k_cache: jax.Array,        # [B, S, Hkv, Dh]
-    v_cache: jax.Array,
-    attn_mask: jax.Array,      # [B, T, S] over the cache
+    cache_entry: Dict,         # {k, v[, k_scale, v_scale]}, [B, S, ...]
+    attn_mask: jax.Array,      # prefill: [B, T, T] over the chunk;
+                               # decode (T == 1): [B, S] over the cache
     impl: str,
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
+) -> Tuple[jax.Array, Dict]:
     B, T, D = x.shape
     h = rms_norm(x, layer["attn_norm"], spec.rms_eps)
     q = (h @ layer["wq"]).reshape(B, T, spec.num_heads, spec.head_dim)
@@ -160,17 +203,22 @@ def _block(
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
 
-    k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, kv_write_pos, 0, 0))
-    v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, kv_write_pos, 0, 0))
+    new_entry = _write_cache(cache_entry, k, v, kv_write_pos)
 
     scale = 1.0 / math.sqrt(spec.head_dim)
-    attn_out = attention(q, k_cache, v_cache, attn_mask, scale, impl)
+    if T > 1:
+        # Prefill attends over the FRESH bf16 chunk (nothing earlier is
+        # in the cache), so prefill cost is O(L^2) not O(L*S_cache) and
+        # is unaffected by cache quantization.
+        attn_out = attention(q, k, v, attn_mask, scale, impl)
+    else:
+        attn_out = _cache_attention(q, new_entry, attn_mask, scale, impl)
     x = x + attn_out.reshape(B, T, spec.q_size) @ layer["wo"]
 
     h = rms_norm(x, layer["mlp_norm"], spec.rms_eps)
     gate = jax.nn.silu(h @ layer["w_gate"])
     x = x + (gate * (h @ layer["w_up"])) @ layer["w_down"]
-    return x, k_cache, v_cache
+    return x, new_entry
 
 
 def _logits(params: TransformerParams, spec: ModelSpec, x: jax.Array) -> jax.Array:
@@ -179,8 +227,16 @@ def _logits(params: TransformerParams, spec: ModelSpec, x: jax.Array) -> jax.Arr
     return (h @ head).astype(jnp.float32)
 
 
-def init_kv_cache(spec: ModelSpec, batch: int, max_len: int, dtype=jnp.bfloat16):
-    """Per-layer list of {k, v} leaves ([B, S, Hkv, Dh] each).
+def init_kv_cache(
+    spec: ModelSpec, batch: int, max_len: int, dtype=jnp.bfloat16,
+    quantized: bool = False,
+):
+    """Per-layer list of {k, v[, k_scale, v_scale]} leaves.
+
+    k/v are [B, S, Hkv, Dh]; with ``quantized`` they are int8 with f32
+    per-(position, kv-head) absmax scales [B, S, Hkv] — halving the
+    HBM traffic of the bandwidth-bound decode step (the Pallas decode
+    kernel dequantizes in VMEM; see ops/decode_attention.py).
 
     Kept as separate pytree leaves (not one stacked array) so the
     ``dynamic_update_slice`` in each decode step is a pure per-buffer
@@ -188,10 +244,18 @@ def init_kv_cache(spec: ModelSpec, batch: int, max_len: int, dtype=jnp.bfloat16)
     layout would force a gather + restack copy of the whole cache every
     token."""
     shape = (batch, max_len, spec.num_kv_heads, spec.head_dim)
-    return [
-        {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
-        for _ in range(spec.num_layers)
-    ]
+    layers = []
+    for _ in range(spec.num_layers):
+        if quantized:
+            layers.append({
+                "k": jnp.zeros(shape, jnp.int8),
+                "v": jnp.zeros(shape, jnp.int8),
+                "k_scale": jnp.ones(shape[:3], jnp.float32),
+                "v_scale": jnp.ones(shape[:3], jnp.float32),
+            })
+        else:
+            layers.append({"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)})
+    return layers
 
 
 def prefill(
@@ -208,24 +272,23 @@ def prefill(
     sequence starting at 0; pads are masked out of attention entirely.
     """
     B, L = tokens.shape
-    S = cache[0]["k"].shape[1]
     positions = jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1
     positions = jnp.maximum(positions, 0)
     cos, sin = rope_table(positions, spec.head_dim, spec.rope_theta)
 
     causal = jnp.tril(jnp.ones((L, L), bool))
-    mask_ll = causal[None] & valid[:, None, :] & valid[:, :, None]  # [B, L, L]
-    # Mask over the full cache length S (beyond L nothing is valid yet).
-    attn_mask = jnp.zeros((B, L, S), bool).at[:, :, :L].set(mask_ll)
+    # Prefill attends over the fresh [B, L] chunk only — nothing beyond L
+    # is in the cache yet, so no padded-cache slots are ever touched.
+    attn_mask = causal[None] & valid[:, None, :] & valid[:, :, None]  # [B, L, L]
 
     x = params["embed"][tokens]
     new_cache = []
     for layer_idx, layer in enumerate(params["layers"]):
-        x, k_l, v_l = _block(
+        x, entry = _block(
             layer, spec, x, cos, sin, jnp.int32(0),
-            cache[layer_idx]["k"], cache[layer_idx]["v"], attn_mask, impl,
+            cache[layer_idx], attn_mask, impl,
         )
-        new_cache.append({"k": k_l, "v": v_l})
+        new_cache.append(entry)
     logits = _logits(params, spec, x[:, -1:, :])[:, 0, :]  # [B, V]
     return logits, new_cache
 
@@ -244,15 +307,14 @@ def decode_step(
     B = token.shape[0]
     cos, sin = rope_table(seq_positions[:, None], spec.head_dim, spec.rope_theta)
     x = params["embed"][token][:, None, :]  # [B, 1, D]
-    attn_mask = valid_mask[:, None, :]      # [B, 1, S]
 
     new_cache = []
     for layer_idx, layer in enumerate(params["layers"]):
-        x, k_l, v_l = _block(
+        x, entry = _block(
             layer, spec, x, cos, sin, write_pos,
-            cache[layer_idx]["k"], cache[layer_idx]["v"], attn_mask, impl,
+            cache[layer_idx], valid_mask, impl,
         )
-        new_cache.append({"k": k_l, "v": v_l})
+        new_cache.append(entry)
     logits = _logits(params, spec, x)[:, 0, :]
     return logits, new_cache
 

@@ -1,0 +1,157 @@
+"""Pallas decode-step attention (T = 1) with optional int8 KV cache.
+
+Decode reads the whole KV cache every step — it is HBM-bandwidth-bound
+(the reference's vLLM leans on FlashAttention/xFORMERS CUDA paged
+kernels for the same reason, ``vllm_agent.py:34-55``).  This kernel:
+
+* streams K/V blocks once from HBM, online-softmax accumulation in VMEM
+  (the stock einsum path materializes f32 scores and re-reads V);
+* optionally reads **int8** K/V with per-(position, kv-head) scales and
+  dequantizes in VMEM — halving the dominant HBM traffic with no
+  full-precision cache copy ever materialized;
+* is GQA-native: grid over (batch, kv-head), each program computing all
+  ``group`` query heads of that kv head at once (an [group, Dh] MXU tile
+  instead of ``group`` separate vector products).
+
+Layouts: q [B, H, Dh]; k/v [B, S, Hkv, Dh] (cache layout, any dtype);
+scales [B, S, Hkv] when quantized; mask [B, S] bool (attendable slots).
+Returns [B, H, Dh] in q's dtype.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _decode_kernel(
+    q_ref, k_ref, v_ref, ks_ref, vs_ref, mask_ref, o_ref,
+    m_scr, l_scr, acc_scr, *, scale, num_s_blocks, quantized,
+):
+    s = pl.program_id(2)
+
+    @pl.when(s == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0, 0]                          # [group, Dh]
+    k = k_ref[0, :, 0, :]                    # [Sblk, Dh]
+    v = v_ref[0, :, 0, :]
+    mask = mask_ref[0]                       # [1, Sblk] bool
+
+    if quantized:
+        k = k.astype(jnp.float32) * ks_ref[0, :, 0][:, None]
+        v = v.astype(jnp.float32) * vs_ref[0, :, 0][:, None]
+    k = k.astype(q.dtype)
+    v = v.astype(q.dtype)
+
+    scores = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale                                # [group, Sblk]
+    scores = jnp.where(mask, scores, _NEG_INF)
+
+    m_prev = m_scr[...]                      # [group, 1]
+    m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(scores - m_new) * mask.astype(jnp.float32)
+
+    m_scr[...] = m_new
+    l_scr[...] = alpha * l_scr[...] + jnp.sum(p, axis=-1, keepdims=True)
+    acc_scr[...] = alpha * acc_scr[...] + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(s == num_s_blocks - 1)
+    def _finish():
+        l = l_scr[...]
+        o_ref[0, 0] = (acc_scr[...] / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
+
+
+def _pad_s(x, block_s, axis=1, value=0):
+    pad = (-x.shape[axis]) % block_s
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths, constant_values=value)
+
+
+def decode_attention(
+    q, k, v, mask, scale,
+    k_scale=None, v_scale=None,
+    block_s: int = 512,
+    interpret: bool = False,
+):
+    """q [B, H, Dh], k/v [B, S, Hkv, Dh], mask [B, S] -> [B, H, Dh]."""
+    B, H, Dh = q.shape
+    S, Hkv = k.shape[1], k.shape[2]
+    group = H // Hkv
+    quantized = k_scale is not None
+
+    kp = _pad_s(k, block_s)
+    vp = _pad_s(v, block_s)
+    mp = _pad_s(mask, block_s, axis=1)[:, None, :]  # [B, 1, S]
+    if quantized:
+        ksp = _pad_s(k_scale, block_s)
+        vsp = _pad_s(v_scale, block_s)
+    else:  # dummy 1-wide operands so the kernel signature is stable
+        ksp = jnp.ones((B, kp.shape[1], Hkv), jnp.float32)
+        vsp = ksp
+    Sp = kp.shape[1]
+    nS = Sp // block_s
+
+    qg = q.reshape(B, Hkv, group, Dh)
+
+    kernel = functools.partial(
+        _decode_kernel, scale=scale, num_s_blocks=nS, quantized=quantized
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(B, Hkv, nS),
+        in_specs=[
+            pl.BlockSpec((1, 1, group, Dh), lambda b, h, s: (b, h, 0, 0)),
+            pl.BlockSpec((1, block_s, 1, Dh), lambda b, h, s: (b, s, h, 0)),
+            pl.BlockSpec((1, block_s, 1, Dh), lambda b, h, s: (b, s, h, 0)),
+            pl.BlockSpec((1, block_s, 1), lambda b, h, s: (b, s, h)),
+            pl.BlockSpec((1, block_s, 1), lambda b, h, s: (b, s, h)),
+            pl.BlockSpec((1, 1, block_s), lambda b, h, s: (b, 0, s)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, group, Dh), lambda b, h, s: (b, h, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, group, Dh), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, 1), jnp.float32),
+            pltpu.VMEM((group, Dh), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qg, kp, vp, ksp, vsp, mp)
+    return out.reshape(B, H, Dh)
+
+
+# ----------------------------------------------------------- kv quantization
+
+def quantize_kv(x, axis=-1):
+    """bf16/f32 [..., Dh] -> (int8 values, f32 per-row scale).
+
+    Symmetric absmax over the head dim: scale[..., 1] = absmax / 127.
+    """
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis, keepdims=True)
+    scale = jnp.where(absmax == 0.0, 1.0, absmax / 127.0)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127).astype(jnp.int8)
+    return q, scale.squeeze(axis)
+
+
+def dequantize_kv(q, scale, axis=-1):
+    return q.astype(jnp.float32) * jnp.expand_dims(scale, axis)

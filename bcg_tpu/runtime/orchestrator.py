@@ -535,7 +535,7 @@ class BCGSimulation:
         import jax.numpy as jnp
         import numpy as np
 
-        from bcg_tpu.comm.a2a_sim import REASONING_CHAR_LIMIT
+        from bcg_tpu.comm.a2a_sim import truncate_reasoning
         from bcg_tpu.parallel.game_step import exchange_values
         from bcg_tpu.parallel.mesh import build_mesh
 
@@ -570,14 +570,10 @@ class BCGSimulation:
             exchange_values(encoded, self._spmd_mask, self._spmd_mesh)
         )
 
-        def _cap(text):  # A2AMessage.__post_init__ truncation, verbatim
-            if len(text) > REASONING_CHAR_LIMIT:
-                return text[: REASONING_CHAR_LIMIT - 3] + "..."
-            return text
-
         reasonings = {
-            aid: _cap(agent.last_reasoning
-                      or f"Proposing value: {self.game.agents[aid].proposed_value}")
+            aid: truncate_reasoning(
+                agent.last_reasoning
+                or f"Proposing value: {self.game.agents[aid].proposed_value}")
             for aid, agent in self.agents.items()
         }
         mask_np = self._spmd_mask_np
