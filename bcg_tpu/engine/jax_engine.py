@@ -93,27 +93,36 @@ class JaxEngine(InferenceEngine):
             )
         else:
             self.attention_impl = config.attention_impl
-        # Decode runs the dedicated cache-streaming kernel on TPU (it
-        # also handles int8 KV in-kernel); elsewhere the einsum path.
-        self.decode_attention_impl = self.attention_impl
         if config.kv_cache_dtype not in ("bfloat16", "int8"):
             raise ValueError(
                 f"kv_cache_dtype={config.kv_cache_dtype!r}: expected "
                 "'bfloat16' or 'int8'"
             )
         self.kv_quantized = config.kv_cache_dtype == "int8"
-        if self.kv_quantized and (
-            self.decode_attention_impl != "pallas"
-            or jax.default_backend() != "tpu"
-            or self.spec.head_dim % 128 != 0
-        ):
+        # Decode impl: the bf16 einsum path is a well-fused GEMV and the
+        # hardware-validated default; the Pallas cache-streaming kernel
+        # is used when int8 KV needs its in-VMEM dequant (and can be
+        # forced for bf16 via attention_impl="pallas" explicitly, i.e.
+        # not through "auto").
+        on_tpu_aligned = (
+            jax.default_backend() == "tpu" and self.spec.head_dim % 128 == 0
+        )
+        if self.kv_quantized and on_tpu_aligned:
+            self.decode_attention_impl = "pallas"
+        elif config.attention_impl == "pallas" and on_tpu_aligned:
+            self.decode_attention_impl = "pallas"
+        else:
+            self.decode_attention_impl = (
+                "xla" if self.attention_impl == "pallas" else self.attention_impl
+            )
+        if self.kv_quantized and self.decode_attention_impl != "pallas":
             import warnings
 
             warnings.warn(
                 "int8 KV cache without the Pallas decode kernel (non-TPU "
-                "backend, attention_impl != pallas, or head_dim not a "
-                "multiple of 128): the fallback dequantizes the whole "
-                "cache per step, which is SLOWER than bfloat16",
+                "backend or head_dim not a multiple of 128): the fallback "
+                "dequantizes the whole cache per step, which is SLOWER "
+                "than bfloat16",
                 stacklevel=2,
             )
         self.max_model_len = config.max_model_len

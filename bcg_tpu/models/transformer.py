@@ -143,12 +143,14 @@ def _write_cache(entry: Dict, k, v, pos) -> Dict:
     if "k_scale" in entry:
         from bcg_tpu.ops.decode_attention import quantize_kv
 
-        kq, ksc = quantize_kv(k)
+        kq, ksc = quantize_kv(k)   # ksc: [B, T, Hkv]
         vq, vsc = quantize_kv(v)
         new["k"] = jax.lax.dynamic_update_slice(entry["k"], kq, (0, pos, 0, 0))
         new["v"] = jax.lax.dynamic_update_slice(entry["v"], vq, (0, pos, 0, 0))
-        new["k_scale"] = jax.lax.dynamic_update_slice(entry["k_scale"], ksc, (0, pos, 0))
-        new["v_scale"] = jax.lax.dynamic_update_slice(entry["v_scale"], vsc, (0, pos, 0))
+        new["k_scale"] = jax.lax.dynamic_update_slice(
+            entry["k_scale"], ksc.transpose(0, 2, 1), (0, 0, pos))
+        new["v_scale"] = jax.lax.dynamic_update_slice(
+            entry["v_scale"], vsc.transpose(0, 2, 1), (0, 0, pos))
     else:
         new["k"] = jax.lax.dynamic_update_slice(entry["k"], k.astype(entry["k"].dtype), (0, pos, 0, 0))
         new["v"] = jax.lax.dynamic_update_slice(entry["v"], v.astype(entry["v"].dtype), (0, pos, 0, 0))
@@ -175,8 +177,10 @@ def _cache_attention(q, entry: Dict, mask, scale, impl: str):
     if quantized:
         from bcg_tpu.ops.decode_attention import dequantize_kv
 
-        k = dequantize_kv(k, entry["k_scale"]).astype(q.dtype)
-        v = dequantize_kv(v, entry["v_scale"]).astype(q.dtype)
+        # Scales are cached [B, Hkv, S]; the (slow-path) full dequant
+        # wants [B, S, Hkv] to broadcast against [B, S, Hkv, Dh].
+        k = dequantize_kv(k, entry["k_scale"].transpose(0, 2, 1)).astype(q.dtype)
+        v = dequantize_kv(v, entry["v_scale"].transpose(0, 2, 1)).astype(q.dtype)
     return _xla_attention(q, k, v, mask[:, None, :], scale)
 
 
@@ -234,8 +238,9 @@ def init_kv_cache(
     """Per-layer list of {k, v[, k_scale, v_scale]} leaves.
 
     k/v are [B, S, Hkv, Dh]; with ``quantized`` they are int8 with f32
-    per-(position, kv-head) absmax scales [B, S, Hkv] — halving the
-    HBM traffic of the bandwidth-bound decode step (the Pallas decode
+    per-(position, kv-head) absmax scales stored [B, Hkv, S] (S minor —
+    the lane-aligned layout the Pallas decode kernel consumes directly) —
+    halving the HBM traffic of the bandwidth-bound decode step (the
     kernel dequantizes in VMEM; see ops/decode_attention.py).
 
     Kept as separate pytree leaves (not one stacked array) so the
@@ -247,11 +252,12 @@ def init_kv_cache(
     layers = []
     for _ in range(spec.num_layers):
         if quantized:
+            scale_shape = (batch, spec.num_kv_heads, max_len)
             layers.append({
                 "k": jnp.zeros(shape, jnp.int8),
                 "v": jnp.zeros(shape, jnp.int8),
-                "k_scale": jnp.ones(shape[:3], jnp.float32),
-                "v_scale": jnp.ones(shape[:3], jnp.float32),
+                "k_scale": jnp.ones(scale_shape, jnp.float32),
+                "v_scale": jnp.ones(scale_shape, jnp.float32),
             })
         else:
             layers.append({"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)})

@@ -14,7 +14,9 @@ kernels for the same reason, ``vllm_agent.py:34-55``).  This kernel:
   instead of ``group`` separate vector products).
 
 Layouts: q [B, H, Dh]; k/v [B, S, Hkv, Dh] (cache layout, any dtype);
-scales [B, S, Hkv] when quantized; mask [B, S] bool (attendable slots).
+scales [B, Hkv, S] when quantized (S minor-most: that is both the
+lane-aligned Mosaic layout and what the cache stores, so no per-step
+transpose ever happens); mask [B, S] bool (attendable slots).
 Returns [B, H, Dh] in q's dtype.
 """
 
@@ -48,8 +50,8 @@ def _decode_kernel(
     mask = mask_ref[0]                       # [1, Sblk] bool
 
     if quantized:
-        k = k.astype(jnp.float32) * ks_ref[0, :, 0][:, None]
-        v = v.astype(jnp.float32) * vs_ref[0, :, 0][:, None]
+        k = k.astype(jnp.float32) * ks_ref[0, 0][:, None]
+        v = v.astype(jnp.float32) * vs_ref[0, 0][:, None]
     k = k.astype(q.dtype)
     v = v.astype(q.dtype)
 
@@ -100,11 +102,13 @@ def decode_attention(
     kp = _pad_s(k, block_s)
     vp = _pad_s(v, block_s)
     mp = _pad_s(mask, block_s, axis=1)[:, None, :]  # [B, 1, S]
+    # Scales arrive [B, Hkv, S] (cache layout): S minor-most keeps the
+    # Mosaic block (1, 1, block_s) lane-aligned with no copy here.
     if quantized:
-        ksp = _pad_s(k_scale, block_s)
-        vsp = _pad_s(v_scale, block_s)
-    else:  # dummy 1-wide operands so the kernel signature is stable
-        ksp = jnp.ones((B, kp.shape[1], Hkv), jnp.float32)
+        ksp = _pad_s(k_scale, block_s, axis=2)
+        vsp = _pad_s(v_scale, block_s, axis=2)
+    else:  # dummy operands so the kernel signature is stable
+        ksp = jnp.ones((B, Hkv, kp.shape[1]), jnp.float32)
         vsp = ksp
     Sp = kp.shape[1]
     nS = Sp // block_s
@@ -121,8 +125,8 @@ def decode_attention(
             pl.BlockSpec((1, 1, group, Dh), lambda b, h, s: (b, h, 0, 0)),
             pl.BlockSpec((1, block_s, 1, Dh), lambda b, h, s: (b, s, h, 0)),
             pl.BlockSpec((1, block_s, 1, Dh), lambda b, h, s: (b, s, h, 0)),
-            pl.BlockSpec((1, block_s, 1), lambda b, h, s: (b, s, h)),
-            pl.BlockSpec((1, block_s, 1), lambda b, h, s: (b, s, h)),
+            pl.BlockSpec((1, 1, block_s), lambda b, h, s: (b, h, s)),
+            pl.BlockSpec((1, 1, block_s), lambda b, h, s: (b, h, s)),
             pl.BlockSpec((1, 1, block_s), lambda b, h, s: (b, 0, s)),
         ],
         out_specs=pl.BlockSpec((1, 1, group, Dh), lambda b, h, s: (b, h, 0, 0)),

@@ -19,7 +19,9 @@ class RunLogger:
     def __init__(
         self, log_path: Optional[str] = None, verbose: bool = False, mode: str = "w"
     ):
-        self.verbose = verbose
+        # VERBOSE=1 env forces verbosity (reference convention:
+        # vllm_agent.py:31, byzantine_consensus.py:17, main.py:1108).
+        self.verbose = verbose or os.environ.get("VERBOSE", "") == "1"
         self.log_path = log_path
         self._fh: Optional[IO] = None
         if log_path:

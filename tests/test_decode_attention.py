@@ -50,7 +50,10 @@ def test_int8_kv_close_to_fp():
     ref = _reference(q, k, v, mask, scale)
     kq, ks = quantize_kv(k)
     vq, vs = quantize_kv(v)
-    out = decode_attention(q, kq, vq, mask, scale, k_scale=ks, v_scale=vs,
+    # decode_attention consumes the cache's [B, Hkv, S] scale layout
+    out = decode_attention(q, kq, vq, mask, scale,
+                           k_scale=ks.transpose(0, 2, 1),
+                           v_scale=vs.transpose(0, 2, 1),
                            block_s=128, interpret=True)
     # int8 with per-(token, head) scales: ~1% relative error budget
     err = np.abs(np.asarray(out) - np.asarray(ref)).max()
