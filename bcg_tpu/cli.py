@@ -55,6 +55,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-every-round", action="store_true", help="Write a resumable checkpoint after each round")
     p.add_argument("--resume", type=str, default=None, help="Resume from checkpoint file")
     p.add_argument("--tensor-parallel", type=int, default=None, help="TP mesh axis size")
+    p.add_argument("--quantization", type=str, default=None, choices=["int8"],
+                   help="Weight quantization: int8 = dynamic W8A8 (halves decode weight traffic)")
+    p.add_argument("--kv-cache-dtype", type=str, default=None, choices=["bfloat16", "int8"],
+                   help="KV cache storage dtype (int8 halves decode cache traffic)")
     return p
 
 
@@ -89,6 +93,10 @@ def config_from_args(args) -> BCGConfig:
         engine = dataclasses.replace(engine, model_name=resolve_model_name(args.model))
     if args.tensor_parallel:
         engine = dataclasses.replace(engine, tensor_parallel_size=args.tensor_parallel)
+    if args.quantization:
+        engine = dataclasses.replace(engine, quantization=args.quantization)
+    if args.kv_cache_dtype:
+        engine = dataclasses.replace(engine, kv_cache_dtype=args.kv_cache_dtype)
     network = base.network
     if args.topology:
         network = dataclasses.replace(network, topology_type=args.topology)

@@ -98,6 +98,10 @@ class JaxEngine(InferenceEngine):
                 f"kv_cache_dtype={config.kv_cache_dtype!r}: expected "
                 "'bfloat16' or 'int8'"
             )
+        if config.quantization not in (None, "int8"):
+            raise ValueError(
+                f"quantization={config.quantization!r}: expected None or 'int8'"
+            )
         self.kv_quantized = config.kv_cache_dtype == "int8"
         # Decode impl: the bf16 einsum path is a well-fused GEMV and the
         # hardware-validated default; the Pallas cache-streaming kernel
@@ -136,6 +140,16 @@ class JaxEngine(InferenceEngine):
             from bcg_tpu.models.loader import load_checkpoint_params
 
             self.params = load_checkpoint_params(self.spec, config.model_name, mesh=mesh)
+
+        if config.quantization == "int8":
+            from bcg_tpu.models.quantize import is_quantized, quantize_params
+
+            # Quantize BEFORE sharding so the int8 tensors (not the bf16
+            # originals) are what gets laid out over the mesh.  Constructor-
+            # supplied params may already be quantized (weight sharing
+            # between engines) — don't quantize twice.
+            if not is_quantized(self.params["layers"][0]["wq"]):
+                self.params = quantize_params(self.params, self.spec)
 
         if mesh is not None:
             from bcg_tpu.parallel.sharding import shard_params

@@ -1,0 +1,98 @@
+"""int8 weight quantization (W8A8) for the bandwidth-bound decode path.
+
+Autoregressive decode reads every weight byte once per token, so on TPU it
+is HBM-bandwidth-bound; storing the dense weights as int8 with per-output-
+channel absmax scales halves that traffic, and the MXU multiplies int8 at
+twice the bf16 rate.  Activations are quantized dynamically per token
+(per-row absmax) right before each matmul, the matmul runs int8 x int8 ->
+int32 on the MXU, and the result is rescaled in f32 — the standard
+"dynamic W8A8" serving recipe.
+
+This replaces the role of vLLM's quantization support in the reference's
+engine layer (``quantization`` knob in `EngineConfig`; the reference
+passes its engine config straight to vLLM, vllm_agent.py:100-157).
+Enable with ``EngineConfig(quantization="int8")`` / ``--quantization int8``.
+
+Scope: the seven dense matmuls per block plus the LM head.  Embedding
+lookups stay bf16 (gathers, not matmuls); for tied-embedding models a
+separate quantized head copy is materialized so the [D, V] projection —
+the single largest weight in small-vocab-heavy models — still benefits.
+Norm vectors stay bf16.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Union
+
+import jax
+import jax.numpy as jnp
+
+from bcg_tpu.models.configs import ModelSpec
+
+# A quantized dense weight is a dict {"q": int8 [in, out], "scale": f32 [out]}.
+QuantizedDense = Dict[str, jax.Array]
+DenseWeight = Union[jax.Array, QuantizedDense]
+
+_QUANT_LEAVES = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def quantize_weight(w: jax.Array) -> QuantizedDense:
+    """[in, out] bf16/f32 -> int8 + per-output-channel f32 absmax scale."""
+    w32 = w.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(w32), axis=0)
+    scale = jnp.maximum(absmax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return {"q": q, "scale": scale}
+
+
+def is_quantized(w: DenseWeight) -> bool:
+    return isinstance(w, dict)
+
+
+def dense(x: jax.Array, w: DenseWeight, out_dtype=None) -> jax.Array:
+    """``x @ w`` where ``w`` is bf16 or a quantized dict.
+
+    Quantized path: per-token (last-axis) dynamic absmax activation quant,
+    int8 x int8 -> int32 dot on the MXU, f32 rescale cast to ``out_dtype``
+    (default ``x.dtype``; pass f32 on the logits path to keep the full
+    accumulator precision instead of bouncing through bf16).
+    """
+    if out_dtype is None:
+        out_dtype = x.dtype
+    if not is_quantized(w):
+        return (x @ w).astype(out_dtype)
+    x32 = x.astype(jnp.float32)
+    a_absmax = jnp.max(jnp.abs(x32), axis=-1, keepdims=True)
+    a_scale = jnp.maximum(a_absmax, 1e-12) / 127.0
+    xq = jnp.clip(jnp.round(x32 / a_scale), -127, 127).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        xq, w["q"],
+        dimension_numbers=(((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return (acc.astype(jnp.float32) * a_scale * w["scale"]).astype(out_dtype)
+
+
+def quantize_params(params: Dict, spec: ModelSpec) -> Dict:
+    """Quantize every dense matmul weight of a transformer param pytree.
+
+    Returns a new pytree with each of ``_QUANT_LEAVES`` (per layer) and the
+    LM head replaced by ``{"q", "scale"}`` dicts.  Tied-embedding models
+    gain an explicit quantized ``lm_head`` (from ``embed.T``) so the logits
+    projection is quantized while the bf16 embedding table remains for
+    token gathers; ``transformer._logits`` prefers ``lm_head`` when
+    present, keeping the tie semantically intact.
+    """
+    out = dict(params)
+    out["layers"] = [
+        {
+            k: (quantize_weight(v) if k in _QUANT_LEAVES else v)
+            for k, v in layer.items()
+        }
+        for layer in params["layers"]
+    ]
+    if "lm_head" in params:
+        out["lm_head"] = quantize_weight(params["lm_head"])
+    elif spec.tie_embeddings:
+        out["lm_head"] = quantize_weight(params["embed"].T)
+    return out

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from bcg_tpu.models.configs import ModelSpec
+from bcg_tpu.models.quantize import dense
 
 TransformerParams = Dict  # pytree: see init_params for the layout
 
@@ -53,33 +54,33 @@ def init_params(
     """
     keys = iter(jax.random.split(key, 4 + spec.num_layers * 7))
 
-    def dense(k, shape):
+    def _init_dense(k, shape):
         fan_in = shape[0]
         return (jax.random.normal(k, shape, jnp.float32) / math.sqrt(fan_in)).astype(dtype)
 
     params: Dict = {
-        "embed": dense(next(keys), (spec.vocab_size, spec.hidden_size)),
+        "embed": _init_dense(next(keys), (spec.vocab_size, spec.hidden_size)),
         "final_norm": jnp.ones((spec.hidden_size,), dtype),
         "layers": [],
     }
     for _ in range(spec.num_layers):
         layer = {
             "attn_norm": jnp.ones((spec.hidden_size,), dtype),
-            "wq": dense(next(keys), (spec.hidden_size, spec.q_size)),
-            "wk": dense(next(keys), (spec.hidden_size, spec.kv_size)),
-            "wv": dense(next(keys), (spec.hidden_size, spec.kv_size)),
-            "wo": dense(next(keys), (spec.q_size, spec.hidden_size)),
+            "wq": _init_dense(next(keys), (spec.hidden_size, spec.q_size)),
+            "wk": _init_dense(next(keys), (spec.hidden_size, spec.kv_size)),
+            "wv": _init_dense(next(keys), (spec.hidden_size, spec.kv_size)),
+            "wo": _init_dense(next(keys), (spec.q_size, spec.hidden_size)),
             "mlp_norm": jnp.ones((spec.hidden_size,), dtype),
-            "w_gate": dense(next(keys), (spec.hidden_size, spec.intermediate_size)),
-            "w_up": dense(next(keys), (spec.hidden_size, spec.intermediate_size)),
-            "w_down": dense(next(keys), (spec.intermediate_size, spec.hidden_size)),
+            "w_gate": _init_dense(next(keys), (spec.hidden_size, spec.intermediate_size)),
+            "w_up": _init_dense(next(keys), (spec.hidden_size, spec.intermediate_size)),
+            "w_down": _init_dense(next(keys), (spec.intermediate_size, spec.hidden_size)),
         }
         if spec.qk_norm:
             layer["q_norm"] = jnp.ones((spec.head_dim,), dtype)
             layer["k_norm"] = jnp.ones((spec.head_dim,), dtype)
         params["layers"].append(layer)
     if not spec.tie_embeddings:
-        params["lm_head"] = dense(next(keys), (spec.hidden_size, spec.vocab_size))
+        params["lm_head"] = _init_dense(next(keys), (spec.hidden_size, spec.vocab_size))
     return params
 
 
@@ -198,9 +199,9 @@ def _block(
 ) -> Tuple[jax.Array, Dict]:
     B, T, D = x.shape
     h = rms_norm(x, layer["attn_norm"], spec.rms_eps)
-    q = (h @ layer["wq"]).reshape(B, T, spec.num_heads, spec.head_dim)
-    k = (h @ layer["wk"]).reshape(B, T, spec.num_kv_heads, spec.head_dim)
-    v = (h @ layer["wv"]).reshape(B, T, spec.num_kv_heads, spec.head_dim)
+    q = dense(h, layer["wq"]).reshape(B, T, spec.num_heads, spec.head_dim)
+    k = dense(h, layer["wk"]).reshape(B, T, spec.num_kv_heads, spec.head_dim)
+    v = dense(h, layer["wv"]).reshape(B, T, spec.num_kv_heads, spec.head_dim)
     if spec.qk_norm:
         q = rms_norm(q, layer["q_norm"], spec.rms_eps)
         k = rms_norm(k, layer["k_norm"], spec.rms_eps)
@@ -217,18 +218,24 @@ def _block(
         attn_out = attention(q, k, v, attn_mask, scale, impl)
     else:
         attn_out = _cache_attention(q, new_entry, attn_mask, scale, impl)
-    x = x + attn_out.reshape(B, T, spec.q_size) @ layer["wo"]
+    x = x + dense(attn_out.reshape(B, T, spec.q_size), layer["wo"])
 
     h = rms_norm(x, layer["mlp_norm"], spec.rms_eps)
-    gate = jax.nn.silu(h @ layer["w_gate"])
-    x = x + (gate * (h @ layer["w_up"])) @ layer["w_down"]
+    gate = jax.nn.silu(dense(h, layer["w_gate"]))
+    x = x + dense(gate * dense(h, layer["w_up"]), layer["w_down"])
     return x, new_entry
 
 
 def _logits(params: TransformerParams, spec: ModelSpec, x: jax.Array) -> jax.Array:
     h = rms_norm(x, params["final_norm"], spec.rms_eps)
-    head = params["embed"].T if spec.tie_embeddings else params["lm_head"]
-    return (h @ head).astype(jnp.float32)
+    # Quantized tied-embedding models carry an explicit quantized lm_head
+    # (see quantize.quantize_params), so prefer it when present; an untied
+    # model without one is a loader bug that must stay loud.
+    if "lm_head" in params:
+        return dense(h, params["lm_head"], out_dtype=jnp.float32)
+    if not spec.tie_embeddings:
+        raise KeyError(f"params for untied model {spec.name!r} lack 'lm_head'")
+    return (h @ params["embed"].T).astype(jnp.float32)
 
 
 def init_kv_cache(

@@ -43,8 +43,21 @@ _SPECS = {
 
 
 def param_sharding(logical_name: str, spec: ModelSpec, mesh: Mesh) -> NamedSharding:
-    """Sharding for a logical parameter path like ``layers.3.wq``."""
-    leaf = logical_name.split(".")[-1]
+    """Sharding for a logical parameter path like ``layers.3.wq``.
+
+    int8-quantized weights appear as ``...wq.q`` / ``...wq.scale`` leaves
+    (models/quantize.py): ``q`` shards exactly like the parent weight;
+    ``scale`` is per-OUTPUT-channel, so it follows the output dim — sharded
+    over ``tp`` for column-parallel parents (wq/wk/wv/w_gate/w_up, and the
+    vocab-dim lm_head), replicated for row-parallel parents (wo/w_down,
+    whose sharded dim is the input).
+    """
+    parts = logical_name.split(".")
+    leaf = parts[-1]
+    quant_kind = None
+    if leaf in ("q", "scale") and len(parts) >= 2 and parts[-2] in _SPECS:
+        quant_kind = leaf
+        leaf = parts[-2]
     pspec = _SPECS.get(leaf, P(None))
     # Head-count must divide tp; otherwise replicate rather than crash.
     tp = mesh.shape.get("tp", 1)
@@ -52,6 +65,9 @@ def param_sharding(logical_name: str, spec: ModelSpec, mesh: Mesh) -> NamedShard
         pspec = P(None)
     if leaf in ("wk", "wv") and spec.num_kv_heads % tp != 0:
         pspec = P(None)
+    if quant_kind == "scale":
+        # Per-output-channel vector: keep the weight's OUTPUT-dim axis.
+        pspec = P(pspec[-1] if len(pspec) > 0 else None)
     return NamedSharding(mesh, pspec)
 
 
