@@ -59,6 +59,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Weight quantization: int8 = dynamic W8A8 (halves decode weight traffic)")
     p.add_argument("--kv-cache-dtype", type=str, default=None, choices=["bfloat16", "int8"],
                    help="KV cache storage dtype (int8 halves decode cache traffic)")
+    p.add_argument("--no-prefix-caching", action="store_true",
+                   help="Disable system-prompt KV prefix caching")
     return p
 
 
@@ -97,6 +99,8 @@ def config_from_args(args) -> BCGConfig:
         engine = dataclasses.replace(engine, quantization=args.quantization)
     if args.kv_cache_dtype:
         engine = dataclasses.replace(engine, kv_cache_dtype=args.kv_cache_dtype)
+    if args.no_prefix_caching:
+        engine = dataclasses.replace(engine, prefix_caching=False)
     network = base.network
     if args.topology:
         network = dataclasses.replace(network, topology_type=args.topology)

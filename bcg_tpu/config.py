@@ -80,6 +80,10 @@ class EngineConfig:
     # HBM traffic of the bandwidth-bound decode step.
     kv_cache_dtype: str = "bfloat16"  # bfloat16 | int8
     quantization: Optional[str] = None
+    # Prefill the static per-role system prompt once per run and reuse its
+    # KV across every round's calls (auto-disabled for template families
+    # whose prefix/suffix split is not a special-token boundary).
+    prefix_caching: bool = True
     disable_qwen3_thinking: bool = True
     attention_impl: str = "auto"  # auto | pallas | xla
     # Fake-backend determinism seed (ignored by the real engine).

@@ -18,21 +18,32 @@ reference's dispatch order:
 
 from __future__ import annotations
 
+from typing import Tuple
 
-def _chatml(system_prompt: str, user_prompt: str) -> str:
+
+def _chatml(system_prompt: str, user_prompt: str) -> Tuple[str, str]:
     return (
-        f"<|im_start|>system\n{system_prompt}<|im_end|>\n"
+        f"<|im_start|>system\n{system_prompt}<|im_end|>\n",
         f"<|im_start|>user\n{user_prompt}<|im_end|>\n"
-        f"<|im_start|>assistant\n"
+        f"<|im_start|>assistant\n",
     )
 
 
-def format_chat_prompt(
+def format_chat_parts(
     model_name: str,
     system_prompt: str,
     user_prompt: str,
     disable_qwen3_thinking: bool = True,
-) -> str:
+) -> Tuple[str, str]:
+    """(prefix, suffix) halves of the chat prompt; full = prefix + suffix.
+
+    The prefix covers everything through the (static, per-role) system
+    segment and the suffix everything from the user turn on, so the engine
+    can prefill the prefix once per run and reuse its KV cache across every
+    round's decision and vote calls (prefix caching — the TPU equivalent
+    of the reference's cached-system-prompt prefix-reuse design,
+    bcg_agents.py:24-27,174-177).
+    """
     m = model_name.lower()
 
     if "qwen3" in m or "qwen-3" in m:
@@ -48,13 +59,28 @@ def format_chat_prompt(
     if "llama-3" in m or "llama3" in m:
         return (
             "<|begin_of_text|><|start_header_id|>system<|end_header_id|>\n\n"
-            f"{system_prompt}<|eot_id|>"
+            f"{system_prompt}<|eot_id|>",
             "<|start_header_id|>user<|end_header_id|>\n\n"
             f"{user_prompt}<|eot_id|>"
-            "<|start_header_id|>assistant<|end_header_id|>\n\n"
+            "<|start_header_id|>assistant<|end_header_id|>\n\n",
         )
 
     if "llama" in m or "mistral" in m:
-        return f"<s>[INST] <<SYS>>\n{system_prompt}\n<</SYS>>\n\n{user_prompt} [/INST]"
+        return (
+            f"<s>[INST] <<SYS>>\n{system_prompt}\n<</SYS>>\n\n",
+            f"{user_prompt} [/INST]",
+        )
 
     return _chatml(system_prompt, user_prompt)
+
+
+def format_chat_prompt(
+    model_name: str,
+    system_prompt: str,
+    user_prompt: str,
+    disable_qwen3_thinking: bool = True,
+) -> str:
+    prefix, suffix = format_chat_parts(
+        model_name, system_prompt, user_prompt, disable_qwen3_thinking
+    )
+    return prefix + suffix

@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from bcg_tpu.engine.chat_template import format_chat_prompt
+from bcg_tpu.engine.chat_template import format_chat_parts, format_chat_prompt
 from bcg_tpu.engine.interface import InferenceEngine
 from bcg_tpu.engine.tokenizer import Tokenizer, tokenizer_for_model
 from bcg_tpu.guided.processor import GuidedBatch, compile_schema
@@ -38,6 +38,7 @@ from bcg_tpu.models.transformer import (
     init_kv_cache,
     init_params,
     prefill,
+    prefill_with_prefix,
 )
 
 # Coarse prompt-length ladder.  Every distinct (B, L) pair compiles its
@@ -46,9 +47,60 @@ from bcg_tpu.models.transformer import (
 # though prompts keep growing with game history.  A fine-grained bucket
 # (the first design used 128) recompiled nearly every round.
 _LEN_BUCKETS = (512, 1024, 2048, 4096, 6144, 8192)
+# With the system prompt served from the prefix cache, the remaining
+# per-call suffix (round prompt) is much shorter — give it a finer ladder.
+_SUFFIX_BUCKETS = (256, 512, 1024, 2048, 4096, 8192)
+# Prefix entries are per-run static (one compile each), so an even finer
+# ladder is cheap — and a tight prefix bucket matters doubly, because pad
+# slots in [0, P) are streamed by EVERY subsequent decode step.
+_PREFIX_BUCKETS = (128, 256) + _LEN_BUCKETS
 
 # BCG_TPU_TIMING=1 prints per-call prefill/decode wall times.
 _TIMING = os.environ.get("BCG_TPU_TIMING", "") not in ("", "0")
+
+_comp_cache_enabled = False
+
+
+def _enable_compilation_cache() -> None:
+    """Persist compiled XLA executables across processes.
+
+    A remote-attached TPU compile costs tens of seconds per (B, L) shape;
+    a fresh process (new bench run, new experiment in a sweep) repays it
+    all.  The JAX persistent cache makes that a one-time cost per machine.
+    Opt out with BCG_TPU_XLA_CACHE=off; override the location with
+    BCG_TPU_XLA_CACHE=<dir>.
+    """
+    global _comp_cache_enabled
+    if _comp_cache_enabled:
+        return
+    setting = os.environ.get("BCG_TPU_XLA_CACHE", "")
+    if setting.lower() in ("off", "0", "none"):
+        return
+    cache_dir = setting or os.path.join(
+        os.path.expanduser("~"), ".cache", "bcg_tpu_xla"
+    )
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        _comp_cache_enabled = True
+    except Exception:  # unsupported backend/version: run without the cache
+        pass
+
+
+def _prefix_split_safe(model_name: str) -> bool:
+    """True when the chat template's prefix/suffix split lands on a
+    special-token boundary, so encode(prefix) + encode(suffix) ==
+    encode(prefix + suffix).  ChatML prefixes end at ``<|im_end|>\\n``
+    followed by the special ``<|im_start|>``, and Llama-3 at
+    ``<|eot_id|>`` — safe.  The Mistral/Llama-2 ``[INST]`` prefix ends in
+    bare text where a BPE merge could straddle the split — not safe."""
+    m = model_name.lower()
+    if "llama-3" in m or "llama3" in m:
+        return True
+    if "llama" in m or "mistral" in m:
+        return False
+    return True  # ChatML families and the ChatML fallback
 
 
 def _pad_batch(real_B: int) -> int:
@@ -73,6 +125,7 @@ def _pad_rows(*lists):
 
 class JaxEngine(InferenceEngine):
     def __init__(self, config, mesh=None, params=None, spec: Optional[ModelSpec] = None):
+        _enable_compilation_cache()
         self.config = config
         self.spec = spec or spec_for_model(config.model_name)
         if self.spec is None:
@@ -174,9 +227,46 @@ class JaxEngine(InferenceEngine):
             partial(prefill, spec=self.spec, impl=self.attention_impl),
             donate_argnames=("cache",),
         )
+        self._prefill_suffix = jax.jit(
+            partial(prefill_with_prefix, spec=self.spec, impl=self.attention_impl),
+            donate_argnames=("cache",),
+        )
         self._decode_loops: Dict[Tuple, Any] = {}
+        # Prefix caching: the per-role system-prompt segment is static for
+        # a whole run, so its KV is prefilled once and reused by every
+        # round's decision/vote call (the reference caches the system
+        # prompt STRING for the same reason, bcg_agents.py:174-177; with
+        # an owned engine we can cache the actual KV).  Safe only when the
+        # template family ends the prefix at a special-token boundary so
+        # BPE merges cannot straddle the split.
+        self.prefix_caching = getattr(config, "prefix_caching", True)
+        self._prefix_safe = _prefix_split_safe(config.model_name)
+        self._prefix_cache: Dict[str, Dict[str, Any]] = {}
 
     # ------------------------------------------------------------- tokenizing
+
+    def _encode_leftpad(
+        self, texts: List[str], limit: int, bucket_ladder: Tuple[int, ...]
+    ) -> Tuple[np.ndarray, np.ndarray, int]:
+        """Tokenize (keeping the LAST ``limit`` tokens) and LEFT-pad into a
+        bucketed [B, L] batch.  The ladder extends by doubling past its
+        static tail so a raised max_model_len still lands on stable
+        buckets; anything beyond the last bucket uses ``limit`` itself
+        (one stable shape, not ragged)."""
+        token_lists = [self.tokenizer.encode(t)[-limit:] for t in texts]
+        max_len = max(len(t) for t in token_lists)
+        buckets = list(bucket_ladder)
+        while buckets[-1] < limit:
+            buckets.append(buckets[-1] * 2)
+        L = next((b for b in buckets if b >= max_len), limit)
+        L = max(min(L, limit), max_len)
+        B = len(token_lists)
+        tokens = np.full((B, L), self.tokenizer.pad_id, dtype=np.int32)
+        valid = np.zeros((B, L), dtype=bool)
+        for i, toks in enumerate(token_lists):
+            tokens[i, L - len(toks):] = toks
+            valid[i, L - len(toks):] = True
+        return tokens, valid, L
 
     def _prepare_batch(
         self, full_prompts: List[str], max_new: int
@@ -190,23 +280,107 @@ class JaxEngine(InferenceEngine):
                 f"max_tokens={max_new} leaves no room for a prompt within "
                 f"max_model_len={self.max_model_len}"
             )
-        token_lists = [self.tokenizer.encode(p)[-limit:] for p in full_prompts]
-        max_len = max(len(t) for t in token_lists)
-        # Ladder extends by doubling past its static tail so a raised
-        # max_model_len still lands on stable buckets; anything beyond the
-        # last bucket uses `limit` itself (one stable shape, not ragged).
-        buckets = list(_LEN_BUCKETS)
-        while buckets[-1] < limit:
-            buckets.append(buckets[-1] * 2)
-        L = next((b for b in buckets if b >= max_len), limit)
-        L = max(min(L, limit), max_len)
-        B = len(token_lists)
-        tokens = np.full((B, L), self.tokenizer.pad_id, dtype=np.int32)
-        valid = np.zeros((B, L), dtype=bool)
-        for i, toks in enumerate(token_lists):
-            tokens[i, L - len(toks):] = toks
-            valid[i, L - len(toks):] = True
-        return tokens, valid, L
+        return self._encode_leftpad(full_prompts, limit, _LEN_BUCKETS)
+
+    # --------------------------------------------------------- prefix caching
+
+    def _get_prefix_entry(self, prefix: str, limit: int) -> Optional[Dict[str, Any]]:
+        """Prefill (once) and cache the KV for a static prompt prefix.
+
+        Returns ``None`` when the prefix is too long to leave useful room
+        for a suffix — the caller then falls back to full-prompt prefill.
+        """
+        entry = self._prefix_cache.get(prefix)
+        if entry is not None:
+            return entry
+        toks = self.tokenizer.encode(prefix)
+        if not toks or len(toks) > limit - 64:
+            return None
+        buckets = [b for b in _PREFIX_BUCKETS if b <= limit]
+        Pb = next((b for b in buckets if b >= len(toks)), None)
+        if Pb is None:
+            return None
+        tokens = np.full((1, Pb), self.tokenizer.pad_id, dtype=np.int32)
+        valid = np.zeros((1, Pb), dtype=bool)
+        tokens[0, Pb - len(toks):] = toks
+        valid[0, Pb - len(toks):] = True
+        cache = init_kv_cache(self.spec, 1, Pb, quantized=self.kv_quantized)
+        _, kv = self._prefill(
+            self.params, tokens=jnp.asarray(tokens), valid=jnp.asarray(valid),
+            cache=cache,
+        )
+        if len(self._prefix_cache) >= 8:  # a run uses <=4 (2 roles x 2 phases)
+            self._prefix_cache.pop(next(iter(self._prefix_cache)))
+        entry = {"kv": kv, "valid": valid[0], "len": len(toks), "bucket": Pb}
+        self._prefix_cache[prefix] = entry
+        return entry
+
+    def _prepare_prefixed_batch(self, parts, max_new: int):
+        """Assemble a batch whose cache slots [0, P) are prefilled prefix
+        KV (gathered per row from the prefix cache) and whose suffix is
+        left-padded into [P, P+Ls).  Returns None when any prefix cannot
+        be cached (caller falls back to full-prompt prefill)."""
+        limit = self.max_model_len - max_new - 1
+        entries: Dict[str, Dict[str, Any]] = {}
+        for p, _ in parts:
+            if p not in entries:
+                e = self._get_prefix_entry(p, limit)
+                if e is None:
+                    return None
+                entries[p] = e
+        uniq = list(entries)
+        P = max(entries[p]["bucket"] for p in uniq)
+        limit_s = limit - P
+        if limit_s < 1:
+            return None
+
+        tokens, valid, Ls = self._encode_leftpad(
+            [s for _, s in parts], limit_s, _SUFFIX_BUCKETS
+        )
+        B = len(parts)
+
+        gid = np.array([uniq.index(p) for p, _ in parts], dtype=np.int32)
+        tail = Ls + max_new + 1
+
+        def stack(name, pad_axis, pad_value, tail_shape_fn):
+            """[G, ...] stacked entry arrays padded to P, gathered to [B, ...],
+            concatenated with the suffix+decode tail."""
+            arrs = []
+            for p in uniq:
+                a = entries[p]["kv"][layer_idx][name]
+                pad = P - a.shape[pad_axis]
+                if pad:
+                    widths = [(0, 0)] * a.ndim
+                    widths[pad_axis] = (0, pad)
+                    a = jnp.pad(a, widths, constant_values=pad_value)
+                arrs.append(a)
+            g = jnp.concatenate(arrs, axis=0)[gid]  # [B, ...]
+            tail_arr = (jnp.ones if pad_value == 1 else jnp.zeros)(
+                tail_shape_fn(g), g.dtype
+            )
+            return jnp.concatenate([g, tail_arr], axis=pad_axis)
+
+        cache = []
+        for layer_idx in range(self.spec.num_layers):
+            entry0 = entries[uniq[0]]["kv"][layer_idx]
+            layer = {
+                "k": stack("k", 1, 0, lambda g: (B, tail) + g.shape[2:]),
+                "v": stack("v", 1, 0, lambda g: (B, tail) + g.shape[2:]),
+            }
+            if "k_scale" in entry0:
+                layer["k_scale"] = stack(
+                    "k_scale", 2, 1, lambda g: g.shape[:2] + (tail,))
+                layer["v_scale"] = stack(
+                    "v_scale", 2, 1, lambda g: g.shape[:2] + (tail,))
+            cache.append(layer)
+
+        prefix_valid = np.zeros((B, P), dtype=bool)
+        prefix_lens = np.zeros((B,), dtype=np.int32)
+        for i, (p, _) in enumerate(parts):
+            e = entries[p]
+            prefix_valid[i, : e["bucket"]] = e["valid"]
+            prefix_lens[i] = e["len"]
+        return tokens, valid, Ls, cache, prefix_valid, prefix_lens, P
 
     # ------------------------------------------------------------ decode loop
 
@@ -322,13 +496,13 @@ class JaxEngine(InferenceEngine):
 
     def _run_guided(
         self,
-        full_prompts: List[str],
+        parts: List[Tuple[str, str]],
         schemas: List[Dict],
         temperature: float,
         max_tokens: int,
         top_p: float = 1.0,
     ) -> List[str]:
-        real_B, B, full_prompts, schemas = _pad_rows(full_prompts, schemas)
+        real_B, B, parts, schemas = _pad_rows(parts, schemas)
         guides = [
             compile_schema(s, self._token_bytes, vocab_id=self.tokenizer.vocab_id)
             for s in schemas
@@ -336,33 +510,54 @@ class JaxEngine(InferenceEngine):
         batch = GuidedBatch(guides)
         sig = (batch.num_unique, batch.tables.shape[1], batch.tables.shape[2])
         return self._decode_batch(
-            full_prompts, batch, sig, real_B, temperature, max_tokens, top_p
+            parts, batch, sig, real_B, temperature, max_tokens, top_p
         )
 
     def _decode_batch(
-        self, full_prompts, batch, sig_prefix, real_B, temperature, max_new,
+        self, parts, batch, sig_prefix, real_B, temperature, max_new,
         top_p,
     ) -> List[str]:
         """Shared prefill + guided-decode scaffolding for the guided and
-        free paths; ``full_prompts`` is already batch-padded (_pad_rows)."""
-        B = len(full_prompts)
-        tokens, valid, L = self._prepare_batch(full_prompts, max_new)
-
+        free paths; ``parts`` is a batch-padded (_pad_rows) list of
+        (prefix, suffix) prompt halves.  When every row has a cacheable
+        prefix, only the suffixes are prefilled (prefix caching);
+        otherwise the joined full prompts take the plain path."""
+        B = len(parts)
         t0 = time.perf_counter()
-        cache = init_kv_cache(
-            self.spec, B, L + max_new + 1, quantized=self.kv_quantized
-        )
-        first_logits, cache = self._prefill(
-            self.params, tokens=jnp.asarray(tokens), valid=jnp.asarray(valid),
-            cache=cache,
-        )
+        prepped = None
+        if self.prefix_caching and self._prefix_safe and all(p for p, _ in parts):
+            prepped = self._prepare_prefixed_batch(parts, max_new)
+        if prepped is not None:
+            tokens, valid, Ls, cache, prefix_valid, prefix_lens, P = prepped
+            first_logits, cache = self._prefill_suffix(
+                self.params, tokens=jnp.asarray(tokens),
+                valid=jnp.asarray(valid), cache=cache,
+                prefix_valid=jnp.asarray(prefix_valid),
+                prefix_lens=jnp.asarray(prefix_lens),
+            )
+            L = P + Ls
+            S = L + max_new + 1
+            valid_mask = np.zeros((B, S), dtype=bool)
+            valid_mask[:, :P] = prefix_valid
+            valid_mask[:, P:L] = valid
+            prompt_lens = (prefix_lens + valid.sum(axis=1)).astype(np.int32)
+        else:
+            full_prompts = [p + s for p, s in parts]
+            tokens, valid, L = self._prepare_batch(full_prompts, max_new)
+            cache = init_kv_cache(
+                self.spec, B, L + max_new + 1, quantized=self.kv_quantized
+            )
+            first_logits, cache = self._prefill(
+                self.params, tokens=jnp.asarray(tokens), valid=jnp.asarray(valid),
+                cache=cache,
+            )
+            S = L + max_new + 1
+            valid_mask = np.zeros((B, S), dtype=bool)
+            valid_mask[:, :L] = valid
+            prompt_lens = valid.sum(axis=1).astype(np.int32)
         if _TIMING:
             first_logits.block_until_ready()
         t1 = time.perf_counter()
-        S = L + max_new + 1
-        valid_mask = np.zeros((B, S), dtype=bool)
-        valid_mask[:, :L] = valid
-        prompt_lens = valid.sum(axis=1).astype(np.int32)
 
         loop = self._get_decode_loop(sig_prefix + (B, L), temperature, max_new, top_p)
         self._key, sub = jax.random.split(self._key)
@@ -399,8 +594,8 @@ class JaxEngine(InferenceEngine):
     def batch_generate_json(self, prompts, temperature=0.8, max_tokens=512):
         if not prompts:
             return []
-        full = [
-            format_chat_prompt(
+        parts = [
+            format_chat_parts(
                 self.config.model_name, system_prompt, user_prompt,
                 self.config.disable_qwen3_thinking,
             )
@@ -408,7 +603,7 @@ class JaxEngine(InferenceEngine):
         ]
         schemas = [schema for _, _, schema in prompts]
         try:
-            texts = self._run_guided(full, schemas, temperature, max_tokens)
+            texts = self._run_guided(parts, schemas, temperature, max_tokens)
         except ValueError as e:
             return [{"error": "generation_failed", "message": str(e)} for _ in prompts]
         results = []
@@ -444,10 +639,13 @@ class JaxEngine(InferenceEngine):
         return self._run_free(prompts, temperature, max_tokens, top_p)
 
     def _run_free(self, full_prompts, temperature, max_tokens, top_p=1.0):
-        real_B, B, full_prompts = _pad_rows(full_prompts)
+        # Free-form prompts arrive pre-joined (no prefix/suffix split), so
+        # they always take the full-prefill path.
+        parts = [("", p) for p in full_prompts]
+        real_B, B, parts = _pad_rows(parts)
         batch = GuidedBatch.permissive(B, self.spec.vocab_size)
         texts = self._decode_batch(
-            full_prompts, batch, ("free", 1, self.spec.vocab_size), real_B,
+            parts, batch, ("free", 1, self.spec.vocab_size), real_B,
             temperature, max_tokens, top_p,
         )
         return [t.strip() for t in texts]
@@ -455,3 +653,4 @@ class JaxEngine(InferenceEngine):
     def shutdown(self) -> None:
         self.params = None
         self._decode_loops.clear()
+        self._prefix_cache.clear()

@@ -11,6 +11,7 @@ from bcg_tpu.models.transformer import (
     TransformerParams,
     init_params,
     prefill,
+    prefill_with_prefix,
     decode_step,
 )
 
@@ -21,5 +22,6 @@ __all__ = [
     "TransformerParams",
     "init_params",
     "prefill",
+    "prefill_with_prefix",
     "decode_step",
 ]

@@ -185,6 +185,19 @@ def _cache_attention(q, entry: Dict, mask, scale, impl: str):
     return _xla_attention(q, k, v, mask[:, None, :], scale)
 
 
+def _dequant_slice(entry: Dict, name: str, upto: int, dtype) -> jax.Array:
+    """Cache slots [0, upto) of k or v, dequantized if stored int8."""
+    raw = entry[name][:, :upto]
+    scale_name = f"{name}_scale"
+    if scale_name not in entry:
+        return raw.astype(dtype)
+    from bcg_tpu.ops.decode_attention import dequantize_kv
+
+    return dequantize_kv(
+        raw, entry[scale_name][:, :, :upto].transpose(0, 2, 1)
+    ).astype(dtype)
+
+
 def _block(
     layer: Dict,
     spec: ModelSpec,
@@ -193,9 +206,11 @@ def _block(
     sin: jax.Array,
     kv_write_pos: jax.Array,   # scalar: where in the cache to write
     cache_entry: Dict,         # {k, v[, k_scale, v_scale]}, [B, S, ...]
-    attn_mask: jax.Array,      # prefill: [B, T, T] over the chunk;
+    attn_mask: jax.Array,      # prefill: [B, T, hist_len+T] over hist+chunk;
                                # decode (T == 1): [B, S] over the cache
     impl: str,
+    hist_len: int = 0,         # static: cache slots [0, hist_len) hold a
+                               # reusable prefix (prefix caching)
 ) -> Tuple[jax.Array, Dict]:
     B, T, D = x.shape
     h = rms_norm(x, layer["attn_norm"], spec.rms_eps)
@@ -211,7 +226,17 @@ def _block(
     new_entry = _write_cache(cache_entry, k, v, kv_write_pos)
 
     scale = 1.0 / math.sqrt(spec.head_dim)
-    if T > 1:
+    if T > 1 and hist_len > 0:
+        # Suffix prefill: the chunk attends over the cached prefix KV
+        # plus itself.  Prefix slots are read once per call instead of
+        # being recomputed — the point of prefix caching.
+        hk = _dequant_slice(cache_entry, "k", hist_len, q.dtype)
+        hv = _dequant_slice(cache_entry, "v", hist_len, q.dtype)
+        attn_out = attention(
+            q, jnp.concatenate([hk, k], axis=1),
+            jnp.concatenate([hv, v], axis=1), attn_mask, scale, impl,
+        )
+    elif T > 1:
         # Prefill attends over the FRESH bf16 chunk (nothing earlier is
         # in the cache), so prefill cost is O(L^2) not O(L*S_cache) and
         # is unaffected by cache quantization.
@@ -303,6 +328,46 @@ def prefill(
         )
         new_cache.append(entry)
     logits = _logits(params, spec, x[:, -1:, :])[:, 0, :]  # [B, V]
+    return logits, new_cache
+
+
+def prefill_with_prefix(
+    params: TransformerParams,
+    spec: ModelSpec,
+    tokens: jax.Array,         # [B, Ls] left-padded suffix tokens
+    valid: jax.Array,          # [B, Ls] bool, False on pads
+    cache: Dict,               # slots [0, P) already hold prefix KV
+    prefix_valid: jax.Array,   # [B, P] attendable prefix slots
+    prefix_lens: jax.Array,    # [B] valid prefix token counts (RoPE offset)
+    impl: str = "xla",
+) -> Tuple[jax.Array, Dict]:
+    """Prefill the per-call suffix against a cached prompt prefix.
+
+    Prefix caching: the static system-prompt segment is prefilled once per
+    run (slots [0, P) of the cache) and only the round-specific suffix is
+    processed here, with RoPE positions continuing where each row's prefix
+    ended.  The suffix chunk KV is written at slots [P, P+Ls).
+    """
+    B, Ls = tokens.shape
+    P = prefix_valid.shape[1]
+    positions = prefix_lens[:, None] + jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1
+    positions = jnp.maximum(positions, 0)
+    cos, sin = rope_table(positions, spec.head_dim, spec.rope_theta)
+
+    causal = jnp.tril(jnp.ones((Ls, Ls), bool))
+    chunk_mask = causal[None] & valid[:, None, :] & valid[:, :, None]   # [B, Ls, Ls]
+    hist_mask = prefix_valid[:, None, :] & valid[:, :, None]            # [B, Ls, P]
+    attn_mask = jnp.concatenate([hist_mask, chunk_mask], axis=2)        # [B, Ls, P+Ls]
+
+    x = params["embed"][tokens]
+    new_cache = []
+    for layer_idx, layer in enumerate(params["layers"]):
+        x, entry = _block(
+            layer, spec, x, cos, sin, jnp.int32(P),
+            cache[layer_idx], attn_mask, impl, hist_len=P,
+        )
+        new_cache.append(entry)
+    logits = _logits(params, spec, x[:, -1:, :])[:, 0, :]
     return logits, new_cache
 
 

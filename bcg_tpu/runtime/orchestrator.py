@@ -234,20 +234,34 @@ class BCGSimulation:
                 return self._is_valid_byzantine_decision_response(result)
             return self._is_valid_decision_response(result)
 
+        # Retries resubmit the FULL batch and harvest only the pending
+        # rows: decode is weight-bandwidth-bound, so a 3-row retry costs
+        # the same device time as the full batch — but the full batch
+        # reuses the already-compiled (B, L) decode loop, while a
+        # subset-shaped batch would pay a fresh tens-of-seconds remote
+        # compile (the reference re-batches only failures,
+        # main.py:293-341; on TPU static shapes win).
+        row_of = {aid: i for i, (aid, _) in enumerate(agent_prompts)}
         for attempt in range(1, MAX_RETRIES + 1):
             if not pending:
                 break
-            label = "[BATCHED]" if attempt == 1 else f"[RETRY {attempt}/{MAX_RETRIES}]"
-            self.logger.log(
-                f"  {label} Processing {len(pending)} agents in single LLM call..."
-            )
+            if attempt == 1:
+                self.logger.log(
+                    f"  [BATCHED] Processing {len(pending)} agents in single LLM call..."
+                )
+            else:
+                self.logger.log(
+                    f"  [RETRY {attempt}/{MAX_RETRIES}] Harvesting {len(pending)} "
+                    f"pending rows from full batch of {len(agent_prompts)}..."
+                )
             results = self.engine.batch_generate_json(
-                [p for _, p in pending],
+                [p for _, p in agent_prompts],
                 temperature=self.config.llm.temperature_decide,
                 max_tokens=self.config.llm.max_tokens_decide,
             )
             still_failed = []
-            for (aid, prompt_tuple), result in zip(pending, results):
+            for aid, prompt_tuple in pending:
+                result = results[row_of[aid]]
                 if valid(aid, result):
                     agent_results[aid] = result
                 else:
@@ -309,18 +323,28 @@ class BCGSimulation:
         agent_results: Dict[str, Optional[Dict]] = {aid: None for aid, _ in vote_prompts}
         pending = list(vote_prompts)
 
+        # Full-batch retries for shape reuse — see _run_batched_decisions.
+        row_of = {aid: i for i, (aid, _) in enumerate(vote_prompts)}
         for attempt in range(1, MAX_RETRIES + 1):
             if not pending:
                 break
-            label = "[BATCHED]" if attempt == 1 else f"[RETRY {attempt}/{MAX_RETRIES}]"
-            self.logger.log(f"  {label} Processing {len(pending)} votes in single LLM call...")
+            if attempt == 1:
+                self.logger.log(
+                    f"  [BATCHED] Processing {len(pending)} votes in single LLM call..."
+                )
+            else:
+                self.logger.log(
+                    f"  [RETRY {attempt}/{MAX_RETRIES}] Harvesting {len(pending)} "
+                    f"pending votes from full batch of {len(vote_prompts)}..."
+                )
             results = self.engine.batch_generate_json(
-                [p for _, p in pending],
+                [p for _, p in vote_prompts],
                 temperature=self.config.llm.temperature_vote,
                 max_tokens=self.config.llm.max_tokens_vote,
             )
             still_failed = []
-            for (aid, prompt_tuple), result in zip(pending, results):
+            for aid, prompt_tuple in pending:
+                result = results[row_of[aid]]
                 if self._is_valid_vote_response(self.agents[aid], result):
                     agent_results[aid] = result
                 else:

@@ -20,7 +20,9 @@ denominator is an estimate of its steady-state rate on its own config
 the absolute `value` is the number to track round over round.
 
 Env overrides: BENCH_ROUNDS (measured rounds, default 2),
-BENCH_MODEL (spec name), BENCH_BACKEND=fake for a hermetic smoke run.
+BENCH_MODEL (spec name), BENCH_BACKEND=fake for a hermetic smoke run,
+BENCH_QUANTIZATION=int8 (dynamic W8A8 weights), BENCH_KV_DTYPE=int8
+(quantized KV cache).
 """
 
 from __future__ import annotations
@@ -56,7 +58,11 @@ def main() -> None:
             max_rounds=warmup_rounds + measured_rounds + 8,
             seed=0,
         ),
-        engine=dataclasses.replace(base.engine, model_name=model, backend=backend),
+        engine=dataclasses.replace(
+            base.engine, model_name=model, backend=backend,
+            quantization=os.environ.get("BENCH_QUANTIZATION") or None,
+            kv_cache_dtype=os.environ.get("BENCH_KV_DTYPE", "bfloat16"),
+        ),
         metrics=dataclasses.replace(
             base.metrics, save_results=False, generate_plots=False
         ),
@@ -129,6 +135,8 @@ def main() -> None:
             "agents": n_agents,
             "model": model,
             "backend": backend,
+            "quantization": cfg.engine.quantization,
+            "kv_cache_dtype": cfg.engine.kv_cache_dtype,
             "platform": platform,
             "elapsed_sec": round(elapsed, 2),
             "baseline_note": "denominator is an ESTIMATED reference rate "

@@ -1,0 +1,142 @@
+"""System-prompt KV prefix caching (engine + transformer).
+
+The decisive property: prefilling a prompt in two stages — cached prefix
+KV, then the suffix via ``prefill_with_prefix`` — must reproduce the
+logits of a single full prefill (same math, different association order),
+and the engine must produce identical-quality guided JSON with the
+feature on or off.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bcg_tpu.config import EngineConfig
+from bcg_tpu.engine.chat_template import format_chat_parts, format_chat_prompt
+from bcg_tpu.engine.jax_engine import JaxEngine, _prefix_split_safe
+from bcg_tpu.models import init_params, prefill, prefill_with_prefix, spec_for_model
+from bcg_tpu.models.transformer import init_kv_cache
+
+SCHEMA = {
+    "type": "object",
+    "properties": {"decision": {"type": "string", "enum": ["stop", "continue"]}},
+    "required": ["decision"],
+    "additionalProperties": False,
+}
+
+
+class TestChatParts:
+    def test_parts_join_to_full_prompt(self):
+        for model in [
+            "Qwen/Qwen3-14B", "Qwen/Qwen3-4B-Instruct-2507", "Qwen/Qwen2.5-7B",
+            "meta-llama/Meta-Llama-3-8B-Instruct", "mistralai/Mistral-7B-Instruct",
+            "bcg-tpu/tiny-test",
+        ]:
+            prefix, suffix = format_chat_parts(model, "sys text", "user text")
+            assert prefix + suffix == format_chat_prompt(model, "sys text", "user text")
+
+    def test_split_safety_classification(self):
+        assert _prefix_split_safe("Qwen/Qwen3-14B")
+        assert _prefix_split_safe("meta-llama/Meta-Llama-3-8B-Instruct")
+        assert not _prefix_split_safe("mistralai/Mistral-Small-Instruct-2409")
+        assert _prefix_split_safe("bcg-tpu/tiny-test")
+
+
+class TestSplitPrefillMatchesFull:
+    def _run(self, quantized_kv: bool):
+        spec = spec_for_model("bcg-tpu/tiny-test")
+        params = init_params(spec, jax.random.PRNGKey(0))
+        B, P_len, S_len = 2, 6, 5
+        key = jax.random.PRNGKey(3)
+        tokens = jax.random.randint(key, (B, P_len + S_len), 0, spec.vocab_size)
+        valid = jnp.ones((B, P_len + S_len), bool)
+
+        cache_full = init_kv_cache(spec, B, P_len + S_len + 1, quantized=quantized_kv)
+        full_logits, _ = prefill(params, spec, tokens, valid, cache_full)
+
+        # Stage 1: prefix alone; stage 2: suffix against the prefix cache.
+        cache = init_kv_cache(spec, B, P_len + S_len + 1, quantized=quantized_kv)
+        _, cache = prefill(
+            params, spec, tokens[:, :P_len], valid[:, :P_len], cache
+        )
+        split_logits, _ = prefill_with_prefix(
+            params, spec, tokens[:, P_len:], valid[:, P_len:], cache,
+            prefix_valid=valid[:, :P_len],
+            prefix_lens=jnp.full((B,), P_len, jnp.int32),
+        )
+        np.testing.assert_allclose(
+            np.asarray(split_logits), np.asarray(full_logits),
+            rtol=0.08 if quantized_kv else 0.02,
+            atol=0.08 if quantized_kv else 0.02,
+        )
+
+    def test_bf16_cache(self):
+        self._run(quantized_kv=False)
+
+    def test_int8_cache(self):
+        self._run(quantized_kv=True)
+
+    def test_left_padded_prefix_rope_offset(self):
+        """Rows with different prefix lengths must get per-row RoPE offsets."""
+        spec = spec_for_model("bcg-tpu/tiny-test")
+        params = init_params(spec, jax.random.PRNGKey(0))
+        P, Ls = 8, 4
+        key = jax.random.PRNGKey(5)
+        row = jax.random.randint(key, (1, P + Ls), 0, spec.vocab_size)
+        plen = 5  # row's real prefix is 5 tokens, left-padded into 8 slots
+
+        # Reference: contiguous full prefill of the 9 real tokens.
+        cache_full = init_kv_cache(spec, 1, plen + Ls + 1)
+        full_logits, _ = prefill(
+            params, spec, row[:, P - plen:], jnp.ones((1, plen + Ls), bool),
+            cache_full,
+        )
+
+        prefix_tokens = row[:, :P]
+        prefix_valid = jnp.arange(P)[None, :] >= (P - plen)
+        cache = init_kv_cache(spec, 1, P + Ls + 1)
+        _, cache = prefill(params, spec, prefix_tokens, prefix_valid, cache)
+        split_logits, _ = prefill_with_prefix(
+            params, spec, row[:, P:], jnp.ones((1, Ls), bool), cache,
+            prefix_valid=prefix_valid,
+            prefix_lens=jnp.full((1,), plen, jnp.int32),
+        )
+        np.testing.assert_allclose(
+            np.asarray(split_logits), np.asarray(full_logits), rtol=0.02, atol=0.02
+        )
+
+
+class TestEnginePrefixCaching:
+    def test_guided_json_and_cache_population(self):
+        engine = JaxEngine(EngineConfig(
+            backend="jax", model_name="bcg-tpu/tiny-test", max_model_len=2048,
+        ))
+        prompts = [
+            ("You are honest agent", "vote now round 1", SCHEMA),
+            ("You are byzantine agent", "vote now round 1", SCHEMA),
+        ]
+        out = engine.batch_generate_json(prompts, temperature=0.0, max_tokens=24)
+        assert all(o.get("decision") in ("stop", "continue") for o in out)
+        assert len(engine._prefix_cache) == 2  # one entry per distinct system prompt
+        # Second round: same prefixes, new suffixes — entries are reused.
+        out2 = engine.batch_generate_json(
+            [(s, "vote now round 2", SCHEMA) for s, _, _ in prompts],
+            temperature=0.0, max_tokens=24,
+        )
+        assert all(o.get("decision") in ("stop", "continue") for o in out2)
+        assert len(engine._prefix_cache) == 2
+        engine.shutdown()
+
+    def test_matches_uncached_engine_greedy(self):
+        cfg = EngineConfig(backend="jax", model_name="bcg-tpu/tiny-test",
+                           max_model_len=2048)
+        on = JaxEngine(cfg)
+        off = JaxEngine(dataclasses.replace(cfg, prefix_caching=False))
+        prompts = [("system prompt here", "decide", SCHEMA)]
+        r_on = on.batch_generate_json(prompts, temperature=0.0, max_tokens=24)
+        r_off = off.batch_generate_json(prompts, temperature=0.0, max_tokens=24)
+        assert r_on == r_off
+        on.shutdown()
+        off.shutdown()
