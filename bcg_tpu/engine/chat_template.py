@@ -84,3 +84,24 @@ def format_chat_prompt(
         model_name, system_prompt, user_prompt, disable_qwen3_thinking
     )
     return prefix + suffix
+
+
+def prefix_split_safe(model_name: str) -> bool:
+    """True when this family's prefix/suffix split (format_chat_parts)
+    lands on a special-token boundary, so encode(prefix) + encode(suffix)
+    == encode(prefix + suffix) and the prefix KV can be cached.
+
+    ChatML prefixes end at ``<|im_end|>\\n`` followed by the special
+    ``<|im_start|>``, and Llama-3 at ``<|eot_id|>`` — safe.  The
+    Mistral/Llama-2 ``[INST]`` prefix ends in bare text where a BPE merge
+    could straddle the split — not safe.  KEEP IN SYNC with the family
+    dispatch above: a new family whose prefix ends in bare text must
+    return False here or prefix caching will silently corrupt prompts at
+    the seam.
+    """
+    m = model_name.lower()
+    if "llama-3" in m or "llama3" in m:
+        return True
+    if "llama" in m or "mistral" in m:
+        return False
+    return True  # ChatML families and the ChatML fallback

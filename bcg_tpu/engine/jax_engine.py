@@ -28,7 +28,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from bcg_tpu.engine.chat_template import format_chat_parts, format_chat_prompt
+from bcg_tpu.engine.chat_template import (
+    format_chat_parts,
+    format_chat_prompt,
+    prefix_split_safe,
+)
 from bcg_tpu.engine.interface import InferenceEngine
 from bcg_tpu.engine.tokenizer import Tokenizer, tokenizer_for_model
 from bcg_tpu.guided.processor import GuidedBatch, compile_schema
@@ -76,6 +80,12 @@ def _enable_compilation_cache() -> None:
     setting = os.environ.get("BCG_TPU_XLA_CACHE", "")
     if setting.lower() in ("off", "0", "none"):
         return
+    # Respect an existing user configuration (JAX_COMPILATION_CACHE_DIR
+    # env or an explicit jax.config.update) — only fill in the default
+    # when nothing is set.
+    if getattr(jax.config, "jax_compilation_cache_dir", None):
+        _comp_cache_enabled = True
+        return
     cache_dir = setting or os.path.join(
         os.path.expanduser("~"), ".cache", "bcg_tpu_xla"
     )
@@ -86,21 +96,6 @@ def _enable_compilation_cache() -> None:
         _comp_cache_enabled = True
     except Exception:  # unsupported backend/version: run without the cache
         pass
-
-
-def _prefix_split_safe(model_name: str) -> bool:
-    """True when the chat template's prefix/suffix split lands on a
-    special-token boundary, so encode(prefix) + encode(suffix) ==
-    encode(prefix + suffix).  ChatML prefixes end at ``<|im_end|>\\n``
-    followed by the special ``<|im_start|>``, and Llama-3 at
-    ``<|eot_id|>`` — safe.  The Mistral/Llama-2 ``[INST]`` prefix ends in
-    bare text where a BPE merge could straddle the split — not safe."""
-    m = model_name.lower()
-    if "llama-3" in m or "llama3" in m:
-        return True
-    if "llama" in m or "mistral" in m:
-        return False
-    return True  # ChatML families and the ChatML fallback
 
 
 def _pad_batch(real_B: int) -> int:
@@ -184,6 +179,8 @@ class JaxEngine(InferenceEngine):
             )
         self.max_model_len = config.max_model_len
 
+        quantize = config.quantization == "int8"
+        owns_params = params is None
         if params is not None:
             self.params = params
         elif config.model_name.startswith("bcg-tpu/"):
@@ -191,18 +188,30 @@ class JaxEngine(InferenceEngine):
             self.params = init_params(self.spec, jax.random.PRNGKey(0))
         else:
             from bcg_tpu.models.loader import load_checkpoint_params
+            from bcg_tpu.models.quantize import quantize_leaf_transform
 
-            self.params = load_checkpoint_params(self.spec, config.model_name, mesh=mesh)
+            # Streamed quantized loading: each weight is quantized as it
+            # arrives so the bf16 model never exists whole on device.
+            self.params = load_checkpoint_params(
+                self.spec, config.model_name, mesh=mesh,
+                leaf_transform=quantize_leaf_transform(self.spec) if quantize else None,
+            )
 
-        if config.quantization == "int8":
-            from bcg_tpu.models.quantize import is_quantized, quantize_params
+        if quantize:
+            from bcg_tpu.models.quantize import (
+                ensure_quantized_head, is_quantized, quantize_params,
+            )
 
             # Quantize BEFORE sharding so the int8 tensors (not the bf16
             # originals) are what gets laid out over the mesh.  Constructor-
             # supplied params may already be quantized (weight sharing
-            # between engines) — don't quantize twice.
+            # between engines) — don't quantize twice, and only consume
+            # (free-as-we-go) a tree this engine created itself.
             if not is_quantized(self.params["layers"][0]["wq"]):
-                self.params = quantize_params(self.params, self.spec)
+                self.params = quantize_params(
+                    self.params, self.spec, consume=owns_params
+                )
+            ensure_quantized_head(self.params, self.spec)
 
         if mesh is not None:
             from bcg_tpu.parallel.sharding import shard_params
@@ -240,7 +249,7 @@ class JaxEngine(InferenceEngine):
         # template family ends the prefix at a special-token boundary so
         # BPE merges cannot straddle the split.
         self.prefix_caching = getattr(config, "prefix_caching", True)
-        self._prefix_safe = _prefix_split_safe(config.model_name)
+        self._prefix_safe = prefix_split_safe(config.model_name)
         self._prefix_cache: Dict[str, Dict[str, Any]] = {}
 
     # ------------------------------------------------------------- tokenizing
@@ -342,7 +351,7 @@ class JaxEngine(InferenceEngine):
         gid = np.array([uniq.index(p) for p, _ in parts], dtype=np.int32)
         tail = Ls + max_new + 1
 
-        def stack(name, pad_axis, pad_value, tail_shape_fn):
+        def stack(layer_idx, name, pad_axis, pad_value, tail_shape_fn):
             """[G, ...] stacked entry arrays padded to P, gathered to [B, ...],
             concatenated with the suffix+decode tail."""
             arrs = []
@@ -364,14 +373,14 @@ class JaxEngine(InferenceEngine):
         for layer_idx in range(self.spec.num_layers):
             entry0 = entries[uniq[0]]["kv"][layer_idx]
             layer = {
-                "k": stack("k", 1, 0, lambda g: (B, tail) + g.shape[2:]),
-                "v": stack("v", 1, 0, lambda g: (B, tail) + g.shape[2:]),
+                "k": stack(layer_idx, "k", 1, 0, lambda g: (B, tail) + g.shape[2:]),
+                "v": stack(layer_idx, "v", 1, 0, lambda g: (B, tail) + g.shape[2:]),
             }
             if "k_scale" in entry0:
                 layer["k_scale"] = stack(
-                    "k_scale", 2, 1, lambda g: g.shape[:2] + (tail,))
+                    layer_idx, "k_scale", 2, 1, lambda g: g.shape[:2] + (tail,))
                 layer["v_scale"] = stack(
-                    "v_scale", 2, 1, lambda g: g.shape[:2] + (tail,))
+                    layer_idx, "v_scale", 2, 1, lambda g: g.shape[:2] + (tail,))
             cache.append(layer)
 
         prefix_valid = np.zeros((B, P), dtype=bool)

@@ -73,8 +73,15 @@ def load_checkpoint_params(
     model_name: str,
     mesh=None,
     dtype=jnp.bfloat16,
+    leaf_transform=None,
 ) -> Dict:
-    """Load and (optionally) shard all parameters for ``spec``."""
+    """Load and (optionally) shard all parameters for ``spec``.
+
+    ``leaf_transform(logical_name, tensor) -> leaf`` is applied to each
+    tensor right after device placement — e.g. streamed int8 quantization
+    (models/quantize.py:quantize_leaf_transform), which keeps peak device
+    memory at the final model size instead of bf16 + quantized copies.
+    """
     ckpt_dir = find_checkpoint_dir(model_name)
     if ckpt_dir is None:
         raise FileNotFoundError(
@@ -122,6 +129,8 @@ def load_checkpoint_params(
         tensor = tensor.astype(dtype)
         if sharding_for is not None:
             tensor = jax.device_put(tensor, sharding_for(logical))
+        if leaf_transform is not None:
+            tensor = leaf_transform(logical, tensor)
         return tensor
 
     params: Dict = {"layers": []}

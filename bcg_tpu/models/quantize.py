@@ -73,7 +73,7 @@ def dense(x: jax.Array, w: DenseWeight, out_dtype=None) -> jax.Array:
     return (acc.astype(jnp.float32) * a_scale * w["scale"]).astype(out_dtype)
 
 
-def quantize_params(params: Dict, spec: ModelSpec) -> Dict:
+def quantize_params(params: Dict, spec: ModelSpec, consume: bool = False) -> Dict:
     """Quantize every dense matmul weight of a transformer param pytree.
 
     Returns a new pytree with each of ``_QUANT_LEAVES`` (per layer) and the
@@ -82,17 +82,55 @@ def quantize_params(params: Dict, spec: ModelSpec) -> Dict:
     projection is quantized while the bf16 embedding table remains for
     token gathers; ``transformer._logits`` prefers ``lm_head`` when
     present, keeping the tie semantically intact.
+
+    ``consume=True`` drops each bf16 source leaf from ``params`` as it is
+    quantized, so peak device memory is the int8 model plus ONE bf16
+    weight instead of both full copies — the difference between a 14B
+    int8 model fitting a single v5e chip or not.  Only pass it for a tree
+    the caller owns exclusively.
     """
     out = dict(params)
-    out["layers"] = [
-        {
-            k: (quantize_weight(v) if k in _QUANT_LEAVES else v)
-            for k, v in layer.items()
-        }
-        for layer in params["layers"]
-    ]
+    out_layers = []
+    for layer in params["layers"]:
+        new_layer = {}
+        for k in list(layer):
+            v = layer[k]
+            if k in _QUANT_LEAVES:
+                new_layer[k] = quantize_weight(v)
+                if consume:
+                    del layer[k]
+                del v  # drop the local bf16 reference immediately
+            else:
+                new_layer[k] = v
+        out_layers.append(new_layer)
+    out["layers"] = out_layers
     if "lm_head" in params:
         out["lm_head"] = quantize_weight(params["lm_head"])
+        if consume:
+            del params["lm_head"]
     elif spec.tie_embeddings:
         out["lm_head"] = quantize_weight(params["embed"].T)
     return out
+
+
+def quantize_leaf_transform(spec: ModelSpec):
+    """Per-leaf hook for the checkpoint loader: quantize each dense weight
+    AS IT LOADS, so the bf16 tensor is freed before the next one arrives
+    (streamed quantized loading; see loader.load_checkpoint_params)."""
+
+    def transform(logical: str, tensor):
+        leaf = logical.split(".")[-1]
+        if leaf in _QUANT_LEAVES or leaf == "lm_head":
+            return quantize_weight(tensor)
+        return tensor
+
+    return transform
+
+
+def ensure_quantized_head(params: Dict, spec: ModelSpec) -> Dict:
+    """Give tied-embedding models their explicit quantized LM head when a
+    leaf-transform load (which never sees an ``lm_head`` tensor) built the
+    rest of the tree."""
+    if "lm_head" not in params and spec.tie_embeddings:
+        params["lm_head"] = quantize_weight(params["embed"].T)
+    return params

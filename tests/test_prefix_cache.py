@@ -15,7 +15,8 @@ import numpy as np
 
 from bcg_tpu.config import EngineConfig
 from bcg_tpu.engine.chat_template import format_chat_parts, format_chat_prompt
-from bcg_tpu.engine.jax_engine import JaxEngine, _prefix_split_safe
+from bcg_tpu.engine.chat_template import prefix_split_safe
+from bcg_tpu.engine.jax_engine import JaxEngine
 from bcg_tpu.models import init_params, prefill, prefill_with_prefix, spec_for_model
 from bcg_tpu.models.transformer import init_kv_cache
 
@@ -38,10 +39,10 @@ class TestChatParts:
             assert prefix + suffix == format_chat_prompt(model, "sys text", "user text")
 
     def test_split_safety_classification(self):
-        assert _prefix_split_safe("Qwen/Qwen3-14B")
-        assert _prefix_split_safe("meta-llama/Meta-Llama-3-8B-Instruct")
-        assert not _prefix_split_safe("mistralai/Mistral-Small-Instruct-2409")
-        assert _prefix_split_safe("bcg-tpu/tiny-test")
+        assert prefix_split_safe("Qwen/Qwen3-14B")
+        assert prefix_split_safe("meta-llama/Meta-Llama-3-8B-Instruct")
+        assert not prefix_split_safe("mistralai/Mistral-Small-Instruct-2409")
+        assert prefix_split_safe("bcg-tpu/tiny-test")
 
 
 class TestSplitPrefillMatchesFull:

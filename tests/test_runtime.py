@@ -255,6 +255,19 @@ class TestCLI:
         with pytest.raises(SystemExit):
             main(["--value-range", "banana"])
 
+    def test_cli_engine_flags_reach_config(self):
+        from bcg_tpu.cli import build_parser, config_from_args
+
+        args = build_parser().parse_args(
+            ["--quantization", "int8", "--kv-cache-dtype", "int8",
+             "--no-prefix-caching", "--tensor-parallel", "2"]
+        )
+        cfg = config_from_args(args)
+        assert cfg.engine.quantization == "int8"
+        assert cfg.engine.kv_cache_dtype == "int8"
+        assert cfg.engine.prefix_caching is False
+        assert cfg.engine.tensor_parallel_size == 2
+
     def test_cli_no_save(self, tmp_path, capsys):
         from bcg_tpu.cli import main
 
