@@ -11,8 +11,26 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional
 
-from bcg_tpu.config import BCGConfig, resolve_model_name
+from bcg_tpu.config import BCGConfig, EngineConfig, resolve_model_name
 from bcg_tpu.engine.interface import InferenceEngine
+
+
+def resolve_engine_config(
+    model_name: Optional[str],
+    backend: Optional[str],
+    base: Optional[BCGConfig] = None,
+) -> EngineConfig:
+    """The single place name/backend overrides become an EngineConfig —
+    shared by per-run construction here and the concurrent-sweep shared
+    engine in :mod:`bcg_tpu.experiments`, so both always agree."""
+    engine_cfg = (base or BCGConfig()).engine
+    if model_name:
+        engine_cfg = dataclasses.replace(
+            engine_cfg, model_name=resolve_model_name(model_name)
+        )
+    if backend:
+        engine_cfg = dataclasses.replace(engine_cfg, backend=backend)
+    return engine_cfg
 
 
 def run_simulation(
@@ -38,11 +56,7 @@ def run_simulation(
         byzantine_awareness=byzantine_awareness,
         seed=seed if seed is not None else base.game.seed,
     )
-    engine_cfg = base.engine
-    if model_name:
-        engine_cfg = dataclasses.replace(engine_cfg, model_name=resolve_model_name(model_name))
-    if backend:
-        engine_cfg = dataclasses.replace(engine_cfg, backend=backend)
+    engine_cfg = resolve_engine_config(model_name, backend, base=base)
     metrics = dataclasses.replace(base.metrics, save_results=False, generate_plots=False)
 
     sim = BCGSimulation(

@@ -1,0 +1,199 @@
+"""Cross-simulation batching: merge concurrent games into one device batch.
+
+TPU decode is weight-bandwidth-bound — every decode step streams the whole
+model from HBM regardless of batch size — so G games of 10 agents decoded
+as one 10G-row batch cost roughly what ONE game costs.  The reference
+cannot do this (its vLLM engine is a process-wide singleton fed by one
+synchronous loop; experiment sweeps in its README are sequential CLI
+invocations).  Here, experiment throughput scales with whatever batch the
+chip's memory fits.
+
+:class:`CollectiveEngine` is an :class:`InferenceEngine` proxy shared by G
+simulation threads.  Each thread's ``batch_generate_json`` blocks until
+every ACTIVE participant is blocked on a call (games run in lockstep
+phases, so they arrive nearly together); the proxy then merges each
+(kind, temperature, max_tokens) signature group into one inner-engine call
+and scatters the results.  Dispatching *all* pending groups whenever every
+active thread is blocked guarantees progress even when retries desynchronize
+the phase structure (one sim re-deciding while others vote): mixed groups
+just dispatch as separate smaller batches that round.
+
+Participants MUST call :meth:`retire` when their game ends (or crashes) —
+a missing retire would leave the barrier waiting for a thread that will
+never call again.  ``run_concurrent_simulations`` below handles that
+bookkeeping, and is what :mod:`bcg_tpu.experiments` uses.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from bcg_tpu.engine.interface import InferenceEngine
+
+
+class _Call:
+    __slots__ = ("sig", "payload", "n_rows", "results", "error")
+
+    def __init__(self, sig: Tuple, payload, n_rows: int):
+        self.sig = sig
+        self.payload = payload
+        self.n_rows = n_rows
+        self.results: Optional[List] = None
+        self.error: Optional[BaseException] = None
+
+
+class CollectiveEngine(InferenceEngine):
+    """Thread-barrier batching proxy over a real engine.
+
+    ``participants`` is the number of concurrently running simulations
+    sharing this proxy; it decreases via :meth:`retire`.
+    """
+
+    def __init__(self, engine: InferenceEngine, participants: int):
+        if participants < 1:
+            raise ValueError("participants must be >= 1")
+        self._engine = engine
+        self._cond = threading.Condition()
+        self._active = participants
+        self._blocked = 0
+        self._pending: List[_Call] = []
+
+    # ------------------------------------------------------------- barrier
+
+    def _submit(self, sig: Tuple, payload, n_rows: int) -> List:
+        call = _Call(sig, payload, n_rows)
+        with self._cond:
+            self._pending.append(call)
+            self._blocked += 1
+            if self._blocked == self._active:
+                self._dispatch_all_locked()
+            while call.results is None and call.error is None:
+                # The timeout is a lost-wakeup safety net, not a timer.
+                self._cond.wait(timeout=60.0)
+                if (call.results is None and call.error is None
+                        and self._blocked == self._active and self._pending):
+                    self._dispatch_all_locked()
+        if call.error is not None:
+            raise call.error
+        return call.results
+
+    def _dispatch_all_locked(self) -> None:
+        """Run every pending signature group as one merged inner call.
+
+        Called with the lock held; the inner engine runs WITH the lock so
+        exactly one device batch is in flight (the other threads are all
+        blocked waiting anyway — that is the dispatch precondition).
+
+        ``_blocked`` is decremented HERE, per satisfied call, not by the
+        woken threads: a satisfied thread that hasn't been scheduled yet
+        must not count toward the barrier, or the next phase's first
+        arrival would see blocked == active and dispatch a lonely
+        unmerged batch."""
+        while self._pending:
+            sig = self._pending[0].sig
+            group = [c for c in self._pending if c.sig == sig]
+            self._pending = [c for c in self._pending if c.sig != sig]
+            merged: List = []
+            for c in group:
+                merged.extend(c.payload)
+            try:
+                if sig[0] == "json":
+                    out = self._engine.batch_generate_json(
+                        merged, temperature=sig[1], max_tokens=sig[2]
+                    )
+                else:
+                    out = self._engine.batch_generate(
+                        merged, temperature=sig[1], max_tokens=sig[2], top_p=sig[3]
+                    )
+                pos = 0
+                for c in group:
+                    c.results = out[pos: pos + c.n_rows]
+                    pos += c.n_rows
+            except BaseException as e:  # propagate to every caller in the group
+                for c in group:
+                    c.error = e
+            self._blocked -= len(group)
+        self._cond.notify_all()
+
+    def retire(self) -> None:
+        """A participant's game is over; shrink the barrier."""
+        with self._cond:
+            self._active -= 1
+            if self._active > 0 and self._blocked == self._active and self._pending:
+                self._dispatch_all_locked()
+            self._cond.notify_all()
+
+    # --------------------------------------------------- InferenceEngine API
+
+    def batch_generate_json(self, prompts, temperature=0.8, max_tokens=512):
+        if not prompts:
+            return []
+        return self._submit(
+            ("json", float(temperature), int(max_tokens)), list(prompts), len(prompts)
+        )
+
+    def generate_json(self, prompt, schema, temperature=0.0, max_tokens=512,
+                      system_prompt=None) -> Dict[str, Any]:
+        return self.batch_generate_json(
+            [(system_prompt or "", prompt, schema)], temperature, max_tokens
+        )[0]
+
+    def batch_generate(self, prompts, temperature=0.0, max_tokens=256, top_p=1.0):
+        if not prompts:
+            return []
+        return self._submit(
+            ("free", float(temperature), int(max_tokens), float(top_p)),
+            list(prompts), len(prompts),
+        )
+
+    def generate(self, prompt, temperature=0.0, max_tokens=256, top_p=1.0,
+                 system_prompt=None) -> str:
+        if system_prompt is not None:
+            # Chat formatting is model-specific and lives in the inner
+            # engine — delegate directly (unmerged; generate() is not on
+            # the game's hot path) rather than silently dropping it.
+            return self._engine.generate(
+                prompt, temperature, max_tokens, top_p, system_prompt=system_prompt
+            )
+        return self.batch_generate([prompt], temperature, max_tokens, top_p)[0]
+
+    def shutdown(self) -> None:
+        # The inner engine is owned by the caller (shared across waves).
+        pass
+
+
+def run_concurrent_simulations(
+    engine: InferenceEngine,
+    run_fns: List[Callable[[InferenceEngine], Any]],
+    concurrency: int,
+) -> List[Any]:
+    """Run ``run_fns`` (each ``fn(engine) -> result``) in lockstep waves of
+    ``concurrency`` threads sharing one :class:`CollectiveEngine` per wave.
+
+    Wave size bounds device memory: the merged batch is at most
+    ``concurrency x agents`` rows of KV cache.  Results keep input order;
+    a failed run stores its exception object in its slot.
+    """
+    results: List[Any] = [None] * len(run_fns)
+    for start in range(0, len(run_fns), concurrency):
+        wave = list(range(start, min(start + concurrency, len(run_fns))))
+        collective = CollectiveEngine(engine, participants=len(wave))
+
+        def worker(idx: int) -> None:
+            try:
+                results[idx] = run_fns[idx](collective)
+            except BaseException as e:
+                results[idx] = e
+            finally:
+                collective.retire()
+
+        threads = [
+            threading.Thread(target=worker, args=(i,), name=f"bcg-sim-{i}")
+            for i in wave
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    return results

@@ -82,8 +82,9 @@ def _enable_compilation_cache() -> None:
         return
     # Respect an existing user configuration (JAX_COMPILATION_CACHE_DIR
     # env or an explicit jax.config.update) — only fill in the default
-    # when nothing is set.
-    if getattr(jax.config, "jax_compilation_cache_dir", None):
+    # when nothing is set.  An explicit BCG_TPU_XLA_CACHE=<dir> still
+    # wins, as documented above.
+    if not setting and getattr(jax.config, "jax_compilation_cache_dir", None):
         _comp_cache_enabled = True
         return
     cache_dir = setting or os.path.join(

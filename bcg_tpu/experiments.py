@@ -92,20 +92,52 @@ def run_preset(
     seed: Optional[int] = 0,
     honest: Optional[int] = None,
     byzantine: Optional[int] = None,
+    concurrency: int = 1,
 ) -> Dict:
-    per_run = []
-    for r in range(runs):
-        out = run_simulation(
-            n_agents=(honest if honest is not None else preset.honest)
-            + (byzantine if byzantine is not None else preset.byzantine),
-            byzantine_count=byzantine if byzantine is not None else preset.byzantine,
-            max_rounds=max_rounds if max_rounds is not None else preset.max_rounds,
-            byzantine_awareness=preset.awareness,
-            model_name=model_name,
-            backend=backend,
-            seed=None if seed is None else seed + r,
-        )
-        per_run.append(out["metrics"])
+    """Run a preset ``runs`` times and aggregate.
+
+    ``concurrency > 1`` runs that many games at once against ONE shared
+    engine, merged into single device batches per phase
+    (engine/collective.py) — decode cost is per-step weight streaming, so
+    G concurrent games cost roughly one game's wall-clock.  The reference
+    has no equivalent: its sweeps are sequential CLI invocations
+    (README.md:55-70).
+    """
+    n_honest = honest if honest is not None else preset.honest
+    n_byz = byzantine if byzantine is not None else preset.byzantine
+
+    def make_run(r: int):
+        def go(engine=None):
+            return run_simulation(
+                n_agents=n_honest + n_byz,
+                byzantine_count=n_byz,
+                max_rounds=max_rounds if max_rounds is not None else preset.max_rounds,
+                byzantine_awareness=preset.awareness,
+                model_name=model_name,
+                backend=backend,
+                seed=None if seed is None else seed + r,
+                engine=engine,
+            )
+        return go
+
+    if concurrency > 1:
+        from bcg_tpu.api import resolve_engine_config
+        from bcg_tpu.engine.collective import run_concurrent_simulations
+        from bcg_tpu.engine.interface import create_engine
+
+        engine = create_engine(resolve_engine_config(model_name, backend))
+        try:
+            outs = run_concurrent_simulations(
+                engine, [make_run(r) for r in range(runs)], concurrency
+            )
+        finally:
+            engine.shutdown()
+        failures = [o for o in outs if isinstance(o, BaseException)]
+        if failures:
+            raise failures[0]
+        per_run = [o["metrics"] for o in outs]
+    else:
+        per_run = [make_run(r)()["metrics"] for r in range(runs)]
     return {"preset": preset.name, "aggregate": aggregate(per_run), "per_run": per_run}
 
 
@@ -137,10 +169,14 @@ def main(argv: Optional[List[str]] = None) -> None:
                    help="scale-sweep agent counts, comma-separated")
     p.add_argument("--byzantine-fraction", type=float, default=0.0,
                    help="scale-sweep Byzantine share of each population")
+    p.add_argument("--concurrency", type=int, default=1,
+                   help="Games run at once against one shared engine "
+                        "(merged device batches; bound by KV-cache memory)")
     args = p.parse_args(argv)
 
     common = dict(runs=args.runs, model_name=args.model, backend=args.backend,
-                  max_rounds=args.rounds, seed=args.seed)
+                  max_rounds=args.rounds, seed=args.seed,
+                  concurrency=args.concurrency)
     if args.preset == "scale-sweep":
         out = run_scale_sweep(
             [int(x) for x in args.agents.split(",")],
