@@ -11,12 +11,12 @@ chip's memory fits.
 :class:`CollectiveEngine` is an :class:`InferenceEngine` proxy shared by G
 simulation threads.  Each thread's ``batch_generate_json`` blocks until
 every ACTIVE participant is blocked on a call (games run in lockstep
-phases, so they arrive nearly together); the proxy then merges each
-(kind, temperature, max_tokens) signature group into one inner-engine call
-and scatters the results.  Dispatching *all* pending groups whenever every
-active thread is blocked guarantees progress even when retries desynchronize
-the phase structure (one sim re-deciding while others vote): mixed groups
-just dispatch as separate smaller batches that round.
+phases, so they arrive nearly together); the proxy then merges every
+guided call — temperature and token budget ride PER ROW, so a game
+mid-decide batches with a game mid-vote — into one inner-engine call and
+scatters the results.  Free-text calls group by top_p.  Dispatching all
+pending groups whenever every active thread is blocked guarantees
+progress even when retries desynchronize the phase structure.
 
 Participants MUST call :meth:`retire` when their game ends (or crashes) —
 a missing retire would leave the barrier waiting for a thread that will
@@ -33,12 +33,16 @@ from bcg_tpu.engine.interface import InferenceEngine
 
 
 class _Call:
-    __slots__ = ("sig", "payload", "n_rows", "results", "error")
+    __slots__ = ("sig", "payload", "n_rows", "temperature", "max_tokens",
+                 "results", "error")
 
-    def __init__(self, sig: Tuple, payload, n_rows: int):
+    def __init__(self, sig: Tuple, payload, n_rows: int,
+                 temperature: float, max_tokens: int):
         self.sig = sig
         self.payload = payload
         self.n_rows = n_rows
+        self.temperature = temperature
+        self.max_tokens = max_tokens
         self.results: Optional[List] = None
         self.error: Optional[BaseException] = None
 
@@ -61,8 +65,9 @@ class CollectiveEngine(InferenceEngine):
 
     # ------------------------------------------------------------- barrier
 
-    def _submit(self, sig: Tuple, payload, n_rows: int) -> List:
-        call = _Call(sig, payload, n_rows)
+    def _submit(self, sig: Tuple, payload, n_rows: int,
+                temperature: float, max_tokens: int) -> List:
+        call = _Call(sig, payload, n_rows, temperature, max_tokens)
         with self._cond:
             self._pending.append(call)
             self._blocked += 1
@@ -95,16 +100,27 @@ class CollectiveEngine(InferenceEngine):
             group = [c for c in self._pending if c.sig == sig]
             self._pending = [c for c in self._pending if c.sig != sig]
             merged: List = []
+            temps: List[float] = []
+            budgets: List[int] = []
             for c in group:
                 merged.extend(c.payload)
+                temps.extend([c.temperature] * c.n_rows)
+                budgets.extend([c.max_tokens] * c.n_rows)
+            # Collapse to scalars when uniform so plain engines (fake,
+            # stubs) that expect scalar settings keep working; the JAX
+            # engine accepts per-row lists (its decode loop takes
+            # temperature and budget as per-row dynamic inputs).
+            temperature = temps[0] if len(set(temps)) == 1 else temps
+            max_tokens = budgets[0] if len(set(budgets)) == 1 else budgets
             try:
                 if sig[0] == "json":
                     out = self._engine.batch_generate_json(
-                        merged, temperature=sig[1], max_tokens=sig[2]
+                        merged, temperature=temperature, max_tokens=max_tokens
                     )
                 else:
                     out = self._engine.batch_generate(
-                        merged, temperature=sig[1], max_tokens=sig[2], top_p=sig[3]
+                        merged, temperature=temperature, max_tokens=max_tokens,
+                        top_p=sig[1],
                     )
                 pos = 0
                 for c in group:
@@ -129,8 +145,11 @@ class CollectiveEngine(InferenceEngine):
     def batch_generate_json(self, prompts, temperature=0.8, max_tokens=512):
         if not prompts:
             return []
+        # One signature for ALL guided calls: temperature and budget ride
+        # per-row, so a game mid-decide merges with a game mid-vote.
         return self._submit(
-            ("json", float(temperature), int(max_tokens)), list(prompts), len(prompts)
+            ("json",), list(prompts), len(prompts),
+            float(temperature), int(max_tokens),
         )
 
     def generate_json(self, prompt, schema, temperature=0.0, max_tokens=512,
@@ -143,8 +162,8 @@ class CollectiveEngine(InferenceEngine):
         if not prompts:
             return []
         return self._submit(
-            ("free", float(temperature), int(max_tokens), float(top_p)),
-            list(prompts), len(prompts),
+            ("free", float(top_p)), list(prompts), len(prompts),
+            float(temperature), int(max_tokens),
         )
 
     def generate(self, prompt, temperature=0.0, max_tokens=256, top_p=1.0,

@@ -10,7 +10,7 @@ from __future__ import annotations
 import json
 from abc import ABC, abstractmethod
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 
 @dataclass
@@ -41,11 +41,17 @@ class InferenceEngine(ABC):
     def batch_generate(
         self,
         prompts: List[str],
-        temperature: float = 0.0,
-        max_tokens: int = 256,
+        temperature: Union[float, Sequence[float]] = 0.0,
+        max_tokens: Union[int, Sequence[int]] = 256,
         top_p: float = 1.0,
     ) -> List[str]:
-        """Free-text generation for a padded batch of prompts."""
+        """Free-text generation for a padded batch of prompts.
+
+        ``temperature`` / ``max_tokens`` may be scalars or per-row
+        sequences (len == len(prompts)); the collective proxy merges calls
+        with different settings into one batch, so implementations MUST
+        accept both forms (ignoring them entirely, like the fake engine,
+        also satisfies the contract)."""
 
     @abstractmethod
     def generate_json(
@@ -64,14 +70,15 @@ class InferenceEngine(ABC):
     def batch_generate_json(
         self,
         prompts: List[Tuple[str, str, Dict[str, Any]]],
-        temperature: float = 0.8,
-        max_tokens: int = 512,
+        temperature: Union[float, Sequence[float]] = 0.8,
+        max_tokens: Union[int, Sequence[int]] = 512,
     ) -> List[Dict[str, Any]]:
         """Batched schema-guided generation over (system, user, schema)
         tuples.  Unlike the reference (vllm_agent.py:417-455, which falls
         back to sequential calls when schemas differ), implementations here
         are expected to batch heterogeneous schemas via per-sequence DFA
-        masks."""
+        masks.  ``temperature`` / ``max_tokens`` may be scalars or per-row
+        sequences — see :meth:`batch_generate`."""
 
     def shutdown(self) -> None:
         """Release device resources (reference vllm_agent.py:506-551)."""

@@ -105,6 +105,18 @@ def _pad_batch(real_B: int) -> int:
     return real_B if real_B >= 8 else 1 << (real_B - 1).bit_length()
 
 
+def _per_row(value, n: int, cast):
+    """Normalize a scalar-or-sequence sampling setting to a length-n list."""
+    if isinstance(value, (list, tuple)):
+        vals = [cast(v) for v in value]
+        if len(vals) != n:
+            raise ValueError(
+                f"per-row setting has {len(vals)} entries for a batch of {n}"
+            )
+        return vals
+    return [cast(value)] * n
+
+
 def _pad_rows(*lists):
     """Pad parallel per-sequence lists to the bucketed batch size by
     repeating row 0 (results for padding rows are discarded).  Small
@@ -256,20 +268,27 @@ class JaxEngine(InferenceEngine):
     # ------------------------------------------------------------- tokenizing
 
     def _encode_leftpad(
-        self, texts: List[str], limit: int, bucket_ladder: Tuple[int, ...]
+        self, texts: List[str], limits: List[int],
+        bucket_ladder: Tuple[int, ...],
     ) -> Tuple[np.ndarray, np.ndarray, int]:
-        """Tokenize (keeping the LAST ``limit`` tokens) and LEFT-pad into a
-        bucketed [B, L] batch.  The ladder extends by doubling past its
-        static tail so a raised max_model_len still lands on stable
-        buckets; anything beyond the last bucket uses ``limit`` itself
-        (one stable shape, not ragged)."""
-        token_lists = [self.tokenizer.encode(t)[-limit:] for t in texts]
+        """Tokenize (keeping the LAST ``limits[i]`` tokens PER ROW) and
+        LEFT-pad into a bucketed [B, L] batch.  Row limits differ when
+        per-row token budgets differ — each row reserves only ITS OWN
+        decode budget, so merging a small-budget call with a large-budget
+        one never tightens the small call's prompt window.  The ladder
+        extends by doubling past its static tail so a raised max_model_len
+        still lands on stable buckets; anything beyond the last bucket
+        uses the largest row limit (one stable shape, not ragged)."""
+        token_lists = [
+            self.tokenizer.encode(t)[-lim:] for t, lim in zip(texts, limits)
+        ]
         max_len = max(len(t) for t in token_lists)
+        max_limit = max(limits)
         buckets = list(bucket_ladder)
-        while buckets[-1] < limit:
+        while buckets[-1] < max_limit:
             buckets.append(buckets[-1] * 2)
-        L = next((b for b in buckets if b >= max_len), limit)
-        L = max(min(L, limit), max_len)
+        L = next((b for b in buckets if b >= max_len), max_limit)
+        L = max(min(L, max_limit), max_len)
         B = len(token_lists)
         tokens = np.full((B, L), self.tokenizer.pad_id, dtype=np.int32)
         valid = np.zeros((B, L), dtype=bool)
@@ -279,18 +298,19 @@ class JaxEngine(InferenceEngine):
         return tokens, valid, L
 
     def _prepare_batch(
-        self, full_prompts: List[str], max_new: int
+        self, full_prompts: List[str], budgets: List[int]
     ) -> Tuple[np.ndarray, np.ndarray, int]:
         """Tokenize + LEFT-pad into a bucketed [B, L] batch, reserving
-        ``max_new`` decode slots: prompt + output always fit max_model_len
-        (bucket rounding is capped so it can never eat the decode budget)."""
-        limit = self.max_model_len - max_new - 1
-        if limit < 1:
+        each row's own decode budget: prompt + output always fit
+        max_model_len (bucket rounding is capped so it can never eat the
+        decode budget)."""
+        limits = [self.max_model_len - b - 1 for b in budgets]
+        if min(limits) < 1:
             raise ValueError(
-                f"max_tokens={max_new} leaves no room for a prompt within "
-                f"max_model_len={self.max_model_len}"
+                f"max_tokens={max(budgets)} leaves no room for a prompt "
+                f"within max_model_len={self.max_model_len}"
             )
-        return self._encode_leftpad(full_prompts, limit, _LEN_BUCKETS)
+        return self._encode_leftpad(full_prompts, limits, _LEN_BUCKETS)
 
     # --------------------------------------------------------- prefix caching
 
@@ -325,12 +345,14 @@ class JaxEngine(InferenceEngine):
         self._prefix_cache[prefix] = entry
         return entry
 
-    def _prepare_prefixed_batch(self, parts, max_new: int):
+    def _prepare_prefixed_batch(self, parts, budgets: List[int]):
         """Assemble a batch whose cache slots [0, P) are prefilled prefix
         KV (gathered per row from the prefix cache) and whose suffix is
         left-padded into [P, P+Ls).  Returns None when any prefix cannot
         be cached (caller falls back to full-prompt prefill)."""
-        limit = self.max_model_len - max_new - 1
+        # Entry feasibility uses the tightest row budget: the prefix is
+        # shared, so it must leave room for the worst-case row.
+        limit = self.max_model_len - min(budgets) - 1
         entries: Dict[str, Dict[str, Any]] = {}
         for p, _ in parts:
             if p not in entries:
@@ -340,12 +362,13 @@ class JaxEngine(InferenceEngine):
                 entries[p] = e
         uniq = list(entries)
         P = max(entries[p]["bucket"] for p in uniq)
-        limit_s = limit - P
-        if limit_s < 1:
+        max_new = max(budgets)
+        limits_s = [self.max_model_len - b - 1 - P for b in budgets]
+        if min(limits_s) < 1:
             return None
 
         tokens, valid, Ls = self._encode_leftpad(
-            [s for _, s in parts], limit_s, _SUFFIX_BUCKETS
+            [s for _, s in parts], limits_s, _SUFFIX_BUCKETS
         )
         B = len(parts)
 
@@ -394,12 +417,17 @@ class JaxEngine(InferenceEngine):
 
     # ------------------------------------------------------------ decode loop
 
-    def _get_decode_loop(self, guided_sig: Tuple, temperature: float, max_new: int,
+    def _get_decode_loop(self, guided_sig: Tuple, max_new: int,
                          top_p: float = 1.0):
         """Build (or fetch) the compiled guided decode loop for a shape
         signature.  The whole token loop is one ``lax.while_loop`` on
-        device; ``io_callback``-free and host-sync-free."""
-        key = (guided_sig, float(temperature), int(max_new), float(top_p),
+        device; ``io_callback``-free and host-sync-free.
+
+        Temperature and token budget are PER-ROW dynamic inputs, not
+        compile keys: one compiled loop serves greedy and sampled rows,
+        decide- and vote-budget rows, in the same batch — which is what
+        lets desynchronized games merge under the collective engine."""
+        key = (guided_sig, int(max_new), float(top_p),
                self.decode_attention_impl)
         if key in self._decode_loops:
             return self._decode_loops[key]
@@ -407,11 +435,11 @@ class JaxEngine(InferenceEngine):
         spec = self.spec
         impl = self.decode_attention_impl
         eos_id = self.tokenizer.eos_id
-        greedy = temperature <= 0.0
-        use_top_p = (not greedy) and top_p < 1.0
+        use_top_p = top_p < 1.0
 
         def loop(params, cache, first_logits, valid_mask, prompt_lens, L,
-                 tables, accepting, min_budget, dfa_ids, init_states, rng):
+                 tables, accepting, min_budget, dfa_ids, init_states,
+                 row_temp, row_budget, rng):
             B = first_logits.shape[0]
             V = first_logits.shape[1]
 
@@ -430,11 +458,13 @@ class JaxEngine(InferenceEngine):
                 # (bcg_agents.py:708-759) exists to absorb.  min_budget
                 # also encodes "forbidden" (sentinel), so this one gather
                 # is the entire mask.
-                budget_left = max_new - pos                  # incl. this token
-                allowed = min_budget[dfa_ids, clamped] <= budget_left
+                budget_left = row_budget - pos               # [B], incl. this token
+                allowed = min_budget[dfa_ids, clamped] <= budget_left[:, None]
                 eos_ok = accepting[dfa_ids, clamped]
                 any_tok = allowed.any(axis=-1)
-                scaled = logits if greedy else logits / temperature
+                greedy_row = row_temp <= 0.0                 # [B]
+                safe_temp = jnp.where(greedy_row, 1.0, row_temp)[:, None]
+                scaled = logits / safe_temp
                 lg = jnp.where(allowed, scaled, -jnp.inf)
                 # EOS is legal exactly at accepting states (same
                 # temperature scaling as every other token).
@@ -451,10 +481,11 @@ class JaxEngine(InferenceEngine):
                     cutoff = jnp.take_along_axis(sorted_probs, cutoff_idx, axis=-1)
                     lg = jnp.where(probs >= cutoff, lg, -jnp.inf)
                 rng, sub = jax.random.split(rng)
-                if greedy:
-                    tok = jnp.argmax(lg, axis=-1)
-                else:
-                    tok = jax.random.categorical(sub, lg, axis=-1)
+                tok = jnp.where(
+                    greedy_row,
+                    jnp.argmax(lg, axis=-1),
+                    jax.random.categorical(sub, lg, axis=-1),
+                )
                 # Dead end (no token allowed): force EOS.
                 tok = jnp.where(~any_tok, eos_id, tok)
                 next_states = tables[dfa_ids, clamped, tok].astype(jnp.int32)
@@ -508,11 +539,19 @@ class JaxEngine(InferenceEngine):
         self,
         parts: List[Tuple[str, str]],
         schemas: List[Dict],
-        temperature: float,
-        max_tokens: int,
+        temperature,
+        max_tokens,
         top_p: float = 1.0,
     ) -> List[str]:
-        real_B, B, parts, schemas = _pad_rows(parts, schemas)
+        """``temperature`` / ``max_tokens`` may be scalars or per-row lists
+        (the collective engine merges calls with different sampling
+        settings into one batch)."""
+        n = len(parts)
+        temps = _per_row(temperature, n, float)
+        budgets = _per_row(max_tokens, n, int)
+        real_B, B, parts, schemas, temps, budgets = _pad_rows(
+            parts, schemas, temps, budgets
+        )
         guides = [
             compile_schema(s, self._token_bytes, vocab_id=self.tokenizer.vocab_id)
             for s in schemas
@@ -520,23 +559,25 @@ class JaxEngine(InferenceEngine):
         batch = GuidedBatch(guides)
         sig = (batch.num_unique, batch.tables.shape[1], batch.tables.shape[2])
         return self._decode_batch(
-            parts, batch, sig, real_B, temperature, max_tokens, top_p
+            parts, batch, sig, real_B, temps, budgets, top_p
         )
 
     def _decode_batch(
-        self, parts, batch, sig_prefix, real_B, temperature, max_new,
+        self, parts, batch, sig_prefix, real_B, temps, budgets,
         top_p,
     ) -> List[str]:
         """Shared prefill + guided-decode scaffolding for the guided and
         free paths; ``parts`` is a batch-padded (_pad_rows) list of
-        (prefix, suffix) prompt halves.  When every row has a cacheable
+        (prefix, suffix) prompt halves, ``temps``/``budgets`` the padded
+        per-row sampling settings.  When every row has a cacheable
         prefix, only the suffixes are prefilled (prefix caching);
         otherwise the joined full prompts take the plain path."""
         B = len(parts)
+        max_new = max(budgets)
         t0 = time.perf_counter()
         prepped = None
         if self.prefix_caching and self._prefix_safe and all(p for p, _ in parts):
-            prepped = self._prepare_prefixed_batch(parts, max_new)
+            prepped = self._prepare_prefixed_batch(parts, budgets)
         if prepped is not None:
             tokens, valid, Ls, cache, prefix_valid, prefix_lens, P = prepped
             first_logits, cache = self._prefill_suffix(
@@ -553,7 +594,7 @@ class JaxEngine(InferenceEngine):
             prompt_lens = (prefix_lens + valid.sum(axis=1)).astype(np.int32)
         else:
             full_prompts = [p + s for p, s in parts]
-            tokens, valid, L = self._prepare_batch(full_prompts, max_new)
+            tokens, valid, L = self._prepare_batch(full_prompts, budgets)
             cache = init_kv_cache(
                 self.spec, B, L + max_new + 1, quantized=self.kv_quantized
             )
@@ -569,13 +610,15 @@ class JaxEngine(InferenceEngine):
             first_logits.block_until_ready()
         t1 = time.perf_counter()
 
-        loop = self._get_decode_loop(sig_prefix + (B, L), temperature, max_new, top_p)
+        loop = self._get_decode_loop(sig_prefix + (B, L), max_new, top_p)
         self._key, sub = jax.random.split(self._key)
         out, (_, steps) = loop(
             self.params, cache, first_logits, jnp.asarray(valid_mask),
             jnp.asarray(prompt_lens), L,
             batch.tables, batch.accepting, batch.min_budget,
-            batch.dfa_ids, batch.init_states, sub,
+            batch.dfa_ids, batch.init_states,
+            jnp.asarray(temps, jnp.float32), jnp.asarray(budgets, jnp.int32),
+            sub,
         )
         out_np = np.asarray(out)
         if _TIMING:
@@ -652,11 +695,14 @@ class JaxEngine(InferenceEngine):
         # Free-form prompts arrive pre-joined (no prefix/suffix split), so
         # they always take the full-prefill path.
         parts = [("", p) for p in full_prompts]
-        real_B, B, parts = _pad_rows(parts)
+        n = len(parts)
+        temps = _per_row(temperature, n, float)
+        budgets = _per_row(max_tokens, n, int)
+        real_B, B, parts, temps, budgets = _pad_rows(parts, temps, budgets)
         batch = GuidedBatch.permissive(B, self.spec.vocab_size)
         texts = self._decode_batch(
             parts, batch, ("free", 1, self.spec.vocab_size), real_B,
-            temperature, max_tokens, top_p,
+            temps, budgets, top_p,
         )
         return [t.strip() for t in texts]
 

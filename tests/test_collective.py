@@ -22,6 +22,7 @@ class StubEngine(InferenceEngine):
 
     def __init__(self):
         self.calls = []
+        self.settings = []  # (temps, budgets) as lists, per inner call
         self.lock = threading.Lock()
 
     def _row(self, system_prompt, user_prompt, schema):
@@ -32,8 +33,14 @@ class StubEngine(InferenceEngine):
                 "public_reasoning": f"reason {h} for consensus"}
 
     def batch_generate_json(self, prompts, temperature=0.8, max_tokens=512):
+        n = len(prompts)
+        temps = list(temperature) if isinstance(temperature, (list, tuple)) \
+            else [temperature] * n
+        budgets = list(max_tokens) if isinstance(max_tokens, (list, tuple)) \
+            else [max_tokens] * n
         with self.lock:
-            self.calls.append(len(prompts))
+            self.calls.append(n)
+            self.settings.append((temps, budgets))
         return [self._row(*p) for p in prompts]
 
     def generate_json(self, prompt, schema, temperature=0.0, max_tokens=512,
@@ -83,7 +90,9 @@ class TestMergeAndScatter:
                 [(f"sys-{name}", f"user-{name}-{i}", DECIDE) for i in range(4)])
             assert results[name] == expect
 
-    def test_mixed_signatures_dispatch_separately(self):
+    def test_mixed_phases_merge_with_per_row_settings(self):
+        """A decide call (temp 0.5, 300 tok) and a vote call (0.3, 200)
+        merge into ONE inner batch; settings ride per-row."""
         inner = StubEngine()
         coll = CollectiveEngine(inner, participants=2)
         out = {}
@@ -93,7 +102,7 @@ class TestMergeAndScatter:
             coll.retire()
 
         def voter():
-            out["v"] = coll.batch_generate_json([("s", "u", VOTE)], 0.3, 200)
+            out["v"] = coll.batch_generate_json([("s", "u2", VOTE)], 0.3, 200)
             coll.retire()
 
         ts = [threading.Thread(target=decider), threading.Thread(target=voter)]
@@ -101,8 +110,9 @@ class TestMergeAndScatter:
             t.start()
         for t in ts:
             t.join()
-        # Different (temp, max_tokens) → two inner calls of one row each.
-        assert sorted(inner.calls)[:3] == [1, 1]
+        assert inner.calls == [2]
+        assert inner.settings == [([0.5, 0.3], [300, 200])] or \
+            inner.settings == [([0.3, 0.5], [200, 300])]
         assert "value" in out["d"][0] and out["v"][0]["decision"] in ("stop", "continue")
 
     def test_error_propagates_to_all_callers(self):

@@ -130,6 +130,40 @@ class TestEnginePrefixCaching:
         assert len(engine._prefix_cache) == 2
         engine.shutdown()
 
+    def test_per_row_temperature_and_budget(self):
+        """One batch can mix greedy and sampled rows with different token
+        budgets — every row still yields schema-valid JSON and respects
+        its own budget (guaranteed parse is per-row)."""
+        engine = JaxEngine(EngineConfig(
+            backend="jax", model_name="bcg-tpu/tiny-test", max_model_len=1024,
+        ))
+        bounded = {
+            "type": "object",
+            "properties": {"note": {"type": "string", "minLength": 1, "maxLength": 20}},
+            "required": ["note"],
+            "additionalProperties": False,
+        }
+        # NB: budgets must cover each schema's shortest completion for the
+        # byte tokenizer ('{"decision": "stop"}' is 20 bytes) — a budget
+        # below that yields a clean EMPTY output by design (see
+        # TestGuaranteedParse in test_jax_engine.py).
+        texts = engine._run_guided(
+            [("p1 ", "s1"), ("p2 ", "s2")],
+            [bounded, SCHEMA],
+            temperature=[0.0, 0.9],
+            max_tokens=[40, 30],
+        )
+        import json as _json
+
+        a = _json.loads(texts[0])
+        b = _json.loads(texts[1])
+        assert isinstance(a.get("note"), str)
+        assert b.get("decision") in ("stop", "continue")
+        # Row budgets: the encoded outputs fit their own caps.
+        assert len(engine.tokenizer.encode(texts[0])) <= 40
+        assert len(engine.tokenizer.encode(texts[1])) <= 30
+        engine.shutdown()
+
     def test_matches_uncached_engine_greedy(self):
         cfg = EngineConfig(backend="jax", model_name="bcg-tpu/tiny-test",
                            max_model_len=2048)
