@@ -73,7 +73,13 @@ class EngineConfig:
     tensor_parallel_size: int = 1
     data_parallel_size: int = 1
     sequence_parallel_size: int = 1
-    max_num_seqs: int = 4
+    # Cap on concurrently decoded sequences (vLLM max_num_seqs semantics:
+    # larger batches process in chunks).  The reference ships 4 as a GPU
+    # memory guard (config.py:38); here 0 = unbounded is the right TPU
+    # default — decode streams the weights once per step regardless of
+    # rows, so artificial serialization only wastes bandwidth.  Set it
+    # when KV-cache memory (B x S x layers) must be bounded.
+    max_num_seqs: int = 0
     dtype: str = "bfloat16"
     # "int8" stores the KV cache quantized (per-position-per-head absmax
     # scales); the Pallas decode kernel dequantizes in VMEM, halving the

@@ -33,18 +33,32 @@ from bcg_tpu.engine.interface import InferenceEngine
 
 
 class _Call:
-    __slots__ = ("sig", "payload", "n_rows", "temperature", "max_tokens",
+    __slots__ = ("sig", "payload", "n_rows", "temps", "budgets",
                  "results", "error")
 
     def __init__(self, sig: Tuple, payload, n_rows: int,
-                 temperature: float, max_tokens: int):
+                 temps: List[float], budgets: List[int]):
         self.sig = sig
         self.payload = payload
         self.n_rows = n_rows
-        self.temperature = temperature
-        self.max_tokens = max_tokens
+        self.temps = temps        # per-row, len == n_rows
+        self.budgets = budgets    # per-row, len == n_rows
         self.results: Optional[List] = None
         self.error: Optional[BaseException] = None
+
+
+def _rows(value, n: int, cast) -> List:
+    """Scalar-or-sequence sampling setting -> length-n list (the same
+    contract InferenceEngine documents; the proxy must accept what it
+    forwards)."""
+    if isinstance(value, (list, tuple)):
+        vals = [cast(v) for v in value]
+        if len(vals) != n:
+            raise ValueError(
+                f"per-row setting has {len(vals)} entries for a batch of {n}"
+            )
+        return vals
+    return [cast(value)] * n
 
 
 class CollectiveEngine(InferenceEngine):
@@ -66,8 +80,8 @@ class CollectiveEngine(InferenceEngine):
     # ------------------------------------------------------------- barrier
 
     def _submit(self, sig: Tuple, payload, n_rows: int,
-                temperature: float, max_tokens: int) -> List:
-        call = _Call(sig, payload, n_rows, temperature, max_tokens)
+                temps: List[float], budgets: List[int]) -> List:
+        call = _Call(sig, payload, n_rows, temps, budgets)
         with self._cond:
             self._pending.append(call)
             self._blocked += 1
@@ -104,8 +118,8 @@ class CollectiveEngine(InferenceEngine):
             budgets: List[int] = []
             for c in group:
                 merged.extend(c.payload)
-                temps.extend([c.temperature] * c.n_rows)
-                budgets.extend([c.max_tokens] * c.n_rows)
+                temps.extend(c.temps)
+                budgets.extend(c.budgets)
             # Collapse to scalars when uniform so plain engines (fake,
             # stubs) that expect scalar settings keep working; the JAX
             # engine accepts per-row lists (its decode loop takes
@@ -145,11 +159,12 @@ class CollectiveEngine(InferenceEngine):
     def batch_generate_json(self, prompts, temperature=0.8, max_tokens=512):
         if not prompts:
             return []
+        n = len(prompts)
         # One signature for ALL guided calls: temperature and budget ride
         # per-row, so a game mid-decide merges with a game mid-vote.
         return self._submit(
-            ("json",), list(prompts), len(prompts),
-            float(temperature), int(max_tokens),
+            ("json",), list(prompts), n,
+            _rows(temperature, n, float), _rows(max_tokens, n, int),
         )
 
     def generate_json(self, prompt, schema, temperature=0.0, max_tokens=512,
@@ -161,9 +176,10 @@ class CollectiveEngine(InferenceEngine):
     def batch_generate(self, prompts, temperature=0.0, max_tokens=256, top_p=1.0):
         if not prompts:
             return []
+        n = len(prompts)
         return self._submit(
-            ("free", float(top_p)), list(prompts), len(prompts),
-            float(temperature), int(max_tokens),
+            ("free", float(top_p)), list(prompts), n,
+            _rows(temperature, n, float), _rows(max_tokens, n, int),
         )
 
     def generate(self, prompt, temperature=0.0, max_tokens=256, top_p=1.0,

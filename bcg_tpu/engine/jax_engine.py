@@ -264,6 +264,16 @@ class JaxEngine(InferenceEngine):
         self.prefix_caching = getattr(config, "prefix_caching", True)
         self._prefix_safe = prefix_split_safe(config.model_name)
         self._prefix_cache: Dict[str, Dict[str, Any]] = {}
+        # One-time constants for the hbm_utilization OOM guard.
+        self._kv_budget_warned = False
+        self._param_bytes = sum(
+            getattr(p, "nbytes", 0) for p in jax.tree.leaves(self.params)
+        )
+        try:
+            stats = jax.devices()[0].memory_stats() or {}
+            self._mem_limit = stats.get("bytes_limit")
+        except Exception:
+            self._mem_limit = None
 
     # ------------------------------------------------------------- tokenizing
 
@@ -350,9 +360,11 @@ class JaxEngine(InferenceEngine):
         KV (gathered per row from the prefix cache) and whose suffix is
         left-padded into [P, P+Ls).  Returns None when any prefix cannot
         be cached (caller falls back to full-prompt prefill)."""
-        # Entry feasibility uses the tightest row budget: the prefix is
-        # shared, so it must leave room for the worst-case row.
-        limit = self.max_model_len - min(budgets) - 1
+        # Entry feasibility uses the LARGEST row budget: the prefix is
+        # shared, so it must leave suffix room for the row that reserves
+        # the most decode slots — admitting a longer prefix would prefill
+        # and cache an entry the limits_s guard below can never accept.
+        limit = self.max_model_len - max(budgets) - 1
         entries: Dict[str, Dict[str, Any]] = {}
         for p, _ in parts:
             if p not in entries:
@@ -549,6 +561,18 @@ class JaxEngine(InferenceEngine):
         n = len(parts)
         temps = _per_row(temperature, n, float)
         budgets = _per_row(max_tokens, n, int)
+        # max_num_seqs (vLLM semantics, reference config.py:38): bound the
+        # concurrently decoded rows by chunking oversized batches.  Off by
+        # default on TPU — see EngineConfig.
+        cap = self.config.max_num_seqs
+        if cap and n > cap:
+            out: List[str] = []
+            for i in range(0, n, cap):
+                out.extend(self._run_guided(
+                    parts[i:i + cap], schemas[i:i + cap],
+                    temps[i:i + cap], budgets[i:i + cap], top_p,
+                ))
+            return out
         real_B, B, parts, schemas, temps, budgets = _pad_rows(
             parts, schemas, temps, budgets
         )
@@ -574,6 +598,7 @@ class JaxEngine(InferenceEngine):
         otherwise the joined full prompts take the plain path."""
         B = len(parts)
         max_new = max(budgets)
+        self._check_kv_budget(B, budgets)
         t0 = time.perf_counter()
         prepped = None
         if self.prefix_caching and self._prefix_safe and all(p for p, _ in parts):
@@ -635,6 +660,35 @@ class JaxEngine(InferenceEngine):
             row = row[: end[0]] if end.size else row
             texts.append(self.tokenizer.decode(row.tolist()))
         return texts
+
+    def _check_kv_budget(self, B: int, budgets: List[int]) -> None:
+        """hbm_utilization as an OOM guard (the reference's
+        ``gpu_memory_utilization``, config.py:36): warn — once — when the
+        worst-case KV cache for this batch would push past the budgeted
+        fraction of device memory, naming the knobs that bound it."""
+        if self._kv_budget_warned or self._mem_limit is None:
+            return
+        spec = self.spec
+        # Worst case for a mixed-budget batch: a min-budget row's prompt
+        # window (max_model_len - min - 1) plus the batch-wide decode
+        # reservation (max + 1) — S can exceed max_model_len itself.
+        S = self.max_model_len - min(budgets) + max(budgets)
+        kv_bytes_per_slot = spec.num_kv_heads * spec.head_dim * 2  # k+v
+        kv_bytes_per_slot *= 1 if self.kv_quantized else 2
+        kv_total = B * S * kv_bytes_per_slot * spec.num_layers
+        if kv_total + self._param_bytes > self.config.hbm_utilization * self._mem_limit:
+            import warnings
+
+            warnings.warn(
+                f"worst-case KV cache ({kv_total / 1e9:.1f} GB for B={B}, "
+                f"S={S}) plus weights ({self._param_bytes / 1e9:.1f} GB) "
+                f"exceeds hbm_utilization={self.config.hbm_utilization} of "
+                f"device memory ({self._mem_limit / 1e9:.1f} GB); bound it "
+                "with max_num_seqs, a smaller max_model_len, or "
+                "kv_cache_dtype='int8'",
+                stacklevel=3,
+            )
+            self._kv_budget_warned = True
 
     # -------------------------------------------------------- public surface
 
@@ -698,6 +752,15 @@ class JaxEngine(InferenceEngine):
         n = len(parts)
         temps = _per_row(temperature, n, float)
         budgets = _per_row(max_tokens, n, int)
+        cap = self.config.max_num_seqs
+        if cap and n > cap:
+            out: List[str] = []
+            for i in range(0, n, cap):
+                out.extend(self._run_free(
+                    full_prompts[i:i + cap], temps[i:i + cap],
+                    budgets[i:i + cap], top_p,
+                ))
+            return out
         real_B, B, parts, temps, budgets = _pad_rows(parts, temps, budgets)
         batch = GuidedBatch.permissive(B, self.spec.vocab_size)
         texts = self._decode_batch(

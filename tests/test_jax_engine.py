@@ -43,6 +43,29 @@ DECISION_SCHEMA = {
 }
 
 
+class TestMaxNumSeqs:
+    def test_oversized_batch_chunks(self, monkeypatch):
+        engine = JaxEngine(EngineConfig(
+            backend="jax", model_name="bcg-tpu/tiny-test", max_model_len=1024,
+            max_num_seqs=2,
+        ))
+        calls = []
+        orig = engine._decode_batch
+
+        def spy(*a, **k):
+            calls.append(len(a[0]))
+            return orig(*a, **k)
+
+        monkeypatch.setattr(engine, "_decode_batch", spy)
+        prompts = [("sys", f"user {i}", VOTE_SCHEMA) for i in range(5)]
+        out = engine.batch_generate_json(prompts, temperature=0.0, max_tokens=24)
+        assert len(out) == 5
+        assert all(o.get("decision") in ("stop", "continue") for o in out)
+        assert len(calls) == 3  # ceil(5 / 2) chunks
+        assert all(c <= 2 for c in calls)
+        engine.shutdown()
+
+
 class TestChatTemplate:
     def test_qwen3_no_think(self):
         p = format_chat_prompt("Qwen/Qwen3-14B", "sys", "user")
