@@ -29,7 +29,7 @@ from __future__ import annotations
 import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from bcg_tpu.engine.interface import InferenceEngine
+from bcg_tpu.engine.interface import InferenceEngine, per_row_settings as _rows
 
 
 class _Call:
@@ -47,18 +47,6 @@ class _Call:
         self.error: Optional[BaseException] = None
 
 
-def _rows(value, n: int, cast) -> List:
-    """Scalar-or-sequence sampling setting -> length-n list (the same
-    contract InferenceEngine documents; the proxy must accept what it
-    forwards)."""
-    if isinstance(value, (list, tuple)):
-        vals = [cast(v) for v in value]
-        if len(vals) != n:
-            raise ValueError(
-                f"per-row setting has {len(vals)} entries for a batch of {n}"
-            )
-        return vals
-    return [cast(value)] * n
 
 
 class CollectiveEngine(InferenceEngine):

@@ -23,6 +23,19 @@ class GenerationRequest:
     extra: Dict[str, Any] = field(default_factory=dict)
 
 
+def per_row_settings(value, n: int, cast) -> List:
+    """Normalize a scalar-or-sequence sampling setting (the
+    :class:`InferenceEngine` batch contract) to a length-``n`` list."""
+    if isinstance(value, (list, tuple)):
+        vals = [cast(v) for v in value]
+        if len(vals) != n:
+            raise ValueError(
+                f"per-row setting has {len(vals)} entries for a batch of {n}"
+            )
+        return vals
+    return [cast(value)] * n
+
+
 class InferenceEngine(ABC):
     """Shared LLM serving all agents (single weights, many prompts)."""
 

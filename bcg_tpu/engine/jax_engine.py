@@ -33,7 +33,7 @@ from bcg_tpu.engine.chat_template import (
     format_chat_prompt,
     prefix_split_safe,
 )
-from bcg_tpu.engine.interface import InferenceEngine
+from bcg_tpu.engine.interface import InferenceEngine, per_row_settings as _per_row
 from bcg_tpu.engine.tokenizer import Tokenizer, tokenizer_for_model
 from bcg_tpu.guided.processor import GuidedBatch, compile_schema
 from bcg_tpu.models.configs import ModelSpec, spec_for_model
@@ -105,16 +105,13 @@ def _pad_batch(real_B: int) -> int:
     return real_B if real_B >= 8 else 1 << (real_B - 1).bit_length()
 
 
-def _per_row(value, n: int, cast):
-    """Normalize a scalar-or-sequence sampling setting to a length-n list."""
-    if isinstance(value, (list, tuple)):
-        vals = [cast(v) for v in value]
-        if len(vals) != n:
-            raise ValueError(
-                f"per-row setting has {len(vals)} entries for a batch of {n}"
-            )
-        return vals
-    return [cast(value)] * n
+def _chunk_size(cap: int) -> int:
+    """Largest chunk whose PADDED batch (_pad_batch) stays within ``cap``
+    — max_num_seqs bounds allocated KV rows, so padding must not
+    re-inflate a chunk past it (cap 5 would pad to 8 otherwise)."""
+    if cap >= 8:
+        return cap
+    return 1 << (cap.bit_length() - 1)  # largest power of two <= cap
 
 
 def _pad_rows(*lists):
@@ -264,16 +261,22 @@ class JaxEngine(InferenceEngine):
         self.prefix_caching = getattr(config, "prefix_caching", True)
         self._prefix_safe = prefix_split_safe(config.model_name)
         self._prefix_cache: Dict[str, Dict[str, Any]] = {}
-        # One-time constants for the hbm_utilization OOM guard.
+        # One-time constants for the hbm_utilization OOM guard.  Under a
+        # mesh, leaf .nbytes is the GLOBAL size while bytes_limit is ONE
+        # device's — the single-device comparison would fire spuriously on
+        # sharded runs that fit fine, so the guard is single-device only.
         self._kv_budget_warned = False
         self._param_bytes = sum(
             getattr(p, "nbytes", 0) for p in jax.tree.leaves(self.params)
         )
-        try:
-            stats = jax.devices()[0].memory_stats() or {}
-            self._mem_limit = stats.get("bytes_limit")
-        except Exception:
+        if mesh is not None:
             self._mem_limit = None
+        else:
+            try:
+                stats = jax.devices()[0].memory_stats() or {}
+                self._mem_limit = stats.get("bytes_limit")
+            except Exception:
+                self._mem_limit = None
 
     # ------------------------------------------------------------- tokenizing
 
@@ -566,11 +569,12 @@ class JaxEngine(InferenceEngine):
         # default on TPU — see EngineConfig.
         cap = self.config.max_num_seqs
         if cap and n > cap:
+            step = _chunk_size(cap)
             out: List[str] = []
-            for i in range(0, n, cap):
+            for i in range(0, n, step):
                 out.extend(self._run_guided(
-                    parts[i:i + cap], schemas[i:i + cap],
-                    temps[i:i + cap], budgets[i:i + cap], top_p,
+                    parts[i:i + step], schemas[i:i + step],
+                    temps[i:i + step], budgets[i:i + step], top_p,
                 ))
             return out
         real_B, B, parts, schemas, temps, budgets = _pad_rows(
@@ -754,11 +758,12 @@ class JaxEngine(InferenceEngine):
         budgets = _per_row(max_tokens, n, int)
         cap = self.config.max_num_seqs
         if cap and n > cap:
+            step = _chunk_size(cap)
             out: List[str] = []
-            for i in range(0, n, cap):
+            for i in range(0, n, step):
                 out.extend(self._run_free(
-                    full_prompts[i:i + cap], temps[i:i + cap],
-                    budgets[i:i + cap], top_p,
+                    full_prompts[i:i + step], temps[i:i + step],
+                    budgets[i:i + step], top_p,
                 ))
             return out
         real_B, B, parts, temps, budgets = _pad_rows(parts, temps, budgets)

@@ -85,7 +85,11 @@ class HFTokenizer(Tokenizer):
     def __init__(self, path: str, vocab_id: int = 2):
         from transformers import AutoTokenizer
 
-        self.tk = AutoTokenizer.from_pretrained(path, trust_remote_code=True)
+        # local_files_only: a bare name would otherwise trigger ~minutes of
+        # network retries in this zero-egress environment before failing.
+        self.tk = AutoTokenizer.from_pretrained(
+            path, trust_remote_code=True, local_files_only=True
+        )
         self.vocab_size = len(self.tk)
         self.eos_id = self.tk.eos_token_id
         self.pad_id = (
@@ -126,4 +130,11 @@ def tokenizer_for_model(model_name: str, model_path: Optional[str] = None) -> To
 
         spec = spec_for_model(model_name)
         return ByteTokenizer(vocab_size=spec.vocab_size if spec else 512)
-    return HFTokenizer(model_path or model_name)
+    if model_path is None:
+        # Resolve to the local checkpoint dir first: AutoTokenizer given a
+        # bare model NAME would try the network, which this environment
+        # does not have (same zero-egress rule as the weight loader).
+        from bcg_tpu.models.loader import find_checkpoint_dir
+
+        model_path = find_checkpoint_dir(model_name) or model_name
+    return HFTokenizer(model_path)

@@ -177,6 +177,54 @@ class TestConcurrentSimulations:
         assert max(inner.calls) > 4
 
 
+class FlakyStub(StubEngine):
+    """Returns an invalid decision for some rows on their first attempt,
+    driving the orchestrator's retry ladder so concurrent games
+    desynchronize (one re-deciding while others vote) — the barrier must
+    still make progress and every game must complete."""
+
+    def __init__(self, fail_every: int = 5):
+        super().__init__()
+        self.n = 0
+        self.fail_every = fail_every
+
+    def _row(self, system_prompt, user_prompt, schema):
+        with self.lock:
+            self.n += 1
+            n = self.n
+        if "enum" not in str(schema) and n % self.fail_every == 0:
+            return {"error": "synthetic_failure"}
+        return super()._row(system_prompt, user_prompt, schema)
+
+
+class TestRetryDesyncStress:
+    def test_flaky_engine_concurrent_games_complete(self):
+        import random
+
+        from bcg_tpu.api import run_simulation
+
+        inner = FlakyStub(fail_every=5)
+
+        def make(r):
+            def go(engine):
+                # Random thread-start jitter widens the interleavings.
+                import time
+
+                time.sleep(random.random() * 0.01)
+                return run_simulation(
+                    n_agents=4, byzantine_count=1, max_rounds=4,
+                    backend="fake", seed=r, engine=engine,
+                )
+            return go
+
+        outs = run_concurrent_simulations(inner, [make(r) for r in range(6)], 3)
+        for o in outs:
+            if isinstance(o, BaseException):
+                raise o
+        assert len(outs) == 6
+        assert all("consensus_reached" in o["metrics"] for o in outs)
+
+
 class TestExperimentsConcurrency:
     def test_run_preset_concurrent(self):
         from bcg_tpu.experiments import PRESETS, run_preset
