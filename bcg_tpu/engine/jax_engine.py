@@ -261,22 +261,20 @@ class JaxEngine(InferenceEngine):
         self.prefix_caching = getattr(config, "prefix_caching", True)
         self._prefix_safe = prefix_split_safe(config.model_name)
         self._prefix_cache: Dict[str, Dict[str, Any]] = {}
-        # One-time constants for the hbm_utilization OOM guard.  Under a
-        # mesh, leaf .nbytes is the GLOBAL size while bytes_limit is ONE
-        # device's — the single-device comparison would fire spuriously on
-        # sharded runs that fit fine, so the guard is single-device only.
+        # One-time constants for the hbm_utilization OOM guard.  Leaf
+        # .nbytes is the GLOBAL size while bytes_limit is ONE device's, so
+        # sharded totals are divided by mesh size (params and KV both
+        # partition over the mesh — a conservative even-split estimate).
         self._kv_budget_warned = False
+        self._mesh_devices = mesh.size if mesh is not None else 1
         self._param_bytes = sum(
             getattr(p, "nbytes", 0) for p in jax.tree.leaves(self.params)
         )
-        if mesh is not None:
+        try:
+            stats = jax.devices()[0].memory_stats() or {}
+            self._mem_limit = stats.get("bytes_limit")
+        except Exception:
             self._mem_limit = None
-        else:
-            try:
-                stats = jax.devices()[0].memory_stats() or {}
-                self._mem_limit = stats.get("bytes_limit")
-            except Exception:
-                self._mem_limit = None
 
     # ------------------------------------------------------------- tokenizing
 
@@ -568,7 +566,7 @@ class JaxEngine(InferenceEngine):
         # concurrently decoded rows by chunking oversized batches.  Off by
         # default on TPU — see EngineConfig.
         cap = self.config.max_num_seqs
-        if cap and n > cap:
+        if cap and _pad_batch(n) > cap:
             step = _chunk_size(cap)
             out: List[str] = []
             for i in range(0, n, step):
@@ -680,7 +678,8 @@ class JaxEngine(InferenceEngine):
         kv_bytes_per_slot = spec.num_kv_heads * spec.head_dim * 2  # k+v
         kv_bytes_per_slot *= 1 if self.kv_quantized else 2
         kv_total = B * S * kv_bytes_per_slot * spec.num_layers
-        if kv_total + self._param_bytes > self.config.hbm_utilization * self._mem_limit:
+        per_device = (kv_total + self._param_bytes) / self._mesh_devices
+        if per_device > self.config.hbm_utilization * self._mem_limit:
             import warnings
 
             warnings.warn(
@@ -757,7 +756,7 @@ class JaxEngine(InferenceEngine):
         temps = _per_row(temperature, n, float)
         budgets = _per_row(max_tokens, n, int)
         cap = self.config.max_num_seqs
-        if cap and n > cap:
+        if cap and _pad_batch(n) > cap:
             step = _chunk_size(cap)
             out: List[str] = []
             for i in range(0, n, step):
