@@ -20,6 +20,8 @@ MODEL_PRESETS: Dict[str, str] = {
     "qwen3-14b": "Qwen/Qwen3-14B",
     "qwen3-32b": "Qwen/Qwen3-32B",
     "mistral-22b": "mistralai/Mistral-Small-Instruct-2409",
+    "qwen2.5-7b": "Qwen/Qwen2.5-7B-Instruct",
+    "llama3-8b": "meta-llama/Meta-Llama-3.1-8B-Instruct",
     # Hermetic preset: tiny random-weight model + byte tokenizer, runs anywhere.
     "tiny-test": "bcg-tpu/tiny-test",
 }

@@ -262,10 +262,12 @@ class JaxEngine(InferenceEngine):
         self._prefix_safe = prefix_split_safe(config.model_name)
         self._prefix_cache: Dict[str, Dict[str, Any]] = {}
         # One-time constants for the hbm_utilization OOM guard.  Leaf
-        # .nbytes is the GLOBAL size while bytes_limit is ONE device's, so
-        # sharded totals are divided by mesh size (params and KV both
-        # partition over the mesh — a conservative even-split estimate).
+        # .nbytes is the GLOBAL size while bytes_limit is ONE device's.
+        # Weights shard over the tp axis only (replicated across dp/sp —
+        # parallel/sharding.py), while the KV cache shards over every
+        # axis, so the two divide by different factors.
         self._kv_budget_warned = False
+        self._tp_devices = mesh.shape.get("tp", 1) if mesh is not None else 1
         self._mesh_devices = mesh.size if mesh is not None else 1
         self._param_bytes = sum(
             getattr(p, "nbytes", 0) for p in jax.tree.leaves(self.params)
@@ -678,7 +680,9 @@ class JaxEngine(InferenceEngine):
         kv_bytes_per_slot = spec.num_kv_heads * spec.head_dim * 2  # k+v
         kv_bytes_per_slot *= 1 if self.kv_quantized else 2
         kv_total = B * S * kv_bytes_per_slot * spec.num_layers
-        per_device = (kv_total + self._param_bytes) / self._mesh_devices
+        per_device = (
+            kv_total / self._mesh_devices + self._param_bytes / self._tp_devices
+        )
         if per_device > self.config.hbm_utilization * self._mem_limit:
             import warnings
 

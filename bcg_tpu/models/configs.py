@@ -15,6 +15,19 @@ from typing import Dict, Optional
 
 
 @dataclass(frozen=True)
+class RopeScaling:
+    """Llama-3.1-style NTK-by-parts rope scaling (HF ``rope_type:
+    "llama3"``): frequencies whose wavelength exceeds the original
+    training context are stretched by ``factor``, short wavelengths are
+    kept, and the band between is smoothly interpolated."""
+
+    factor: float = 8.0
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_position: int = 8192
+
+
+@dataclass(frozen=True)
 class ModelSpec:
     name: str
     vocab_size: int
@@ -27,6 +40,8 @@ class ModelSpec:
     rope_theta: float = 1_000_000.0
     rms_eps: float = 1e-6
     qk_norm: bool = False          # Qwen3-style per-head q/k RMSNorm
+    attn_bias: bool = False        # Qwen2-style q/k/v projection biases
+    rope_scaling: Optional[RopeScaling] = None
     tie_embeddings: bool = False
     max_position: int = 40960
 
@@ -58,6 +73,22 @@ MODEL_SPECS: Dict[str, ModelSpec] = {
         vocab_size=151936, hidden_size=5120, num_layers=64,
         num_heads=64, num_kv_heads=8, head_dim=128,
         intermediate_size=25600, qk_norm=True,
+    ),
+    # Families beyond the reference's presets that its engine layer
+    # special-cases chat templates for (vllm_agent.py:199-292) — specs
+    # here so those templates are servable, not just formattable.
+    "Qwen/Qwen2.5-7B-Instruct": ModelSpec(
+        name="Qwen/Qwen2.5-7B-Instruct",
+        vocab_size=152064, hidden_size=3584, num_layers=28,
+        num_heads=28, num_kv_heads=4, head_dim=128,
+        intermediate_size=18944, attn_bias=True, max_position=32768,
+    ),
+    "meta-llama/Meta-Llama-3.1-8B-Instruct": ModelSpec(
+        name="meta-llama/Meta-Llama-3.1-8B-Instruct",
+        vocab_size=128256, hidden_size=4096, num_layers=32,
+        num_heads=32, num_kv_heads=8, head_dim=128,
+        intermediate_size=14336, rope_theta=500_000.0,
+        rms_eps=1e-5, rope_scaling=RopeScaling(), max_position=131072,
     ),
     "mistralai/Mistral-Small-Instruct-2409": ModelSpec(
         name="mistralai/Mistral-Small-Instruct-2409",

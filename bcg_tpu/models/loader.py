@@ -28,6 +28,9 @@ _LAYER_MAP = {
     "wq": "model.layers.{i}.self_attn.q_proj.weight",
     "wk": "model.layers.{i}.self_attn.k_proj.weight",
     "wv": "model.layers.{i}.self_attn.v_proj.weight",
+    "bq": "model.layers.{i}.self_attn.q_proj.bias",
+    "bk": "model.layers.{i}.self_attn.k_proj.bias",
+    "bv": "model.layers.{i}.self_attn.v_proj.bias",
     "wo": "model.layers.{i}.self_attn.o_proj.weight",
     "q_norm": "model.layers.{i}.self_attn.q_norm.weight",
     "k_norm": "model.layers.{i}.self_attn.k_norm.weight",
@@ -147,6 +150,8 @@ def load_checkpoint_params(
             layer = {}
             for logical, template in _LAYER_MAP.items():
                 if logical in ("q_norm", "k_norm") and not spec.qk_norm:
+                    continue
+                if logical in ("bq", "bk", "bv") and not spec.attn_bias:
                     continue
                 hf_name = template.format(i=i)
                 layer[logical] = fetch(hf_name, f"layers.{i}.{logical}")

@@ -47,6 +47,7 @@ def init_params(
       layers.l.wq      [D, H*Dh]    layers.l.wk/wv [D, Hkv*Dh]
       layers.l.wo      [H*Dh, D]
       layers.l.q_norm/k_norm [Dh]   (qk_norm models only)
+      layers.l.bq/bk/bv             (attn_bias models only, e.g. Qwen2)
       layers.l.mlp_norm [D]
       layers.l.w_gate/w_up [D, F]   layers.l.w_down [F, D]
       final_norm       [D]
@@ -78,6 +79,10 @@ def init_params(
         if spec.qk_norm:
             layer["q_norm"] = jnp.ones((spec.head_dim,), dtype)
             layer["k_norm"] = jnp.ones((spec.head_dim,), dtype)
+        if spec.attn_bias:
+            layer["bq"] = jnp.zeros((spec.q_size,), dtype)
+            layer["bk"] = jnp.zeros((spec.kv_size,), dtype)
+            layer["bv"] = jnp.zeros((spec.kv_size,), dtype)
         params["layers"].append(layer)
     if not spec.tie_embeddings:
         params["lm_head"] = _init_dense(next(keys), (spec.hidden_size, spec.vocab_size))
@@ -92,9 +97,33 @@ def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
     return (x32 * scale).astype(x.dtype) * weight
 
 
-def rope_table(positions: jax.Array, head_dim: int, theta: float) -> Tuple[jax.Array, jax.Array]:
-    """cos/sin tables for the given positions ([..., P] -> [..., P, Dh/2])."""
+def rope_table(
+    positions: jax.Array, head_dim: int, theta: float, scaling=None
+) -> Tuple[jax.Array, jax.Array]:
+    """cos/sin tables for the given positions ([..., P] -> [..., P, Dh/2]).
+
+    ``scaling`` is an optional :class:`~bcg_tpu.models.configs.RopeScaling`
+    (Llama-3.1 "llama3" NTK-by-parts): long-wavelength frequencies divide
+    by ``factor``, short ones are kept, the band between interpolates.
+    """
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    if scaling is not None:
+        wavelen = 2.0 * math.pi / inv_freq
+        low_wl = scaling.original_max_position / scaling.low_freq_factor
+        high_wl = scaling.original_max_position / scaling.high_freq_factor
+        smooth = (
+            scaling.original_max_position / wavelen - scaling.low_freq_factor
+        ) / (scaling.high_freq_factor - scaling.low_freq_factor)
+        scaled = jnp.where(
+            wavelen > low_wl,
+            inv_freq / scaling.factor,
+            jnp.where(
+                wavelen < high_wl,
+                inv_freq,
+                (1 - smooth) * inv_freq / scaling.factor + smooth * inv_freq,
+            ),
+        )
+        inv_freq = scaled
     angles = positions.astype(jnp.float32)[..., None] * inv_freq
     return jnp.cos(angles), jnp.sin(angles)
 
@@ -214,9 +243,12 @@ def _block(
 ) -> Tuple[jax.Array, Dict]:
     B, T, D = x.shape
     h = rms_norm(x, layer["attn_norm"], spec.rms_eps)
-    q = dense(h, layer["wq"]).reshape(B, T, spec.num_heads, spec.head_dim)
-    k = dense(h, layer["wk"]).reshape(B, T, spec.num_kv_heads, spec.head_dim)
-    v = dense(h, layer["wv"]).reshape(B, T, spec.num_kv_heads, spec.head_dim)
+    q, k, v = dense(h, layer["wq"]), dense(h, layer["wk"]), dense(h, layer["wv"])
+    if "bq" in layer:  # Qwen2-style projection biases
+        q, k, v = q + layer["bq"], k + layer["bk"], v + layer["bv"]
+    q = q.reshape(B, T, spec.num_heads, spec.head_dim)
+    k = k.reshape(B, T, spec.num_kv_heads, spec.head_dim)
+    v = v.reshape(B, T, spec.num_kv_heads, spec.head_dim)
     if spec.qk_norm:
         q = rms_norm(q, layer["q_norm"], spec.rms_eps)
         k = rms_norm(k, layer["k_norm"], spec.rms_eps)
@@ -312,7 +344,7 @@ def prefill(
     B, L = tokens.shape
     positions = jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1
     positions = jnp.maximum(positions, 0)
-    cos, sin = rope_table(positions, spec.head_dim, spec.rope_theta)
+    cos, sin = rope_table(positions, spec.head_dim, spec.rope_theta, spec.rope_scaling)
 
     causal = jnp.tril(jnp.ones((L, L), bool))
     # Prefill attends over the fresh [B, L] chunk only — nothing beyond L
@@ -352,7 +384,7 @@ def prefill_with_prefix(
     P = prefix_valid.shape[1]
     positions = prefix_lens[:, None] + jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1
     positions = jnp.maximum(positions, 0)
-    cos, sin = rope_table(positions, spec.head_dim, spec.rope_theta)
+    cos, sin = rope_table(positions, spec.head_dim, spec.rope_theta, spec.rope_scaling)
 
     causal = jnp.tril(jnp.ones((Ls, Ls), bool))
     chunk_mask = causal[None] & valid[:, None, :] & valid[:, :, None]   # [B, Ls, Ls]
@@ -383,7 +415,7 @@ def decode_step(
 ) -> Tuple[jax.Array, Dict]:
     """One autoregressive step for the whole batch."""
     B = token.shape[0]
-    cos, sin = rope_table(seq_positions[:, None], spec.head_dim, spec.rope_theta)
+    cos, sin = rope_table(seq_positions[:, None], spec.head_dim, spec.rope_theta, spec.rope_scaling)
     x = params["embed"][token][:, None, :]  # [B, 1, D]
 
     new_cache = []

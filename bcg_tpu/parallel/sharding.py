@@ -39,6 +39,10 @@ _SPECS = {
     # per-head norms replicate
     "q_norm": P(None),
     "k_norm": P(None),
+    # qkv projection biases follow their weight's OUTPUT dim
+    "bq": P("tp"),
+    "bk": P("tp"),
+    "bv": P("tp"),
 }
 
 
@@ -61,9 +65,9 @@ def param_sharding(logical_name: str, spec: ModelSpec, mesh: Mesh) -> NamedShard
     pspec = _SPECS.get(leaf, P(None))
     # Head-count must divide tp; otherwise replicate rather than crash.
     tp = mesh.shape.get("tp", 1)
-    if leaf in ("wq", "wo") and spec.num_heads % tp != 0:
+    if leaf in ("wq", "wo", "bq") and spec.num_heads % tp != 0:
         pspec = P(None)
-    if leaf in ("wk", "wv") and spec.num_kv_heads % tp != 0:
+    if leaf in ("wk", "wv", "bk", "bv") and spec.num_kv_heads % tp != 0:
         pspec = P(None)
     if quant_kind == "scale":
         # Per-output-channel vector: keep the weight's OUTPUT-dim axis.

@@ -86,3 +86,44 @@ def test_real_model_specs_registered():
         spec = spec_for_model(name)
         assert spec is not None
         assert spec.num_heads % spec.num_kv_heads == 0
+
+
+def test_attn_bias_models():
+    """Qwen2-style projection biases: present in the pytree and actually
+    applied (nonzero bias must change the logits)."""
+    import dataclasses
+
+    spec = dataclasses.replace(SPEC, attn_bias=True)
+    params = init_params(spec, jax.random.PRNGKey(0))
+    layer0 = params["layers"][0]
+    assert layer0["bq"].shape == (spec.q_size,)
+    assert layer0["bk"].shape == (spec.kv_size,)
+
+    tokens = jnp.asarray([[3, 7, 11]], dtype=jnp.int32)
+    valid = jnp.ones((1, 3), bool)
+    base, _ = prefill(params, spec, tokens, valid, init_kv_cache(spec, 1, 4))
+    for lay in params["layers"]:
+        lay["bq"] = jnp.ones_like(lay["bq"]) * 0.5
+    biased, _ = prefill(params, spec, tokens, valid, init_kv_cache(spec, 1, 4))
+    assert not np.allclose(np.asarray(base), np.asarray(biased), atol=1e-3)
+
+
+def test_llama3_rope_scaling():
+    """NTK-by-parts: high-frequency dims untouched, low-frequency dims
+    stretched by ~factor; tables stay bounded."""
+    from bcg_tpu.models.configs import RopeScaling
+    from bcg_tpu.models.transformer import rope_table
+
+    positions = jnp.arange(0, 16000, 500)[None, :]
+    sc = RopeScaling(factor=8.0, original_max_position=8192)
+    cos_p, sin_p = rope_table(positions, 128, 500_000.0)
+    cos_s, sin_s = rope_table(positions, 128, 500_000.0, sc)
+    # Highest-frequency dim (index 0): wavelength tiny -> identical.
+    np.testing.assert_allclose(np.asarray(cos_p[..., 0]), np.asarray(cos_s[..., 0]))
+    # Lowest-frequency dim: scaled (angle divided by factor).
+    assert not np.allclose(np.asarray(cos_p[..., -1]), np.asarray(cos_s[..., -1]))
+    assert np.isfinite(np.asarray(cos_s)).all() and np.isfinite(np.asarray(sin_s)).all()
+    # The registered Llama-3.1 spec carries the scaling config.
+    spec = spec_for_model("meta-llama/Meta-Llama-3.1-8B-Instruct")
+    assert spec.rope_scaling is not None and spec.rope_scaling.factor == 8.0
+    assert spec_for_model("Qwen/Qwen2.5-7B-Instruct").attn_bias
