@@ -80,6 +80,13 @@ def _enable_compilation_cache() -> None:
     setting = os.environ.get("BCG_TPU_XLA_CACHE", "")
     if setting.lower() in ("off", "0", "none"):
         return
+    # Default-on only for TPU: CPU AOT artifacts are keyed to the exact
+    # host feature set and reload with SIGILL-risk warnings on a
+    # different profile — and CPU compiles of the tiny test models are
+    # cheap anyway.  An explicit BCG_TPU_XLA_CACHE=<dir> still enables it
+    # anywhere.
+    if not setting and jax.default_backend() != "tpu":
+        return
     # Respect an existing user configuration (JAX_COMPILATION_CACHE_DIR
     # env or an explicit jax.config.update) — only fill in the default
     # when nothing is set.  An explicit BCG_TPU_XLA_CACHE=<dir> still

@@ -74,17 +74,24 @@ def completion_paths(
     fail to parse; the reference burns a 3-attempt retry ladder on
     exactly this, bcg_agents.py:708-759.)
 
-    Vectorised Bellman relaxation over the [states, vocab] table; the
-    iteration count is the DFA's completion diameter (tens for the BCG
-    schemas), not the state count.
+    Bellman relaxation over the state SUCCESSOR-SET matrix: the min over
+    the vocabulary only depends on which distinct states are reachable in
+    one token, so the [states, vocab] table (151936 columns for Qwen) is
+    collapsed once into a [states, states] boolean reachability matrix
+    and each iteration is a tiny masked min.  (The first version gathered
+    over the full vocab table per iteration — 18 s per schema at the
+    Qwen vocab; this form is milliseconds.)  Iteration count is the DFA's
+    completion diameter (tens for the BCG schemas), not the state count.
     """
     S, V = transitions.shape
-    dist = np.where(accepting, 0, _UNREACHABLE).astype(np.int64)
     valid = transitions >= 0
-    safe_next = np.clip(transitions, 0, None)
+    reach = np.zeros((S, S), dtype=bool)
+    src, _ = np.nonzero(valid)
+    reach[src, transitions[valid]] = True
+    dist = np.where(accepting, 0, _UNREACHABLE).astype(np.int64)
     for _ in range(S):
-        # cand[s] = 1 + min_v dist[next(s, v)]
-        d = np.where(valid, dist[safe_next], _UNREACHABLE)
+        # cand[s] = 1 + min over successor states t of dist[t]
+        d = np.where(reach, dist[None, :], _UNREACHABLE)
         cand = 1 + d.min(axis=1)
         improved = cand < dist
         if not improved.any():
