@@ -61,6 +61,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="KV cache storage dtype (int8 halves decode cache traffic)")
     p.add_argument("--no-prefix-caching", action="store_true",
                    help="Disable system-prompt KV prefix caching")
+    p.add_argument("--fault-rate", type=float, default=None,
+                   help="Corrupt this fraction of LLM responses (resilience experiments)")
+    p.add_argument("--fault-seed", type=int, default=None,
+                   help="Seed for fault injection")
     return p
 
 
@@ -101,6 +105,10 @@ def config_from_args(args) -> BCGConfig:
         engine = dataclasses.replace(engine, kv_cache_dtype=args.kv_cache_dtype)
     if args.no_prefix_caching:
         engine = dataclasses.replace(engine, prefix_caching=False)
+    if args.fault_rate is not None:
+        engine = dataclasses.replace(engine, fault_rate=args.fault_rate)
+    if args.fault_seed is not None:
+        engine = dataclasses.replace(engine, fault_seed=args.fault_seed)
     network = base.network
     if args.topology:
         network = dataclasses.replace(network, topology_type=args.topology)

@@ -96,6 +96,11 @@ class EngineConfig:
     attention_impl: str = "auto"  # auto | pallas | xla
     # Fake-backend determinism seed (ignored by the real engine).
     fake_seed: int = 0
+    # Fault injection (engine/fault.py): corrupt this seeded fraction of
+    # guided responses to exercise the retry/degradation ladder as a
+    # controlled experimental axis.  0 = off.
+    fault_rate: float = 0.0
+    fault_seed: int = 0
 
 
 @dataclass(frozen=True)

@@ -133,11 +133,12 @@ class InferenceEngine(ABC):
 
 def create_engine(engine_config, llm_config=None) -> InferenceEngine:
     """Build an engine from :class:`bcg_tpu.config.EngineConfig`."""
+    engine: InferenceEngine
     if engine_config.backend == "fake":
         from bcg_tpu.engine.fake import FakeEngine
 
-        return FakeEngine(seed=engine_config.fake_seed)
-    if engine_config.backend == "jax":
+        engine = FakeEngine(seed=engine_config.fake_seed)
+    elif engine_config.backend == "jax":
         from bcg_tpu.engine.jax_engine import JaxEngine
 
         mesh = None
@@ -150,5 +151,17 @@ def create_engine(engine_config, llm_config=None) -> InferenceEngine:
             from bcg_tpu.parallel.mesh import mesh_from_engine_config
 
             mesh = mesh_from_engine_config(engine_config)
-        return JaxEngine(engine_config, mesh=mesh)
-    raise ValueError(f"Unknown engine backend: {engine_config.backend!r}")
+        engine = JaxEngine(engine_config, mesh=mesh)
+    else:
+        raise ValueError(f"Unknown engine backend: {engine_config.backend!r}")
+    if not 0.0 <= engine_config.fault_rate <= 1.0:
+        raise ValueError(
+            f"fault_rate={engine_config.fault_rate} outside [0, 1]"
+        )
+    if engine_config.fault_rate > 0.0:
+        from bcg_tpu.engine.fault import FaultInjectingEngine
+
+        engine = FaultInjectingEngine(
+            engine, engine_config.fault_rate, engine_config.fault_seed
+        )
+    return engine

@@ -1,0 +1,89 @@
+"""Fault injection (engine/fault.py): the retry/degradation ladder as a
+controlled experimental axis."""
+
+import dataclasses
+
+import pytest
+
+from bcg_tpu.api import run_simulation
+from bcg_tpu.config import BCGConfig, EngineConfig
+from bcg_tpu.engine.fake import FakeEngine
+from bcg_tpu.engine.fault import FaultInjectingEngine
+from bcg_tpu.engine.interface import create_engine
+
+SCHEMA = {
+    "type": "object",
+    "properties": {"decision": {"type": "string", "enum": ["stop", "continue"]}},
+    "required": ["decision"],
+}
+
+
+class TestWrapper:
+    def test_rate_zero_is_identity(self):
+        inner = FakeEngine(seed=0)
+        faulty = FaultInjectingEngine(FakeEngine(seed=0), rate=0.0, seed=1)
+        prompts = [("sys", f"u{i}", SCHEMA) for i in range(6)]
+        assert faulty.batch_generate_json(prompts) == inner.batch_generate_json(prompts)
+        assert faulty.injected == 0
+
+    def test_rate_one_corrupts_everything(self):
+        faulty = FaultInjectingEngine(FakeEngine(seed=0), rate=1.0, seed=2)
+        out = faulty.batch_generate_json([("sys", "u", SCHEMA)] * 8)
+        assert faulty.injected == 8
+        # Every corruption must FAIL the validity predicates one way or
+        # another: error key, missing field, wrong type, or short string.
+        for r in out:
+            valid = (
+                isinstance(r.get("decision"), str)
+                and r["decision"] in ("stop", "continue")
+                and "error" not in r
+            )
+            assert not valid, r
+
+    def test_bad_rate_rejected(self):
+        with pytest.raises(ValueError):
+            FaultInjectingEngine(FakeEngine(seed=0), rate=1.5)
+
+    def test_negative_rate_rejected_at_create_engine(self):
+        with pytest.raises(ValueError, match="fault_rate"):
+            create_engine(EngineConfig(backend="fake", fault_rate=-0.2))
+
+    def test_byzantine_shape_corruptions_always_invalid(self):
+        """drop_field / wrong_type must hit a field the Byzantine validity
+        predicate checks (public_reasoning is unchecked for Byzantine),
+        so nominal rate == effective rate."""
+        from bcg_tpu.runtime.orchestrator import BCGSimulation
+
+        faulty = FaultInjectingEngine(FakeEngine(seed=0), rate=1.0, seed=5)
+        byz = {"internal_strategy": "lurk quietly", "value": 12,
+               "public_reasoning": "blend in with the honest agents"}
+        for _ in range(40):
+            corrupted = faulty._corrupt(dict(byz))
+            assert not BCGSimulation._is_valid_byzantine_decision_response(corrupted), corrupted
+
+    def test_create_engine_wraps(self):
+        cfg = EngineConfig(backend="fake", fault_rate=0.5, fault_seed=3)
+        engine = create_engine(cfg)
+        assert isinstance(engine, FaultInjectingEngine)
+        assert engine.rate == 0.5
+
+
+class TestGameUnderFaults:
+    @pytest.mark.parametrize("rate", [0.2, 0.5])
+    def test_game_completes_and_degrades_gracefully(self, rate):
+        base = BCGConfig()
+        cfg = dataclasses.replace(
+            base,
+            engine=dataclasses.replace(
+                base.engine, backend="fake", fault_rate=rate, fault_seed=11
+            ),
+        )
+        out = run_simulation(
+            n_agents=4, byzantine_count=1, max_rounds=5, backend="fake",
+            seed=4, config=cfg,
+        )
+        m = out["metrics"]
+        # The game must never crash: faults degrade to retries, abstains,
+        # and CONTINUE votes (reference main.py:348-351,451-454 semantics).
+        assert "consensus_reached" in m
+        assert m["total_rounds"] >= 1
