@@ -31,7 +31,7 @@ class FaultInjectingEngine(InferenceEngine):
 
     def __init__(self, engine: InferenceEngine, rate: float, seed: int = 0):
         if not 0.0 <= rate <= 1.0:
-            raise ValueError(f"fault rate {rate} outside [0, 1]")
+            raise ValueError(f"fault_rate={rate} outside [0, 1]")
         self._engine = engine
         self.rate = rate
         self.rng = random.Random(seed)

@@ -133,6 +133,12 @@ class InferenceEngine(ABC):
 
 def create_engine(engine_config, llm_config=None) -> InferenceEngine:
     """Build an engine from :class:`bcg_tpu.config.EngineConfig`."""
+    if not 0.0 <= engine_config.fault_rate <= 1.0:
+        # Fail BEFORE any engine boot: a config typo must not cost a
+        # multi-GB weight load first.
+        raise ValueError(
+            f"fault_rate={engine_config.fault_rate} outside [0, 1]"
+        )
     engine: InferenceEngine
     if engine_config.backend == "fake":
         from bcg_tpu.engine.fake import FakeEngine
@@ -154,10 +160,6 @@ def create_engine(engine_config, llm_config=None) -> InferenceEngine:
         engine = JaxEngine(engine_config, mesh=mesh)
     else:
         raise ValueError(f"Unknown engine backend: {engine_config.backend!r}")
-    if not 0.0 <= engine_config.fault_rate <= 1.0:
-        raise ValueError(
-            f"fault_rate={engine_config.fault_rate} outside [0, 1]"
-        )
     if engine_config.fault_rate > 0.0:
         from bcg_tpu.engine.fault import FaultInjectingEngine
 

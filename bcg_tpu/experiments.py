@@ -93,6 +93,7 @@ def run_preset(
     honest: Optional[int] = None,
     byzantine: Optional[int] = None,
     concurrency: int = 1,
+    fault_rate: float = 0.0,
 ) -> Dict:
     """Run a preset ``runs`` times and aggregate.
 
@@ -102,9 +103,22 @@ def run_preset(
     G concurrent games cost roughly one game's wall-clock.  The reference
     has no equivalent: its sweeps are sequential CLI invocations
     (README.md:55-70).
+
+    ``fault_rate`` corrupts that fraction of LLM responses per run
+    (engine/fault.py), making resilience-vs-fault-rate curves a one-flag
+    sweep.
     """
+    import dataclasses
+
+    from bcg_tpu.api import resolve_engine_config
+    from bcg_tpu.config import BCGConfig
+
     n_honest = honest if honest is not None else preset.honest
     n_byz = byzantine if byzantine is not None else preset.byzantine
+    engine_cfg = dataclasses.replace(
+        resolve_engine_config(model_name, backend), fault_rate=fault_rate
+    )
+    base_cfg = dataclasses.replace(BCGConfig(), engine=engine_cfg)
 
     def make_run(r: int):
         def go(engine=None):
@@ -117,15 +131,15 @@ def run_preset(
                 backend=backend,
                 seed=None if seed is None else seed + r,
                 engine=engine,
+                config=base_cfg,
             )
         return go
 
     if concurrency > 1:
-        from bcg_tpu.api import resolve_engine_config
         from bcg_tpu.engine.collective import run_concurrent_simulations
         from bcg_tpu.engine.interface import create_engine
 
-        engine = create_engine(resolve_engine_config(model_name, backend))
+        engine = create_engine(engine_cfg)
         try:
             outs = run_concurrent_simulations(
                 engine, [make_run(r) for r in range(runs)], concurrency
@@ -172,11 +186,14 @@ def main(argv: Optional[List[str]] = None) -> None:
     p.add_argument("--concurrency", type=int, default=1,
                    help="Games run at once against one shared engine "
                         "(merged device batches; bound by KV-cache memory)")
+    p.add_argument("--fault-rate", type=float, default=0.0,
+                   help="Corrupt this fraction of LLM responses per run "
+                        "(resilience-vs-fault-rate sweeps)")
     args = p.parse_args(argv)
 
     common = dict(runs=args.runs, model_name=args.model, backend=args.backend,
                   max_rounds=args.rounds, seed=args.seed,
-                  concurrency=args.concurrency)
+                  concurrency=args.concurrency, fault_rate=args.fault_rate)
     if args.preset == "scale-sweep":
         out = run_scale_sweep(
             [int(x) for x in args.agents.split(",")],
