@@ -61,6 +61,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="KV cache storage dtype (int8 halves decode cache traffic)")
     p.add_argument("--no-prefix-caching", action="store_true",
                    help="Disable system-prompt KV prefix caching")
+    p.add_argument("--fast-forward", action="store_true",
+                   help="Forced-chain fast-forward decoding (skeleton tokens ride the sampled token's weight pass)")
+    p.add_argument("--compact-json", action="store_true",
+                   help="Compact-JSON generation grammar (no inter-token whitespace)")
     p.add_argument("--fault-rate", type=float, default=None,
                    help="Corrupt this fraction of LLM responses (resilience experiments)")
     p.add_argument("--fault-seed", type=int, default=None,
@@ -105,6 +109,10 @@ def config_from_args(args) -> BCGConfig:
         engine = dataclasses.replace(engine, kv_cache_dtype=args.kv_cache_dtype)
     if args.no_prefix_caching:
         engine = dataclasses.replace(engine, prefix_caching=False)
+    if args.fast_forward:
+        engine = dataclasses.replace(engine, decode_fast_forward=True)
+    if args.compact_json:
+        engine = dataclasses.replace(engine, guided_compact_json=True)
     if args.fault_rate is not None:
         engine = dataclasses.replace(engine, fault_rate=args.fault_rate)
     if args.fault_seed is not None:

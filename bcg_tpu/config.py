@@ -92,6 +92,16 @@ class EngineConfig:
     # KV across every round's calls (auto-disabled for template families
     # whose prefix/suffix split is not a special-token boundary).
     prefix_caching: bool = True
+    # Forced-chain fast-forward: ride each sampled token's DFA-forced
+    # continuation (JSON skeleton) through the same decode weight pass.
+    # Greedy-equivalent to the standard loop; costs FF_CHUNK x decode
+    # cache slots; bf16 KV only.
+    decode_fast_forward: bool = False
+    # Compact-JSON generation grammar: no inter-token whitespace (fewer
+    # decoded tokens, longer forced chains).  Output is still valid JSON;
+    # off by default for byte-compatibility with the reference's
+    # whitespace-tolerant guided outputs.
+    guided_compact_json: bool = False
     disable_qwen3_thinking: bool = True
     attention_impl: str = "auto"  # auto | pallas | xla
     # Fake-backend determinism seed (ignored by the real engine).

@@ -38,6 +38,7 @@ from bcg_tpu.engine.tokenizer import Tokenizer, tokenizer_for_model
 from bcg_tpu.guided.processor import GuidedBatch, compile_schema
 from bcg_tpu.models.configs import ModelSpec, spec_for_model
 from bcg_tpu.models.transformer import (
+    decode_chunk,
     decode_step,
     init_kv_cache,
     init_params,
@@ -195,6 +196,15 @@ class JaxEngine(InferenceEngine):
                 stacklevel=2,
             )
         self.max_model_len = config.max_model_len
+        # Forced-chain fast-forward (guided/processor.py FF_CHUNK): each
+        # decode step carries the sampled token plus its DFA-forced
+        # continuation (JSON skeleton) in one weight pass.  bf16 KV only:
+        # the chunk path attends over the raw cache.
+        self.fast_forward = bool(getattr(config, "decode_fast_forward", False))
+        if self.fast_forward and self.kv_quantized:
+            raise ValueError(
+                "decode_fast_forward requires kv_cache_dtype='bfloat16'"
+            )
 
         quantize = config.quantization == "int8"
         owns_params = params is None
@@ -365,7 +375,8 @@ class JaxEngine(InferenceEngine):
         self._prefix_cache[prefix] = entry
         return entry
 
-    def _prepare_prefixed_batch(self, parts, budgets: List[int]):
+    def _prepare_prefixed_batch(self, parts, budgets: List[int],
+                                decode_slots: Optional[int] = None):
         """Assemble a batch whose cache slots [0, P) are prefilled prefix
         KV (gathered per row from the prefix cache) and whose suffix is
         left-padded into [P, P+Ls).  Returns None when any prefix cannot
@@ -395,7 +406,7 @@ class JaxEngine(InferenceEngine):
         B = len(parts)
 
         gid = np.array([uniq.index(p) for p, _ in parts], dtype=np.int32)
-        tail = Ls + max_new + 1
+        tail = Ls + (decode_slots if decode_slots is not None else max_new + 1)
 
         def stack(layer_idx, name, pad_axis, pad_value, tail_shape_fn):
             """[G, ...] stacked entry arrays padded to P, gathered to [B, ...],
@@ -439,6 +450,66 @@ class JaxEngine(InferenceEngine):
 
     # ------------------------------------------------------------ decode loop
 
+    @staticmethod
+    def _make_masked_sampler(eos_id: int, top_p: float):
+        """The guided sampler shared VERBATIM by the standard and
+        fast-forward decode loops (the greedy-equivalence guarantee
+        between them depends on a single implementation).
+
+        Guaranteed parse: a token is only allowed if the state it leads
+        to can still reach acceptance within the remaining budget
+        (min_budget precomputed per (state, token) in GuidedBatch), so
+        the sampler can never truncate into invalid JSON — e.g. with 7
+        tokens left it cannot open a minLength-10 string, and at the
+        exact boundary only shortest-completion tokens survive the mask.
+        vLLM has no equivalent: its guided output just cuts off at
+        max_tokens and fails to parse, which is what the reference's
+        3-attempt retry ladder (bcg_agents.py:708-759) exists to absorb.
+        min_budget also encodes "forbidden" (sentinel), so this one
+        gather is the entire mask.
+        """
+        use_top_p = top_p < 1.0
+
+        def masked_sample(logits, states, rng, emitted,
+                          tables, accepting, min_budget, dfa_ids,
+                          row_temp, row_budget):
+            clamped = jnp.maximum(states, 0)
+            budget_left = row_budget - emitted           # [B], incl. this token
+            allowed = min_budget[dfa_ids, clamped] <= budget_left[:, None]
+            eos_ok = accepting[dfa_ids, clamped]
+            any_tok = allowed.any(axis=-1)
+            greedy_row = row_temp <= 0.0                 # [B]
+            safe_temp = jnp.where(greedy_row, 1.0, row_temp)[:, None]
+            scaled = logits / safe_temp
+            lg = jnp.where(allowed, scaled, -jnp.inf)
+            # EOS is legal exactly at accepting states (same temperature
+            # scaling as every other token).
+            lg = lg.at[:, eos_id].set(
+                jnp.where(eos_ok, scaled[:, eos_id], -jnp.inf)
+            )
+            if use_top_p:
+                # Nucleus filter: keep the smallest prefix of the sorted
+                # distribution whose mass reaches top_p.
+                probs = jax.nn.softmax(lg, axis=-1)
+                sorted_probs = jnp.sort(probs, axis=-1)[:, ::-1]
+                cum = jnp.cumsum(sorted_probs, axis=-1)
+                cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+                cutoff = jnp.take_along_axis(sorted_probs, cutoff_idx, axis=-1)
+                lg = jnp.where(probs >= cutoff, lg, -jnp.inf)
+            rng, sub = jax.random.split(rng)
+            tok = jnp.where(
+                greedy_row,
+                jnp.argmax(lg, axis=-1),
+                jax.random.categorical(sub, lg, axis=-1),
+            )
+            # Dead end (no token allowed): force EOS.
+            tok = jnp.where(~any_tok, eos_id, tok)
+            next_states = tables[dfa_ids, clamped, tok].astype(jnp.int32)
+            next_states = jnp.where(tok == eos_id, -1, next_states)
+            return tok.astype(jnp.int32), next_states, rng
+
+        return masked_sample
+
     def _get_decode_loop(self, guided_sig: Tuple, max_new: int,
                          top_p: float = 1.0):
         """Build (or fetch) the compiled guided decode loop for a shape
@@ -457,62 +528,18 @@ class JaxEngine(InferenceEngine):
         spec = self.spec
         impl = self.decode_attention_impl
         eos_id = self.tokenizer.eos_id
-        use_top_p = top_p < 1.0
+        sampler = self._make_masked_sampler(eos_id, top_p)
 
         def loop(params, cache, first_logits, valid_mask, prompt_lens, L,
                  tables, accepting, min_budget, dfa_ids, init_states,
                  row_temp, row_budget, rng):
             B = first_logits.shape[0]
-            V = first_logits.shape[1]
 
             def masked_sample(logits, states, rng, pos):
-                clamped = jnp.maximum(states, 0)
-                # Guaranteed parse: a token is only allowed if the state
-                # it leads to can still reach acceptance within the
-                # remaining budget (min_budget precomputed per (state,
-                # token) in GuidedBatch).  The sampler can therefore never
-                # truncate into invalid JSON — e.g. with 7 tokens left it
-                # cannot open a minLength-10 string, and at the exact
-                # boundary only shortest-completion tokens survive the
-                # mask.  vLLM has no equivalent: its guided output just
-                # cuts off at max_tokens and fails to parse, which is what
-                # the reference's 3-attempt retry ladder
-                # (bcg_agents.py:708-759) exists to absorb.  min_budget
-                # also encodes "forbidden" (sentinel), so this one gather
-                # is the entire mask.
-                budget_left = row_budget - pos               # [B], incl. this token
-                allowed = min_budget[dfa_ids, clamped] <= budget_left[:, None]
-                eos_ok = accepting[dfa_ids, clamped]
-                any_tok = allowed.any(axis=-1)
-                greedy_row = row_temp <= 0.0                 # [B]
-                safe_temp = jnp.where(greedy_row, 1.0, row_temp)[:, None]
-                scaled = logits / safe_temp
-                lg = jnp.where(allowed, scaled, -jnp.inf)
-                # EOS is legal exactly at accepting states (same
-                # temperature scaling as every other token).
-                lg = lg.at[:, eos_id].set(
-                    jnp.where(eos_ok, scaled[:, eos_id], -jnp.inf)
+                return sampler(
+                    logits, states, rng, pos, tables, accepting,
+                    min_budget, dfa_ids, row_temp, row_budget,
                 )
-                if use_top_p:
-                    # Nucleus filter: keep the smallest prefix of the
-                    # sorted distribution whose mass reaches top_p.
-                    probs = jax.nn.softmax(lg, axis=-1)
-                    sorted_probs = jnp.sort(probs, axis=-1)[:, ::-1]
-                    cum = jnp.cumsum(sorted_probs, axis=-1)
-                    cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
-                    cutoff = jnp.take_along_axis(sorted_probs, cutoff_idx, axis=-1)
-                    lg = jnp.where(probs >= cutoff, lg, -jnp.inf)
-                rng, sub = jax.random.split(rng)
-                tok = jnp.where(
-                    greedy_row,
-                    jnp.argmax(lg, axis=-1),
-                    jax.random.categorical(sub, lg, axis=-1),
-                )
-                # Dead end (no token allowed): force EOS.
-                tok = jnp.where(~any_tok, eos_id, tok)
-                next_states = tables[dfa_ids, clamped, tok].astype(jnp.int32)
-                next_states = jnp.where(tok == eos_id, -1, next_states)
-                return tok.astype(jnp.int32), next_states, rng
 
             def cond(carry):
                 # Position max_new-1 is the last output slot, written by
@@ -557,6 +584,95 @@ class JaxEngine(InferenceEngine):
         self._decode_loops[key] = compiled
         return compiled
 
+    def _get_ff_decode_loop(self, guided_sig: Tuple, max_new: int,
+                            top_p: float = 1.0):
+        """Fast-forward decode loop: every iteration samples ONE token and
+        rides its DFA-forced continuation (up to FF_CHUNK-1 skeleton
+        tokens) through the same weight pass (models/transformer.py
+        decode_chunk).  Cache slots advance K per iteration with per-row
+        gaps masked out of attention; RoPE positions stay contiguous per
+        row.  Greedy outputs are bit-identical to the standard loop; the
+        win is weight-streaming passes ~ sampled tokens, not total tokens.
+        """
+        from bcg_tpu.guided.processor import FF_CHUNK as K
+
+        key = ("ff", guided_sig, int(max_new), float(top_p),
+               self.attention_impl)
+        if key in self._decode_loops:
+            return self._decode_loops[key]
+
+        spec = self.spec
+        eos_id = self.tokenizer.eos_id
+        sampler = self._make_masked_sampler(eos_id, top_p)
+
+        def loop(params, cache, first_logits, valid_mask, prompt_lens, L,
+                 tables, accepting, min_budget, dfa_ids, init_states,
+                 chain_tok, chain_len, chain_next,
+                 row_temp, row_budget, rng):
+            B = first_logits.shape[0]
+
+            def masked_sample(logits, states, rng, emitted):
+                return sampler(
+                    logits, states, rng, emitted, tables, accepting,
+                    min_budget, dfa_ids, row_temp, row_budget,
+                )
+
+            def cond(carry):
+                i, done, *_ = carry
+                return (i < max_new) & ~done.all()
+
+            def body(carry):
+                (i, done, emitted, states, logits, cache, valid_mask,
+                 out, rng) = carry
+                tok, ns, rng = masked_sample(logits, states, rng, emitted)
+                tok = jnp.where(done, eos_id, tok)
+                finished = tok == eos_id
+                clamped_ns = jnp.maximum(ns, 0)
+                # Forced continuation of the sampled token (none for EOS
+                # or already-done rows).
+                cl = jnp.where(
+                    done | finished, 0, chain_len[dfa_ids, clamped_ns]
+                )
+                ct = chain_tok[dfa_ids, clamped_ns]        # [B, K-1]
+                chunk = jnp.concatenate([tok[:, None], ct], axis=1)  # [B, K]
+                j = jnp.arange(K)[None, :]
+                chunk_valid = (j == 0) | (j - 1 < cl[:, None])
+                # Write real tokens into out at per-row offsets (invalid
+                # and already-done positions -> dropped via OOB index).
+                write_idx = jnp.where(
+                    chunk_valid & ~done[:, None],
+                    emitted[:, None] + j, max_new,
+                )
+                out = out.at[
+                    jnp.arange(B)[:, None], write_idx
+                ].set(chunk, mode="drop")
+                positions = (prompt_lens + emitted)[:, None] + j
+                logits, cache = decode_chunk(
+                    params, spec, chunk, chunk_valid, L + i * K, positions,
+                    cache, valid_mask, impl="xla",
+                )
+                valid_mask = jax.lax.dynamic_update_slice(
+                    valid_mask, chunk_valid, (0, L + i * K)
+                )
+                emitted = jnp.where(done, emitted, emitted + 1 + cl)
+                states = jnp.where(done, states, chain_next[dfa_ids, clamped_ns])
+                states = jnp.where(finished, -1, states)
+                done = done | finished
+                return (i + 1, done, emitted, states, logits, cache,
+                        valid_mask, out, rng)
+
+            out = jnp.full((B, max_new), eos_id, dtype=jnp.int32)
+            carry = (jnp.int32(0), jnp.zeros((B,), bool),
+                     jnp.zeros((B,), jnp.int32), init_states.astype(jnp.int32),
+                     first_logits, cache, valid_mask, out, rng)
+            (i, done, emitted, states, logits, cache, valid_mask, out,
+             rng) = jax.lax.while_loop(cond, body, carry)
+            return out, (rng, i)
+
+        compiled = jax.jit(loop, static_argnames=("L",), donate_argnums=(1,))
+        self._decode_loops[key] = compiled
+        return compiled
+
     def _run_guided(
         self,
         parts: List[Tuple[str, str]],
@@ -588,7 +704,10 @@ class JaxEngine(InferenceEngine):
             parts, schemas, temps, budgets
         )
         guides = [
-            compile_schema(s, self._token_bytes, vocab_id=self.tokenizer.vocab_id)
+            compile_schema(
+                s, self._token_bytes, vocab_id=self.tokenizer.vocab_id,
+                compact=getattr(self.config, "guided_compact_json", False),
+            )
             for s in schemas
         ]
         batch = GuidedBatch(guides)
@@ -609,11 +728,23 @@ class JaxEngine(InferenceEngine):
         otherwise the joined full prompts take the plain path."""
         B = len(parts)
         max_new = max(budgets)
-        self._check_kv_budget(B, budgets)
+        # Fast-forward only pays off when the automaton HAS forced chains;
+        # the free path's permissive automaton has none, so it would buy
+        # 4x decode cache and padded chunks for zero skipped steps.
+        use_ff = self.fast_forward and sig_prefix[0] != "free"
+        self._check_kv_budget(B, budgets, fast_forward=use_ff)
+        if use_ff:
+            from bcg_tpu.guided.processor import FF_CHUNK
+
+            # Chunk slots advance FF_CHUNK per iteration (gaps for short
+            # chains), and iterations are bounded by max_new.
+            decode_slots = max_new * FF_CHUNK
+        else:
+            decode_slots = max_new + 1
         t0 = time.perf_counter()
         prepped = None
         if self.prefix_caching and self._prefix_safe and all(p for p, _ in parts):
-            prepped = self._prepare_prefixed_batch(parts, budgets)
+            prepped = self._prepare_prefixed_batch(parts, budgets, decode_slots)
         if prepped is not None:
             tokens, valid, Ls, cache, prefix_valid, prefix_lens, P = prepped
             first_logits, cache = self._prefill_suffix(
@@ -623,7 +754,7 @@ class JaxEngine(InferenceEngine):
                 prefix_lens=jnp.asarray(prefix_lens),
             )
             L = P + Ls
-            S = L + max_new + 1
+            S = L + decode_slots
             valid_mask = np.zeros((B, S), dtype=bool)
             valid_mask[:, :P] = prefix_valid
             valid_mask[:, P:L] = valid
@@ -632,13 +763,13 @@ class JaxEngine(InferenceEngine):
             full_prompts = [p + s for p, s in parts]
             tokens, valid, L = self._prepare_batch(full_prompts, budgets)
             cache = init_kv_cache(
-                self.spec, B, L + max_new + 1, quantized=self.kv_quantized
+                self.spec, B, L + decode_slots, quantized=self.kv_quantized
             )
             first_logits, cache = self._prefill(
                 self.params, tokens=jnp.asarray(tokens), valid=jnp.asarray(valid),
                 cache=cache,
             )
-            S = L + max_new + 1
+            S = L + decode_slots
             valid_mask = np.zeros((B, S), dtype=bool)
             valid_mask[:, :L] = valid
             prompt_lens = valid.sum(axis=1).astype(np.int32)
@@ -646,16 +777,28 @@ class JaxEngine(InferenceEngine):
             first_logits.block_until_ready()
         t1 = time.perf_counter()
 
-        loop = self._get_decode_loop(sig_prefix + (B, L), max_new, top_p)
         self._key, sub = jax.random.split(self._key)
-        out, (_, steps) = loop(
-            self.params, cache, first_logits, jnp.asarray(valid_mask),
-            jnp.asarray(prompt_lens), L,
-            batch.tables, batch.accepting, batch.min_budget,
-            batch.dfa_ids, batch.init_states,
-            jnp.asarray(temps, jnp.float32), jnp.asarray(budgets, jnp.int32),
-            sub,
-        )
+        if use_ff:
+            loop = self._get_ff_decode_loop(sig_prefix + (B, L), max_new, top_p)
+            out, (_, steps) = loop(
+                self.params, cache, first_logits, jnp.asarray(valid_mask),
+                jnp.asarray(prompt_lens), L,
+                batch.tables, batch.accepting, batch.min_budget,
+                batch.dfa_ids, batch.init_states,
+                batch.chain_tok, batch.chain_len, batch.chain_next,
+                jnp.asarray(temps, jnp.float32), jnp.asarray(budgets, jnp.int32),
+                sub,
+            )
+        else:
+            loop = self._get_decode_loop(sig_prefix + (B, L), max_new, top_p)
+            out, (_, steps) = loop(
+                self.params, cache, first_logits, jnp.asarray(valid_mask),
+                jnp.asarray(prompt_lens), L,
+                batch.tables, batch.accepting, batch.min_budget,
+                batch.dfa_ids, batch.init_states,
+                jnp.asarray(temps, jnp.float32), jnp.asarray(budgets, jnp.int32),
+                sub,
+            )
         out_np = np.asarray(out)
         if _TIMING:
             print(
@@ -672,7 +815,8 @@ class JaxEngine(InferenceEngine):
             texts.append(self.tokenizer.decode(row.tolist()))
         return texts
 
-    def _check_kv_budget(self, B: int, budgets: List[int]) -> None:
+    def _check_kv_budget(self, B: int, budgets: List[int],
+                         fast_forward: bool = False) -> None:
         """hbm_utilization as an OOM guard (the reference's
         ``gpu_memory_utilization``, config.py:36): warn — once — when the
         worst-case KV cache for this batch would push past the budgeted
@@ -682,8 +826,14 @@ class JaxEngine(InferenceEngine):
         spec = self.spec
         # Worst case for a mixed-budget batch: a min-budget row's prompt
         # window (max_model_len - min - 1) plus the batch-wide decode
-        # reservation (max + 1) — S can exceed max_model_len itself.
-        S = self.max_model_len - min(budgets) + max(budgets)
+        # reservation — FF_CHUNK slots per token under fast-forward.
+        if fast_forward:
+            from bcg_tpu.guided.processor import FF_CHUNK
+
+            decode_res = max(budgets) * FF_CHUNK
+        else:
+            decode_res = max(budgets) + 1
+        S = self.max_model_len - min(budgets) - 1 + decode_res
         kv_bytes_per_slot = spec.num_kv_heads * spec.head_dim * 2  # k+v
         kv_bytes_per_slot *= 1 if self.kv_quantized else 2
         kv_total = B * S * kv_bytes_per_slot * spec.num_layers

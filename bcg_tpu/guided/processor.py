@@ -52,18 +52,26 @@ def compile_schema(
     token_bytes: Sequence[bytes],
     vocab_id: int = 0,
     force_numpy: bool = False,
+    compact: bool = False,
 ) -> SchemaGuide:
-    """Schema -> token DFA, cached per (schema, vocabulary).
+    """Schema -> token DFA, cached per (schema, vocabulary, compactness).
 
     ``vocab_id`` identifies the tokenizer (vocabularies are large; callers
     pass a stable id rather than hashing the bytes).  The vocabulary size
-    is folded into the key as a safety net against id collisions."""
-    key = (schema_cache_key(schema), vocab_id, len(token_bytes))
+    is folded into the key as a safety net against id collisions.
+    ``compact=True`` removes inter-token whitespace from the GENERATION
+    grammar (fewer decoded tokens, longer forced skeleton chains)."""
+    key = (
+        ("compact:" if compact else "") + schema_cache_key(schema),
+        vocab_id, len(token_bytes),
+    )
     with _cache_lock:
         hit = _cache.get(key)
     if hit is not None:
         return hit
-    char_dfa = ast_to_dfa(schema_to_ast(schema))
+    from bcg_tpu.guided.regex_ast import EPS
+
+    char_dfa = ast_to_dfa(schema_to_ast(schema, ws=EPS if compact else None))
     token_dfa = build_token_dfa(char_dfa, token_bytes, force_numpy=force_numpy)
     guide = SchemaGuide(
         token_dfa=token_dfa, schema_key=key[0], vocab_key=(vocab_id, len(token_bytes))
@@ -87,6 +95,45 @@ _TABLE_CACHE_MAX = 8
 # int16 sentinel for "token forbidden / acceptance unreachable" in the
 # min-budget table; any real budget (max_tokens) is far below it.
 _MINB_INF = np.iinfo(np.int16).max
+
+# Forced-chain fast-forward chunk: after each sampled token, up to
+# FF_CHUNK-1 DFA-forced tokens (states with exactly one legal token —
+# JSON skeleton) are processed in the same device step.  4 keeps the
+# padded-chunk MXU overhead below the per-step weight-streaming cost it
+# saves (see engine/jax_engine.py fast-forward loop).
+FF_CHUNK = 4
+
+
+def _forced_chains(transitions: np.ndarray, accepting: np.ndarray):
+    """Per-state forced-token chains of length <= FF_CHUNK-1.
+
+    A state is *forced* when it is non-accepting and allows exactly one
+    token (EOS is an alternative at accepting states, so those are choice
+    points).  Returns (chain_tok [S, FF_CHUNK-1] int32,
+    chain_len [S] int32, chain_next [S] int32): the forced continuation
+    STARTING at each state, the number of forced tokens, and the state
+    reached after consuming them.  Chains may traverse forced cycles —
+    bounded by FF_CHUNK-1, and unreachable in practice because tokens
+    entering a no-accept cycle are masked by guaranteed-parse budgets.
+    """
+    S = transitions.shape[0]
+    allowed = transitions >= 0
+    cnt = allowed.sum(axis=1)
+    forced = (cnt == 1) & ~accepting
+    ftok = np.argmax(allowed, axis=1).astype(np.int32)      # valid iff forced
+    fnext = transitions[np.arange(S), ftok].astype(np.int32)
+
+    chain_tok = np.zeros((S, FF_CHUNK - 1), dtype=np.int32)
+    chain_len = np.zeros(S, dtype=np.int32)
+    chain_next = np.arange(S, dtype=np.int32)
+    cur = np.arange(S, dtype=np.int32)
+    for j in range(FF_CHUNK - 1):
+        ext = forced[cur] & (chain_len == j)
+        chain_tok[ext, j] = ftok[cur[ext]]
+        chain_next[ext] = fnext[cur[ext]]
+        chain_len[ext] += 1
+        cur = np.where(ext, fnext[cur], cur)
+    return chain_tok, chain_len, chain_next
 
 
 class GuidedBatch:
@@ -119,6 +166,9 @@ class GuidedBatch:
             s_max = max(g.token_dfa.num_states for g in unique)
             tables = np.full((len(unique), s_max, vocab), -1, dtype=np.int32)
             accepting = np.zeros((len(unique), s_max), dtype=bool)
+            chain_tok = np.zeros((len(unique), s_max, FF_CHUNK - 1), dtype=np.int32)
+            chain_len = np.zeros((len(unique), s_max), dtype=np.int32)
+            chain_next = np.tile(np.arange(s_max, dtype=np.int32), (len(unique), 1))
             # min_budget[u, s, t]: tokens of budget (including t itself)
             # needed to take token t from state s and still reach
             # acceptance; _MINB_INF where t is forbidden.  Precomputing
@@ -136,6 +186,10 @@ class GuidedBatch:
                 minb[i, : td.num_states] = np.where(
                     valid, np.minimum(nd, _MINB_INF), _MINB_INF
                 ).astype(np.int16)
+                ct, cl, cn = _forced_chains(td.transitions, td.accepting)
+                chain_tok[i, : td.num_states] = ct
+                chain_len[i, : td.num_states] = cl
+                chain_next[i, : td.num_states] = cn
                 starts[i] = td.start
             # State counts are small (<100 for the BCG schemas); int16
             # halves the HBM footprint of the stacked table.
@@ -144,12 +198,15 @@ class GuidedBatch:
             hit = (
                 jnp.asarray(tables), jnp.asarray(accepting),
                 jnp.asarray(minb), starts,
+                jnp.asarray(chain_tok), jnp.asarray(chain_len),
+                jnp.asarray(chain_next),
             )
             with _table_cache_lock:
                 _table_cache[cache_key] = hit
                 while len(_table_cache) > _TABLE_CACHE_MAX:
                     _table_cache.popitem(last=False)
-        self.tables, self.accepting, self.min_budget, starts = hit
+        (self.tables, self.accepting, self.min_budget, starts,
+         self.chain_tok, self.chain_len, self.chain_next) = hit
         self.dfa_ids = jnp.asarray(np.array(dfa_ids, dtype=np.int32))
         self.init_states = jnp.asarray(starts[np.array(dfa_ids)])
         self.num_unique = len(unique)
@@ -190,6 +247,9 @@ class GuidedBatch:
         self.tables = jnp.zeros((1, 1, vocab_size), dtype=jnp.int16)
         self.accepting = jnp.ones((1, 1), dtype=bool)
         self.min_budget = jnp.ones((1, 1, vocab_size), dtype=jnp.int16)
+        self.chain_tok = jnp.zeros((1, 1, FF_CHUNK - 1), dtype=jnp.int32)
+        self.chain_len = jnp.zeros((1, 1), dtype=jnp.int32)
+        self.chain_next = jnp.zeros((1, 1), dtype=jnp.int32)
         self.dfa_ids = jnp.zeros((batch_size,), dtype=jnp.int32)
         self.init_states = jnp.zeros((batch_size,), dtype=jnp.int32)
         self.num_unique = 1

@@ -152,8 +152,16 @@ def number_ast() -> Node:
     return seq(opt(char("-")), integer, opt(frac), opt(exp))
 
 
-def schema_to_ast(schema: Dict[str, Any]) -> Node:
-    """Compile a JSON schema into a regex AST for its serialized form."""
+def schema_to_ast(schema: Dict[str, Any], ws: Optional[Node] = None) -> Node:
+    """Compile a JSON schema into a regex AST for its serialized form.
+
+    ``ws`` is the inter-token whitespace grammar: the bounded default
+    ``WS`` (compact + shallow pretty-print forms), or ``EPS`` for
+    compact-only GENERATION — fewer tokens to decode and longer
+    DFA-forced skeleton chains for fast-forward (the parse direction is
+    unaffected; emitted JSON is always valid either way)."""
+    if ws is None:
+        ws = WS
     if "enum" in schema:
         options = []
         for v in schema["enum"]:
@@ -168,11 +176,11 @@ def schema_to_ast(schema: Dict[str, Any]) -> Node:
         return alt(*options)
 
     if "anyOf" in schema:
-        return alt(*(schema_to_ast(s) for s in schema["anyOf"]))
+        return alt(*(schema_to_ast(s, ws) for s in schema["anyOf"]))
 
     t = schema.get("type")
     if t == "object":
-        return _object_ast(schema)
+        return _object_ast(schema, ws)
     if t == "string":
         return string_ast(
             min_len=schema.get("minLength", 0),
@@ -188,16 +196,16 @@ def schema_to_ast(schema: Dict[str, Any]) -> Node:
         return literal("null")
     if t == "array":
         item = schema.get("items", {"type": "string"})
-        inner = schema_to_ast(item)
-        items = opt(seq(inner, star(seq(WS, char(","), WS, inner))))
-        return seq(char("["), WS, items, WS, char("]"))
+        inner = schema_to_ast(item, ws)
+        items = opt(seq(inner, star(seq(ws, char(","), ws, inner))))
+        return seq(char("["), ws, items, ws, char("]"))
     raise ValueError(f"Unsupported schema: {schema!r}")
 
 
 _MAX_OPTIONAL_PROPS = 8
 
 
-def _object_ast(schema: Dict[str, Any]) -> Node:
+def _object_ast(schema: Dict[str, Any], ws: Optional[Node] = None) -> Node:
     """Object with properties emitted in declaration order (outlines-
     compatible: the model must emit keys in schema order).
 
@@ -206,6 +214,8 @@ def _object_ast(schema: Dict[str, Any]) -> Node:
     Optional properties anywhere in the order are supported by
     enumerating the presence subsets (bounded by ``_MAX_OPTIONAL_PROPS``
     to keep the automaton small)."""
+    if ws is None:
+        ws = WS
     props = schema.get("properties", {})
     required = set(schema.get("required", []))
     unknown = required - set(props)
@@ -214,11 +224,11 @@ def _object_ast(schema: Dict[str, Any]) -> Node:
 
     members = []
     for name, sub in props.items():
-        member = seq(json_string_literal(name), WS, char(":"), WS, schema_to_ast(sub))
+        member = seq(json_string_literal(name), ws, char(":"), ws, schema_to_ast(sub, ws))
         members.append((name, member, name in required))
 
     if not members:
-        return seq(char("{"), WS, char("}"))
+        return seq(char("{"), ws, char("}"))
 
     optional_count = sum(1 for _, _, is_req in members if not is_req)
     if optional_count > _MAX_OPTIONAL_PROPS:
@@ -236,9 +246,9 @@ def _object_ast(schema: Dict[str, Any]) -> Node:
     if suffix_form:
         body = members[0][1]
         for _, member, is_required in members[1:]:
-            group = seq(WS, char(","), WS, member)
+            group = seq(ws, char(","), ws, member)
             body = seq(body, group if is_required else opt(group))
-        return seq(char("{"), WS, body, WS, char("}"))
+        return seq(char("{"), ws, body, ws, char("}"))
 
     # General path: alternate over every valid presence subset, keeping
     # declaration order within each subset.
@@ -255,6 +265,6 @@ def _object_ast(schema: Dict[str, Any]) -> Node:
             continue
         body = present[0]
         for member in present[1:]:
-            body = seq(body, WS, char(","), WS, member)
+            body = seq(body, ws, char(","), ws, member)
         bodies.append(body)
-    return seq(char("{"), WS, alt(*bodies), WS, char("}"))
+    return seq(char("{"), ws, alt(*bodies), ws, char("}"))

@@ -429,5 +429,93 @@ def decode_step(
     return logits, new_cache
 
 
+def decode_chunk(
+    params: TransformerParams,
+    spec: ModelSpec,
+    tokens: jax.Array,         # [B, K] chunk: sampled token + forced chain
+    chunk_valid: jax.Array,    # [B, K] bool; position 0 always valid
+    write_pos: jax.Array,      # scalar int32: cache slot of chunk position 0
+    positions: jax.Array,      # [B, K] RoPE positions (per-row real counts)
+    cache: Dict,
+    cache_valid: jax.Array,    # [B, S] attendable cache slots BEFORE chunk
+    impl: str = "xla",
+) -> Tuple[jax.Array, Dict]:
+    """One fast-forward step: process a [B, K] token chunk against the
+    cache (forced-chain fast-forward — the sampled token plus up to K-1
+    DFA-forced JSON-skeleton tokens per row in a single weight pass).
+
+    The chunk is written at cache slots [write_pos, write_pos+K); rows
+    whose chain is shorter leave trailing slots invalid (gaps — masked
+    from all later attention by ``cache_valid``).  Returns logits at each
+    row's LAST VALID chunk position and the updated cache.
+    """
+    B, K = tokens.shape
+    cos, sin = rope_table(positions, spec.head_dim, spec.rope_theta, spec.rope_scaling)
+
+    # Mask: chunk queries attend to valid prior cache slots plus the
+    # causally-visible valid part of the chunk itself.
+    S = cache_valid.shape[1]
+    base = jnp.repeat(cache_valid[:, None, :], K, axis=1)          # [B, K, S]
+    causal = jnp.tril(jnp.ones((K, K), bool))
+    chunk_mask = causal[None] & chunk_valid[:, None, :] & chunk_valid[:, :, None]
+    attn_mask = jax.lax.dynamic_update_slice(base, chunk_mask, (0, 0, write_pos))
+
+    x = params["embed"][tokens]
+    new_cache = []
+    for layer_idx, layer in enumerate(params["layers"]):
+        x, entry = _block_chunk(
+            layer, spec, x, cos, sin, write_pos, cache[layer_idx],
+            attn_mask, impl,
+        )
+        new_cache.append(entry)
+    # Per-row last valid chunk position -> one LM-head application.
+    last = jnp.sum(chunk_valid.astype(jnp.int32), axis=1) - 1      # [B]
+    h_last = jnp.take_along_axis(x, last[:, None, None], axis=1)   # [B, 1, D]
+    logits = _logits(params, spec, h_last)[:, 0, :]
+    return logits, new_cache
+
+
+def _block_chunk(
+    layer: Dict,
+    spec: ModelSpec,
+    x: jax.Array,              # [B, K, D]
+    cos, sin,
+    write_pos: jax.Array,
+    cache_entry: Dict,
+    attn_mask: jax.Array,      # [B, K, S]
+    impl: str,
+) -> Tuple[jax.Array, Dict]:
+    """Chunk decode block: write the fresh K positions into the cache,
+    then attend over the WHOLE cache (prior context + the chunk itself,
+    all selected by ``attn_mask``)."""
+    B, K, D = x.shape
+    h = rms_norm(x, layer["attn_norm"], spec.rms_eps)
+    q, k, v = dense(h, layer["wq"]), dense(h, layer["wk"]), dense(h, layer["wv"])
+    if "bq" in layer:
+        q, k, v = q + layer["bq"], k + layer["bk"], v + layer["bv"]
+    q = q.reshape(B, K, spec.num_heads, spec.head_dim)
+    k = k.reshape(B, K, spec.num_kv_heads, spec.head_dim)
+    v = v.reshape(B, K, spec.num_kv_heads, spec.head_dim)
+    if spec.qk_norm:
+        q = rms_norm(q, layer["q_norm"], spec.rms_eps)
+        k = rms_norm(k, layer["k_norm"], spec.rms_eps)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    new_entry = _write_cache(cache_entry, k, v, write_pos)
+
+    # Attend over the full (bf16) cache including the just-written chunk.
+    ck = new_entry["k"]
+    cv = new_entry["v"]
+    scale = 1.0 / math.sqrt(spec.head_dim)
+    attn_out = attention(q, ck, cv, attn_mask, scale, impl)
+    x = x + dense(attn_out.reshape(B, K, spec.q_size), layer["wo"])
+
+    h = rms_norm(x, layer["mlp_norm"], spec.rms_eps)
+    gate = jax.nn.silu(dense(h, layer["w_gate"]))
+    x = x + dense(gate * dense(h, layer["w_up"]), layer["w_down"])
+    return x, new_entry
+
+
 def param_count(params: TransformerParams) -> int:
     return sum(p.size for p in jax.tree.leaves(params))

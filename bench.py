@@ -99,6 +99,10 @@ def main() -> None:
                 else quant_env
             ),
             kv_cache_dtype=os.environ.get("BENCH_KV_DTYPE", "bfloat16"),
+            decode_fast_forward=os.environ.get("BENCH_FAST_FORWARD", "")
+            not in ("", "0"),
+            guided_compact_json=os.environ.get("BENCH_COMPACT_JSON", "")
+            not in ("", "0"),
         ),
         metrics=dataclasses.replace(
             base.metrics, save_results=False, generate_plots=False

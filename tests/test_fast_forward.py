@@ -1,0 +1,147 @@
+"""Forced-chain fast-forward decoding (engine _get_ff_decode_loop +
+models decode_chunk + guided _forced_chains).
+
+The decisive property: with greedy sampling, fast-forward output is
+IDENTICAL to the standard loop's — forced tokens carry no sampling
+freedom, so riding them through one weight pass must not change anything
+observable.  Plus: chain-table correctness against a hand-walked DFA and
+iteration counts actually dropping on skeleton-heavy schemas.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from bcg_tpu.config import EngineConfig
+from bcg_tpu.engine.jax_engine import JaxEngine
+from bcg_tpu.guided.processor import FF_CHUNK, GuidedBatch, _forced_chains, compile_schema
+
+VOTE = {
+    "type": "object",
+    "properties": {"decision": {"type": "string", "enum": ["stop", "continue"]}},
+    "required": ["decision"],
+    "additionalProperties": False,
+}
+DECISION = {
+    "type": "object",
+    "properties": {
+        "internal_strategy": {"type": "string", "minLength": 1, "maxLength": 25},
+        "value": {"type": "integer", "minimum": 0, "maximum": 50},
+        "public_reasoning": {"type": "string", "minLength": 1, "maxLength": 25},
+    },
+    "required": ["internal_strategy", "value", "public_reasoning"],
+    "additionalProperties": False,
+}
+
+
+class TestForcedChains:
+    def test_chains_follow_single_token_states(self):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        tb = [bytes([i]) for i in range(256)]
+        guide = compile_schema(VOTE, tb, vocab_id=99)
+        td = guide.token_dfa
+        ct, cl, cn = _forced_chains(td.transitions, td.accepting)
+        S = td.num_states
+        for s in range(S):
+            allowed = np.nonzero(td.transitions[s] >= 0)[0]
+            if len(allowed) == 1 and not td.accepting[s]:
+                assert cl[s] >= 1
+                # Walking the chain through the DFA reproduces chain_next.
+                cur = s
+                for j in range(cl[s]):
+                    nxt = td.transitions[cur, ct[s, j]]
+                    assert nxt >= 0
+                    cur = nxt
+                assert cur == cn[s]
+            else:
+                assert cl[s] == 0 and cn[s] == s
+
+    def test_vote_schema_is_skeleton_heavy(self):
+        """For an enum-only schema nearly every byte is forced, so chains
+        should cover most states."""
+        tb = [bytes([i]) for i in range(256)]
+        td = compile_schema(VOTE, tb, vocab_id=98).token_dfa
+        _, cl, _ = _forced_chains(td.transitions, td.accepting)
+        forced_states = ((td.transitions >= 0).sum(axis=1) == 1) & ~td.accepting
+        assert forced_states.sum() > td.num_states * 0.5
+        assert cl.max() == FF_CHUNK - 1
+
+
+def _engines():
+    base = EngineConfig(backend="jax", model_name="bcg-tpu/tiny-test",
+                        max_model_len=2048)
+    return (
+        JaxEngine(base),
+        JaxEngine(dataclasses.replace(base, decode_fast_forward=True)),
+    )
+
+
+class TestGreedyEquivalence:
+    def test_vote_and_decision_outputs_identical(self):
+        std, ff = _engines()
+        prompts = [
+            ("honest system", "vote on round 3", VOTE),
+            ("byzantine system", "decide round 3", DECISION),
+        ]
+        r_std = std.batch_generate_json(prompts, temperature=0.0, max_tokens=60)
+        r_ff = ff.batch_generate_json(prompts, temperature=0.0, max_tokens=60)
+        assert r_ff == r_std
+        std.shutdown()
+        ff.shutdown()
+
+    def test_budget_respected_and_clean_parse(self):
+        ff = JaxEngine(EngineConfig(
+            backend="jax", model_name="bcg-tpu/tiny-test",
+            max_model_len=2048, decode_fast_forward=True,
+        ))
+        out = ff.batch_generate_json(
+            [("s", "u", DECISION)], temperature=0.8, max_tokens=80
+        )[0]
+        assert "error" not in out
+        assert isinstance(out.get("value"), int)
+        ff.shutdown()
+
+    def test_int8_kv_rejected(self):
+        with pytest.raises(ValueError, match="fast_forward"):
+            JaxEngine(EngineConfig(
+                backend="jax", model_name="bcg-tpu/tiny-test",
+                decode_fast_forward=True, kv_cache_dtype="int8",
+            ))
+
+
+class TestCompactJson:
+    def test_compact_output_has_no_interstitial_whitespace(self):
+        import json as _json
+
+        ff = JaxEngine(EngineConfig(
+            backend="jax", model_name="bcg-tpu/tiny-test", max_model_len=2048,
+            decode_fast_forward=True, guided_compact_json=True,
+        ))
+        texts = ff._run_guided(
+            [("s ", "vote"), ("s ", "decide")], [VOTE, DECISION],
+            temperature=0.7, max_tokens=120,
+        )
+        for t in texts:
+            obj = _json.loads(t)
+            # Exactly compact serialization (spaces INSIDE string content
+            # are preserved by dumps, so strict equality is correct).
+            assert t == _json.dumps(obj, separators=(",", ":"))
+        ff.shutdown()
+
+    def test_compact_shortens_votes_and_extends_chains(self):
+        import numpy as np
+
+        tb = [bytes([i]) for i in range(256)]
+        loose = compile_schema(VOTE, tb, vocab_id=97, compact=False)
+        tight = compile_schema(VOTE, tb, vocab_id=97, compact=True)
+        # Compact automaton is strictly smaller and its forced chains
+        # cover a larger share of states.
+        assert tight.token_dfa.num_states < loose.token_dfa.num_states
+        _, cl_l, _ = _forced_chains(
+            loose.token_dfa.transitions, loose.token_dfa.accepting)
+        _, cl_t, _ = _forced_chains(
+            tight.token_dfa.transitions, tight.token_dfa.accepting)
+        assert (cl_t > 0).mean() >= (cl_l > 0).mean()
