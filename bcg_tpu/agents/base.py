@@ -13,7 +13,6 @@ prompts (:538-545), history window of 3 rounds in prompts (:445).
 
 from __future__ import annotations
 
-import json
 from typing import Any, Dict, List, Optional, Tuple
 
 from bcg_tpu.agents.state import AgentMemory

@@ -14,15 +14,7 @@ import dataclasses
 import sys
 from typing import Optional
 
-from bcg_tpu.config import (
-    AgentConfig,
-    BCGConfig,
-    EngineConfig,
-    GameConfig,
-    MetricsConfig,
-    NetworkConfig,
-    resolve_model_name,
-)
+from bcg_tpu.config import BCGConfig, resolve_model_name
 
 
 def build_parser() -> argparse.ArgumentParser:

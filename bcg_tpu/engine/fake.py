@@ -27,7 +27,7 @@ from __future__ import annotations
 import random
 import re
 from collections import Counter
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Tuple
 
 from bcg_tpu.engine.interface import InferenceEngine
 

@@ -16,7 +16,7 @@ Enable with ``--fault-rate 0.2 --fault-seed 7`` or
 from __future__ import annotations
 
 import random
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict
 
 from bcg_tpu.engine.interface import InferenceEngine
 

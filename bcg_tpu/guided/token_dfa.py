@@ -20,7 +20,7 @@ import os
 import subprocess
 import tempfile
 from dataclasses import dataclass
-from typing import List, Optional, Sequence
+from typing import Optional, Sequence
 
 import numpy as np
 

@@ -12,7 +12,6 @@ on local disk (HF cache layout or a flat directory of ``*.safetensors``).
 
 from __future__ import annotations
 
-import json
 import os
 from typing import Dict, Optional
 

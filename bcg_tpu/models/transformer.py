@@ -22,8 +22,7 @@ Replaces the CUDA side of the reference's engine (vLLM internals behind
 from __future__ import annotations
 
 import math
-from functools import partial
-from typing import Dict, Optional, Tuple
+from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp

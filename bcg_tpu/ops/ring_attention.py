@@ -15,12 +15,11 @@ feasible where the reference caps at 8K.
 from __future__ import annotations
 
 import math
-from functools import partial
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 
 def _block_attend(q, k, v, q_pos, k_pos, scale, causal):

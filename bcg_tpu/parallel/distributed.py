@@ -20,10 +20,9 @@ import atexit
 from typing import Optional, Sequence
 
 import jax
-import numpy as np
 from jax.sharding import Mesh
 
-from bcg_tpu.parallel.mesh import AXES, build_mesh
+from bcg_tpu.parallel.mesh import build_mesh
 
 _initialized = False
 

@@ -225,6 +225,35 @@ class TestRetryDesyncStress:
         assert all("consensus_reached" in o["metrics"] for o in outs)
 
 
+class TestRealEngineIntegration:
+    def test_two_concurrent_games_on_jax_engine(self):
+        """Full-stack check: two simulation threads share one REAL JaxEngine
+        through the collective barrier — merged guided batches, tiny model,
+        games complete with coherent metrics."""
+        from bcg_tpu.api import run_simulation
+        from bcg_tpu.config import EngineConfig
+        from bcg_tpu.engine.jax_engine import JaxEngine
+
+        engine = JaxEngine(EngineConfig(
+            backend="jax", model_name="bcg-tpu/tiny-test", max_model_len=2048,
+        ))
+
+        def make(r):
+            def go(coll):
+                return run_simulation(
+                    n_agents=3, byzantine_count=1, max_rounds=2,
+                    backend="jax", seed=r, engine=coll,
+                )
+            return go
+
+        outs = run_concurrent_simulations(engine, [make(r) for r in range(2)], 2)
+        engine.shutdown()
+        for o in outs:
+            if isinstance(o, BaseException):
+                raise o
+        assert all("consensus_reached" in o["metrics"] for o in outs)
+
+
 class TestExperimentsConcurrency:
     def test_run_preset_concurrent(self):
         from bcg_tpu.experiments import PRESETS, run_preset
