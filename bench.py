@@ -21,11 +21,16 @@ the absolute `value` is the number to track round over round.
 
 Env overrides: BENCH_ROUNDS (measured rounds, default 2),
 BENCH_MODEL (spec name), BENCH_BACKEND=fake for a hermetic smoke run,
-BENCH_QUANTIZATION (default int8 — the TPU-native serving config:
-dynamic W8A8 halves the weight traffic that bounds decode; set
-``bfloat16``/``none`` for full-precision parity runs), BENCH_KV_DTYPE
-(default bfloat16; int8 opts into the quantized KV cache).  The
-emitted JSON labels both knobs.
+BENCH_QUANTIZATION (default int8 — measured fastest WITH fast-forward:
+3.34 dec/s vs 3.22 bf16+ff vs 3.00 bf16 plain vs 2.27 int8 plain on
+the single-chip bench, 2026-07-30; set ``bfloat16``/``none`` for
+full-precision parity runs), BENCH_KV_DTYPE (default bfloat16; int8
+opts into the quantized KV cache), BENCH_FAST_FORWARD /
+BENCH_COMPACT_JSON (default ON — forced-chain fast-forward decoding
+and whitespace-free generation grammar; set 0 to disable.
+Fast-forward requires a bf16 KV cache, so BENCH_KV_DTYPE=int8
+auto-disables it unless explicitly forced).  The emitted JSON labels
+every knob.
 """
 
 from __future__ import annotations
@@ -37,6 +42,13 @@ import sys
 import time
 
 REFERENCE_DECISIONS_PER_SEC_ESTIMATE = 0.67
+
+
+def _env_flag(name: str, default: bool) -> bool:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    return raw.strip().lower() not in ("0", "false", "no", "off")
 
 
 def main() -> None:
@@ -82,6 +94,7 @@ def main() -> None:
             }))
             return
 
+    kv_dtype = os.environ.get("BENCH_KV_DTYPE", "bfloat16")
     base = BCGConfig()
     cfg = dataclasses.replace(
         base,
@@ -98,11 +111,14 @@ def main() -> None:
                 None if quant_env.lower() in ("", "none", "bfloat16", "bf16", "off")
                 else quant_env
             ),
-            kv_cache_dtype=os.environ.get("BENCH_KV_DTYPE", "bfloat16"),
-            decode_fast_forward=os.environ.get("BENCH_FAST_FORWARD", "")
-            not in ("", "0"),
-            guided_compact_json=os.environ.get("BENCH_COMPACT_JSON", "")
-            not in ("", "0"),
+            kv_cache_dtype=kv_dtype,
+            # Fast-forward attends over the raw bf16 cache, so it is
+            # incompatible with int8 KV — default it off in that case
+            # rather than crashing engine construction.
+            decode_fast_forward=_env_flag(
+                "BENCH_FAST_FORWARD", kv_dtype != "int8"
+            ),
+            guided_compact_json=_env_flag("BENCH_COMPACT_JSON", True),
         ),
         metrics=dataclasses.replace(
             base.metrics, save_results=False, generate_plots=False
@@ -178,6 +194,8 @@ def main() -> None:
             "backend": backend,
             "quantization": cfg.engine.quantization,
             "kv_cache_dtype": cfg.engine.kv_cache_dtype,
+            "fast_forward": cfg.engine.decode_fast_forward,
+            "compact_json": cfg.engine.guided_compact_json,
             "platform": platform,
             "elapsed_sec": round(elapsed, 2),
             "baseline_note": "denominator is an ESTIMATED reference rate "
