@@ -94,8 +94,9 @@ class EngineConfig:
     prefix_caching: bool = True
     # Forced-chain fast-forward: ride each sampled token's DFA-forced
     # continuation (JSON skeleton) through the same decode weight pass.
-    # Greedy-equivalent to the standard loop; costs FF_CHUNK x decode
-    # cache slots; bf16 KV only.
+    # Greedy-equivalent to the standard loop; ~1.5x decode cache slots
+    # (compacted writes); composes with kv_cache_dtype="int8" via the
+    # Pallas chunk decode kernel.
     decode_fast_forward: bool = False
     # Compact-JSON generation grammar: no inter-token whitespace (fewer
     # decoded tokens, longer forced chains).  Output is still valid JSON;

@@ -107,6 +107,22 @@ def _enable_compilation_cache() -> None:
         pass
 
 
+def _ff_decode_slots(max_new: int) -> int:
+    """Cache tail allocation for the fast-forward loop's compacted writes.
+
+    The write position advances by 1 + max(chain) per iteration, with the
+    in-loop capacity guard falling back to single-token advances whenever
+    the worst-case remainder (1 slot per remaining iteration plus a final
+    K-window) would no longer fit — so 1.5x the token budget plus two
+    chunk windows always suffices, vs. the K * max_new a fixed stride
+    needs.  Fewer allocated slots = fewer slots streamed by every decode
+    step of the KV-bandwidth-bound loop.
+    """
+    from bcg_tpu.guided.processor import FF_CHUNK
+
+    return (3 * max_new) // 2 + 2 * FF_CHUNK
+
+
 def _pad_batch(real_B: int) -> int:
     """Batch-size bucketing: small (retry) batches round up to a power of
     two to reuse compiled loops; full-size game batches stay exact."""
@@ -198,13 +214,11 @@ class JaxEngine(InferenceEngine):
         self.max_model_len = config.max_model_len
         # Forced-chain fast-forward (guided/processor.py FF_CHUNK): each
         # decode step carries the sampled token plus its DFA-forced
-        # continuation (JSON skeleton) in one weight pass.  bf16 KV only:
-        # the chunk path attends over the raw cache.
+        # continuation (JSON skeleton) in one weight pass.  Composes with
+        # the int8 KV cache via the chunk decode kernel (in-VMEM dequant,
+        # ops/decode_attention.py chunk_decode_attention); off-TPU the
+        # fallback dequantizes the whole cache per step — correct, slow.
         self.fast_forward = bool(getattr(config, "decode_fast_forward", False))
-        if self.fast_forward and self.kv_quantized:
-            raise ValueError(
-                "decode_fast_forward requires kv_cache_dtype='bfloat16'"
-            )
 
         quantize = config.quantization == "int8"
         owns_params = params is None
@@ -268,6 +282,9 @@ class JaxEngine(InferenceEngine):
             donate_argnames=("cache",),
         )
         self._decode_loops: Dict[Tuple, Any] = {}
+        self._assemble_cache = jax.jit(
+            self._assemble_cache_fn, static_argnames=("tail",)
+        )
         # Prefix caching: the per-role system-prompt segment is static for
         # a whole run, so its KV is prefilled once and reused by every
         # round's decision/vote call (the reference caches the system
@@ -375,6 +392,45 @@ class JaxEngine(InferenceEngine):
         self._prefix_cache[prefix] = entry
         return entry
 
+    @staticmethod
+    def _assemble_cache_fn(entry_kvs, gid, tail: int):
+        """Gather per-row prefix KV from the cached entries and append the
+        suffix+decode tail, for every layer, in one traced computation.
+
+        ``entry_kvs``: tuple (one per unique prefix) of per-layer kv lists,
+        each array [1, Pb, ...] (scales [1, Hkv, Pb]); ``gid`` [B] maps
+        rows to entries.  Shapes are static under jit, so the pad widths
+        and the target P = max(Pb) specialize at trace time.
+        """
+        P = max(e[0]["k"].shape[1] for e in entry_kvs)
+
+        def stack(name, pad_axis, pad_value, li):
+            arrs = []
+            for e in entry_kvs:
+                a = e[li][name]
+                pad = P - a.shape[pad_axis]
+                if pad:
+                    widths = [(0, 0)] * a.ndim
+                    widths[pad_axis] = (0, pad)
+                    a = jnp.pad(a, widths, constant_values=pad_value)
+                arrs.append(a)
+            g = jnp.concatenate(arrs, axis=0)[gid]  # [B, ...]
+            tail_shape = list(g.shape)
+            tail_shape[pad_axis] = tail
+            tail_arr = (jnp.ones if pad_value == 1 else jnp.zeros)(
+                tuple(tail_shape), g.dtype
+            )
+            return jnp.concatenate([g, tail_arr], axis=pad_axis)
+
+        cache = []
+        for li in range(len(entry_kvs[0])):
+            layer = {"k": stack("k", 1, 0, li), "v": stack("v", 1, 0, li)}
+            if "k_scale" in entry_kvs[0][li]:
+                layer["k_scale"] = stack("k_scale", 2, 1, li)
+                layer["v_scale"] = stack("v_scale", 2, 1, li)
+            cache.append(layer)
+        return cache
+
     def _prepare_prefixed_batch(self, parts, budgets: List[int],
                                 decode_slots: Optional[int] = None):
         """Assemble a batch whose cache slots [0, P) are prefilled prefix
@@ -408,37 +464,12 @@ class JaxEngine(InferenceEngine):
         gid = np.array([uniq.index(p) for p, _ in parts], dtype=np.int32)
         tail = Ls + (decode_slots if decode_slots is not None else max_new + 1)
 
-        def stack(layer_idx, name, pad_axis, pad_value, tail_shape_fn):
-            """[G, ...] stacked entry arrays padded to P, gathered to [B, ...],
-            concatenated with the suffix+decode tail."""
-            arrs = []
-            for p in uniq:
-                a = entries[p]["kv"][layer_idx][name]
-                pad = P - a.shape[pad_axis]
-                if pad:
-                    widths = [(0, 0)] * a.ndim
-                    widths[pad_axis] = (0, pad)
-                    a = jnp.pad(a, widths, constant_values=pad_value)
-                arrs.append(a)
-            g = jnp.concatenate(arrs, axis=0)[gid]  # [B, ...]
-            tail_arr = (jnp.ones if pad_value == 1 else jnp.zeros)(
-                tail_shape_fn(g), g.dtype
-            )
-            return jnp.concatenate([g, tail_arr], axis=pad_axis)
-
-        cache = []
-        for layer_idx in range(self.spec.num_layers):
-            entry0 = entries[uniq[0]]["kv"][layer_idx]
-            layer = {
-                "k": stack(layer_idx, "k", 1, 0, lambda g: (B, tail) + g.shape[2:]),
-                "v": stack(layer_idx, "v", 1, 0, lambda g: (B, tail) + g.shape[2:]),
-            }
-            if "k_scale" in entry0:
-                layer["k_scale"] = stack(
-                    layer_idx, "k_scale", 2, 1, lambda g: g.shape[:2] + (tail,))
-                layer["v_scale"] = stack(
-                    layer_idx, "v_scale", 2, 1, lambda g: g.shape[:2] + (tail,))
-            cache.append(layer)
+        # One jitted call assembles the whole batch cache.  Done eagerly
+        # this was ~6 ops x num_layers separate device executions per LLM
+        # call — on a remote-attached TPU each costs a tunnel round-trip,
+        # adding up to hundreds of ms of pure dispatch latency.
+        entry_kvs = tuple(entries[p]["kv"] for p in uniq)
+        cache = self._assemble_cache(entry_kvs, jnp.asarray(gid), tail=tail)
 
         prefix_valid = np.zeros((B, P), dtype=bool)
         prefix_lens = np.zeros((B,), dtype=np.int32)
@@ -589,15 +620,25 @@ class JaxEngine(InferenceEngine):
         """Fast-forward decode loop: every iteration samples ONE token and
         rides its DFA-forced continuation (up to FF_CHUNK-1 skeleton
         tokens) through the same weight pass (models/transformer.py
-        decode_chunk).  Cache slots advance K per iteration with per-row
-        gaps masked out of attention; RoPE positions stay contiguous per
-        row.  Greedy outputs are bit-identical to the standard loop; the
-        win is weight-streaming passes ~ sampled tokens, not total tokens.
+        decode_chunk).  The cache write position advances by 1 + the
+        iteration's WIDEST row chain (compacted; per-row gaps inside the
+        window are masked out of attention); RoPE positions stay
+        contiguous per row.  Greedy outputs are bit-identical to the
+        standard loop; the win is weight-streaming passes ~ sampled
+        tokens, not total tokens — and a cache only ~1.5x the token
+        budget for the KV-bandwidth-bound attention to stream.
         """
         from bcg_tpu.guided.processor import FF_CHUNK as K
 
-        key = ("ff", guided_sig, int(max_new), float(top_p),
-               self.attention_impl)
+        # int8 cache -> the Pallas chunk kernel (when the engine resolved
+        # a Pallas decode impl); bf16 -> stock XLA attention (flash would
+        # pad the K chunk rows to a 128-row query block).
+        chunk_impl = (
+            "pallas"
+            if self.kv_quantized and self.decode_attention_impl == "pallas"
+            else "xla"
+        )
+        key = ("ff", guided_sig, int(max_new), float(top_p), chunk_impl)
         if key in self._decode_loops:
             return self._decode_loops[key]
 
@@ -618,20 +659,30 @@ class JaxEngine(InferenceEngine):
                 )
 
             def cond(carry):
-                i, done, *_ = carry
+                i, _wp, done, *_ = carry
                 return (i < max_new) & ~done.all()
 
+            tail_slots = _ff_decode_slots(max_new)
+
             def body(carry):
-                (i, done, emitted, states, logits, cache, valid_mask,
+                (i, wp, done, emitted, states, logits, cache, valid_mask,
                  out, rng) = carry
                 tok, ns, rng = masked_sample(logits, states, rng, emitted)
                 tok = jnp.where(done, eos_id, tok)
                 finished = tok == eos_id
                 clamped_ns = jnp.maximum(ns, 0)
                 # Forced continuation of the sampled token (none for EOS
-                # or already-done rows).
+                # or already-done rows).  Cache capacity guard: chains are
+                # disabled once the compacted write position could no
+                # longer fit the worst-case remainder (each later
+                # iteration advancing 1 slot, every write needing a K
+                # window).  Output is unchanged when it triggers — a
+                # forced state has exactly one legal token, so the sampler
+                # emits the chain one token per iteration instead.
+                room_ok = (wp - L) <= tail_slots - 2 * K - (max_new - i - 1)
                 cl = jnp.where(
-                    done | finished, 0, chain_len[dfa_ids, clamped_ns]
+                    done | finished | ~room_ok, 0,
+                    chain_len[dfa_ids, clamped_ns],
                 )
                 ct = chain_tok[dfa_ids, clamped_ns]        # [B, K-1]
                 chunk = jnp.concatenate([tok[:, None], ct], axis=1)  # [B, K]
@@ -648,24 +699,36 @@ class JaxEngine(InferenceEngine):
                 ].set(chunk, mode="drop")
                 positions = (prompt_lens + emitted)[:, None] + j
                 logits, cache = decode_chunk(
-                    params, spec, chunk, chunk_valid, L + i * K, positions,
-                    cache, valid_mask, impl="xla",
+                    params, spec, chunk, chunk_valid, wp, positions,
+                    cache, valid_mask, impl=chunk_impl,
                 )
                 valid_mask = jax.lax.dynamic_update_slice(
-                    valid_mask, chunk_valid, (0, L + i * K)
+                    valid_mask, chunk_valid, (0, wp)
                 )
                 emitted = jnp.where(done, emitted, emitted + 1 + cl)
-                states = jnp.where(done, states, chain_next[dfa_ids, clamped_ns])
+                # Compacted advance: the next window starts right after
+                # this iteration's widest row, not K slots later — rows
+                # with shorter chains leave gaps only inside the window,
+                # and the decode attention streams ~emitted slots instead
+                # of K * iterations (decode is KV-bandwidth-bound, so
+                # cache compaction is decode wall-clock).  Overlapped
+                # slots from the previous window were invalid and are
+                # simply overwritten.
+                wp = wp + 1 + jnp.max(jnp.where(done, 0, cl))
+                next_states = jnp.where(
+                    room_ok, chain_next[dfa_ids, clamped_ns], clamped_ns
+                )
+                states = jnp.where(done, states, next_states)
                 states = jnp.where(finished, -1, states)
                 done = done | finished
-                return (i + 1, done, emitted, states, logits, cache,
+                return (i + 1, wp, done, emitted, states, logits, cache,
                         valid_mask, out, rng)
 
             out = jnp.full((B, max_new), eos_id, dtype=jnp.int32)
-            carry = (jnp.int32(0), jnp.zeros((B,), bool),
+            carry = (jnp.int32(0), jnp.int32(L), jnp.zeros((B,), bool),
                      jnp.zeros((B,), jnp.int32), init_states.astype(jnp.int32),
                      first_logits, cache, valid_mask, out, rng)
-            (i, done, emitted, states, logits, cache, valid_mask, out,
+            (i, wp, done, emitted, states, logits, cache, valid_mask, out,
              rng) = jax.lax.while_loop(cond, body, carry)
             return out, (rng, i)
 
@@ -734,11 +797,7 @@ class JaxEngine(InferenceEngine):
         use_ff = self.fast_forward and sig_prefix[0] != "free"
         self._check_kv_budget(B, budgets, fast_forward=use_ff)
         if use_ff:
-            from bcg_tpu.guided.processor import FF_CHUNK
-
-            # Chunk slots advance FF_CHUNK per iteration (gaps for short
-            # chains), and iterations are bounded by max_new.
-            decode_slots = max_new * FF_CHUNK
+            decode_slots = _ff_decode_slots(max_new)
         else:
             decode_slots = max_new + 1
         t0 = time.perf_counter()
@@ -800,6 +859,9 @@ class JaxEngine(InferenceEngine):
                 sub,
             )
         out_np = np.asarray(out)
+        # Observability: decode-loop iterations of the last call (each is
+        # one weight pass — the wall-clock unit of the decode phase).
+        self.last_decode_steps = int(steps)
         if _TIMING:
             print(
                 f"[engine] decode B={B} L={L} max_new={max_new} "
@@ -826,11 +888,9 @@ class JaxEngine(InferenceEngine):
         spec = self.spec
         # Worst case for a mixed-budget batch: a min-budget row's prompt
         # window (max_model_len - min - 1) plus the batch-wide decode
-        # reservation — FF_CHUNK slots per token under fast-forward.
+        # reservation (the compacted fast-forward tail, _ff_decode_slots).
         if fast_forward:
-            from bcg_tpu.guided.processor import FF_CHUNK
-
-            decode_res = max(budgets) * FF_CHUNK
+            decode_res = _ff_decode_slots(max(budgets))
         else:
             decode_res = max(budgets) + 1
         S = self.max_model_len - min(budgets) - 1 + decode_res

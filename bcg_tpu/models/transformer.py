@@ -503,11 +503,33 @@ def _block_chunk(
 
     new_entry = _write_cache(cache_entry, k, v, write_pos)
 
-    # Attend over the full (bf16) cache including the just-written chunk.
-    ck = new_entry["k"]
-    cv = new_entry["v"]
+    # Attend over the full cache including the just-written chunk.
     scale = 1.0 / math.sqrt(spec.head_dim)
-    attn_out = attention(q, ck, cv, attn_mask, scale, impl)
+    quantized = "k_scale" in new_entry
+    if quantized and impl == "pallas" and jax.default_backend() == "tpu" \
+            and spec.head_dim % 128 == 0:
+        # int8 cache: stream once, dequantize in VMEM (K*group query rows
+        # per program — the prefill flash kernel would pad K chunk rows
+        # to a 128-row block).
+        from bcg_tpu.ops.decode_attention import chunk_decode_attention
+
+        attn_out = chunk_decode_attention(
+            q, new_entry["k"], new_entry["v"], attn_mask, scale,
+            k_scale=new_entry["k_scale"], v_scale=new_entry["v_scale"],
+        )
+    else:
+        ck, cv = new_entry["k"], new_entry["v"]
+        if quantized:
+            from bcg_tpu.ops.decode_attention import dequantize_kv
+
+            # Slow fallback (off-TPU / unaligned head dim): full dequant.
+            ck = dequantize_kv(
+                ck, new_entry["k_scale"].transpose(0, 2, 1)).astype(q.dtype)
+            cv = dequantize_kv(
+                cv, new_entry["v_scale"].transpose(0, 2, 1)).astype(q.dtype)
+        attn_out = attention(
+            q, ck, cv, attn_mask, scale, "xla" if quantized else impl
+        )
     x = x + dense(attn_out.reshape(B, K, spec.q_size), layer["wo"])
 
     h = rms_norm(x, layer["mlp_norm"], spec.rms_eps)

@@ -34,7 +34,7 @@ _NEG_INF = -1e30
 
 def _decode_kernel(
     q_ref, k_ref, v_ref, ks_ref, vs_ref, mask_ref, o_ref,
-    m_scr, l_scr, acc_scr, *, scale, num_s_blocks, quantized,
+    m_scr, l_scr, acc_scr, *, scale, num_s_blocks, quantized, group,
 ):
     s = pl.program_id(2)
 
@@ -44,10 +44,10 @@ def _decode_kernel(
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    q = q_ref[0, 0]                          # [group, Dh]
+    q = q_ref[0, 0]                          # [rows, Dh]
     k = k_ref[0, :, 0, :]                    # [Sblk, Dh]
     v = v_ref[0, :, 0, :]
-    mask = mask_ref[0]                       # [1, Sblk] bool
+    mask = mask_ref[0]                       # [M, Sblk] bool
 
     if quantized:
         k = k.astype(jnp.float32) * ks_ref[0, 0][:, None]
@@ -55,9 +55,15 @@ def _decode_kernel(
     k = k.astype(q.dtype)
     v = v.astype(q.dtype)
 
+    # Single-step decode passes one mask row shared by every query row
+    # (broadcast); the chunk variant passes one row per chunk position
+    # (rows are laid out position-major, so repeat by ``group``).
+    if mask.shape[0] > 1:
+        mask = jnp.repeat(mask, group, axis=0)  # [rows, Sblk]
+
     scores = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale                                # [group, Sblk]
+    ) * scale                                # [rows, Sblk]
     scores = jnp.where(mask, scores, _NEG_INF)
 
     m_prev = m_scr[...]                      # [group, 1]
@@ -116,7 +122,8 @@ def decode_attention(
     qg = q.reshape(B, Hkv, group, Dh)
 
     kernel = functools.partial(
-        _decode_kernel, scale=scale, num_s_blocks=nS, quantized=quantized
+        _decode_kernel, scale=scale, num_s_blocks=nS, quantized=quantized,
+        group=group,
     )
     out = pl.pallas_call(
         kernel,
@@ -142,6 +149,82 @@ def decode_attention(
         interpret=interpret,
     )(qg, kp, vp, ksp, vsp, mp)
     return out.reshape(B, H, Dh)
+
+
+def chunk_decode_attention(
+    q, k, v, mask, scale,
+    k_scale=None, v_scale=None,
+    block_s: int = 512,
+    interpret: bool = False,
+):
+    """Fast-forward chunk decode over the (possibly int8) cache.
+
+    q [B, K, H, Dh] (K chunk positions), k/v [B, S, Hkv, Dh],
+    mask [B, K, S] -> [B, K, H, Dh].  Same streaming/online-softmax/
+    in-VMEM-dequant design as :func:`decode_attention`, with an
+    [K*group, Dh] query tile per (batch, kv-head) program — K=4, group=2
+    is an 8-row MXU tile, where the prefill flash kernel would pad the
+    4 chunk rows to a 128-row query block (32x wasted work).
+    """
+    B, K, H, Dh = q.shape
+    S, Hkv = k.shape[1], k.shape[2]
+    group = H // Hkv
+    quantized = k_scale is not None
+
+    kp = _pad_s(k, block_s)
+    vp = _pad_s(v, block_s)
+    mp = _pad_s(mask, block_s, axis=2)              # [B, K, Sp]
+    if quantized:
+        ksp = _pad_s(k_scale, block_s, axis=2)
+        vsp = _pad_s(v_scale, block_s, axis=2)
+    else:
+        ksp = jnp.ones((B, Hkv, kp.shape[1]), jnp.float32)
+        vsp = ksp
+    Sp = kp.shape[1]
+    nS = Sp // block_s
+
+    # [B, K, Hkv, group, Dh] -> [B, Hkv, K*group, Dh]: position-major row
+    # layout, matching the kernel's per-position mask repeat.
+    qg = (
+        q.reshape(B, K, Hkv, group, Dh)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(B, Hkv, K * group, Dh)
+    )
+
+    kernel = functools.partial(
+        _decode_kernel, scale=scale, num_s_blocks=nS, quantized=quantized,
+        group=group,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(B, Hkv, nS),
+        in_specs=[
+            pl.BlockSpec((1, 1, K * group, Dh), lambda b, h, s: (b, h, 0, 0)),
+            pl.BlockSpec((1, block_s, 1, Dh), lambda b, h, s: (b, s, h, 0)),
+            pl.BlockSpec((1, block_s, 1, Dh), lambda b, h, s: (b, s, h, 0)),
+            pl.BlockSpec((1, 1, block_s), lambda b, h, s: (b, h, s)),
+            pl.BlockSpec((1, 1, block_s), lambda b, h, s: (b, h, s)),
+            pl.BlockSpec((1, K, block_s), lambda b, h, s: (b, 0, s)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, K * group, Dh), lambda b, h, s: (b, h, 0, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, K * group, Dh), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((K * group, 1), jnp.float32),
+            pltpu.VMEM((K * group, 1), jnp.float32),
+            pltpu.VMEM((K * group, Dh), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qg, kp, vp, ksp, vsp, mp)
+    return (
+        out.reshape(B, Hkv, K, group, Dh)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(B, K, H, Dh)
+    )
 
 
 # ----------------------------------------------------------- kv quantization

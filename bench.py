@@ -27,10 +27,9 @@ the single-chip bench, 2026-07-30; set ``bfloat16``/``none`` for
 full-precision parity runs), BENCH_KV_DTYPE (default bfloat16; int8
 opts into the quantized KV cache), BENCH_FAST_FORWARD /
 BENCH_COMPACT_JSON (default ON — forced-chain fast-forward decoding
-and whitespace-free generation grammar; set 0 to disable.
-Fast-forward requires a bf16 KV cache, so BENCH_KV_DTYPE=int8
-auto-disables it unless explicitly forced).  The emitted JSON labels
-every knob.
+and whitespace-free generation grammar; set 0 to disable; composes
+with BENCH_KV_DTYPE=int8 via the Pallas chunk decode kernel).  The
+emitted JSON labels every knob.
 """
 
 from __future__ import annotations
@@ -112,12 +111,7 @@ def main() -> None:
                 else quant_env
             ),
             kv_cache_dtype=kv_dtype,
-            # Fast-forward attends over the raw bf16 cache, so it is
-            # incompatible with int8 KV — default it off in that case
-            # rather than crashing engine construction.
-            decode_fast_forward=_env_flag(
-                "BENCH_FAST_FORWARD", kv_dtype != "int8"
-            ),
+            decode_fast_forward=_env_flag("BENCH_FAST_FORWARD", True),
             guided_compact_json=_env_flag("BENCH_COMPACT_JSON", True),
         ),
         metrics=dataclasses.replace(

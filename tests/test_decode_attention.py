@@ -60,6 +60,58 @@ def test_int8_kv_close_to_fp():
     assert err < 0.05, err
 
 
+def _chunk_case(key, B, K, S, H, Hkv, Dh):
+    ks = jax.random.split(key, 4)
+    q = jax.random.normal(ks[0], (B, K, H, Dh), jnp.float32)
+    k = jax.random.normal(ks[1], (B, S, Hkv, Dh), jnp.float32)
+    v = jax.random.normal(ks[2], (B, S, Hkv, Dh), jnp.float32)
+    lens = jax.random.randint(ks[3], (B,), 1, S - K)
+    # Per-position mask: each chunk position additionally sees its causal
+    # predecessors, mirroring decode_chunk's mask construction.
+    base = jnp.arange(S)[None, None, :] < lens[:, None, None]  # [B, 1, S]
+    causal = (
+        jnp.arange(S)[None, None, :]
+        <= (lens[:, None] + jnp.arange(K)[None, :])[:, :, None]
+    )
+    mask = jnp.broadcast_to(base, (B, K, S)) | (causal & ~base)
+    return q, k, v, mask
+
+
+@pytest.mark.parametrize("shape", [
+    (2, 4, 256, 4, 2, 128),   # GQA, FF_CHUNK-sized chunk
+    (1, 4, 300, 8, 8, 128),   # MHA, ragged S
+    (3, 2, 256, 4, 1, 128),   # group=4, K=2
+])
+def test_chunk_matches_reference(shape):
+    from bcg_tpu.ops.decode_attention import chunk_decode_attention
+
+    B, K, S, H, Hkv, Dh = shape
+    q, k, v, mask = _chunk_case(jax.random.PRNGKey(4), B, K, S, H, Hkv, Dh)
+    scale = 1.0 / np.sqrt(Dh)
+    ref = _xla_attention(q, k, v, mask, scale)
+    out = chunk_decode_attention(q, k, v, mask, scale, block_s=128,
+                                 interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_chunk_int8_close_to_fp():
+    from bcg_tpu.ops.decode_attention import chunk_decode_attention
+
+    B, K, S, H, Hkv, Dh = 2, 4, 256, 4, 2, 128
+    q, k, v, mask = _chunk_case(jax.random.PRNGKey(5), B, K, S, H, Hkv, Dh)
+    scale = 1.0 / np.sqrt(Dh)
+    ref = _xla_attention(q, k, v, mask, scale)
+    kq, ks = quantize_kv(k)
+    vq, vs = quantize_kv(v)
+    out = chunk_decode_attention(q, kq, vq, mask, scale,
+                                 k_scale=ks.transpose(0, 2, 1),
+                                 v_scale=vs.transpose(0, 2, 1),
+                                 block_s=128, interpret=True)
+    err = np.abs(np.asarray(out) - np.asarray(ref)).max()
+    assert err < 0.05, err
+
+
 def test_quantize_roundtrip():
     x = jax.random.normal(jax.random.PRNGKey(2), (3, 16, 2, 64)) * 4.0
     q, s = quantize_kv(x)

@@ -92,6 +92,64 @@ class TestGreedyEquivalence:
         std.shutdown()
         ff.shutdown()
 
+    def test_capacity_guard_tight_budget_identical(self):
+        """With a budget barely above the schema's minimum completion, the
+        compacted-write capacity guard must kick in (chains disabled late
+        in the generation) without changing the greedy output: a forced
+        state has exactly one legal token either way."""
+        std, ff = _engines()
+        # The guided sampler guarantees parseability, so any budget the
+        # standard loop can complete in, fast-forward must match exactly.
+        for max_tokens in (24, 30, 40):
+            r_std = std.batch_generate_json(
+                [("s", "vote", VOTE)], temperature=0.0, max_tokens=max_tokens
+            )
+            n_std = std.last_decode_steps
+            r_ff = ff.batch_generate_json(
+                [("s", "vote", VOTE)], temperature=0.0, max_tokens=max_tokens
+            )
+            assert r_ff == r_std
+            assert "error" not in r_std[0]
+            # Chains must be ACTIVE overall (fewer weight passes than the
+            # standard loop) — a broken always-off guard would pass the
+            # equality check while silently erasing the fast-forward win.
+            assert ff.last_decode_steps < n_std, (ff.last_decode_steps, n_std)
+        std.shutdown()
+        ff.shutdown()
+
+    def test_capacity_guard_fires_and_degrades_safely(self, monkeypatch):
+        """Force the guard by shrinking the allocated tail to the bare
+        single-advance minimum: chains must switch off (weight passes rise
+        to ~the standard loop's count), the compacted writes must stay in
+        bounds, and the greedy output must be unchanged."""
+        import bcg_tpu.engine.jax_engine as je
+
+        std, _ = _engines()
+        r_std = std.batch_generate_json(
+            [("s", "vote", VOTE)], temperature=0.0, max_tokens=40
+        )
+        n_std = std.last_decode_steps
+        std.shutdown()
+
+        # tail = max_new + 2K makes room_ok's bound i+1, so chains die
+        # after the first iteration.
+        monkeypatch.setattr(
+            je, "_ff_decode_slots", lambda max_new: max_new + 2 * FF_CHUNK
+        )
+        ff = JaxEngine(dataclasses.replace(
+            EngineConfig(backend="jax", model_name="bcg-tpu/tiny-test",
+                         max_model_len=2048),
+            decode_fast_forward=True,
+        ))
+        r_ff = ff.batch_generate_json(
+            [("s", "vote", VOTE)], temperature=0.0, max_tokens=40
+        )
+        assert r_ff == r_std
+        # Nearly every iteration degraded to a single-token advance.
+        assert ff.last_decode_steps >= n_std - FF_CHUNK, (
+            ff.last_decode_steps, n_std)
+        ff.shutdown()
+
     def test_budget_respected_and_clean_parse(self):
         ff = JaxEngine(EngineConfig(
             backend="jax", model_name="bcg-tpu/tiny-test",
@@ -104,12 +162,29 @@ class TestGreedyEquivalence:
         assert isinstance(out.get("value"), int)
         ff.shutdown()
 
-    def test_int8_kv_rejected(self):
-        with pytest.raises(ValueError, match="fast_forward"):
-            JaxEngine(EngineConfig(
-                backend="jax", model_name="bcg-tpu/tiny-test",
-                decode_fast_forward=True, kv_cache_dtype="int8",
-            ))
+    def test_int8_kv_composes(self):
+        """Fast-forward over an int8 KV cache (off-TPU this exercises the
+        full-dequant fallback in _block_chunk) must produce the same
+        greedy output as the standard int8-KV loop — the quantization
+        error is identical because both attend the same stored cache."""
+        base = EngineConfig(backend="jax", model_name="bcg-tpu/tiny-test",
+                            max_model_len=2048, kv_cache_dtype="int8")
+        with pytest.warns(UserWarning, match="int8"):
+            std = JaxEngine(base)
+        with pytest.warns(UserWarning, match="int8"):
+            ff = JaxEngine(
+                dataclasses.replace(base, decode_fast_forward=True)
+            )
+        prompts = [
+            ("honest system", "vote on round 3", VOTE),
+            ("byzantine system", "decide round 3", DECISION),
+        ]
+        r_std = std.batch_generate_json(prompts, temperature=0.0, max_tokens=60)
+        r_ff = ff.batch_generate_json(prompts, temperature=0.0, max_tokens=60)
+        assert r_ff == r_std
+        assert all("error" not in r for r in r_std)
+        std.shutdown()
+        ff.shutdown()
 
 
 class TestCompactJson:
