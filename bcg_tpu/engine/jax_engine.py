@@ -57,8 +57,10 @@ _LEN_BUCKETS = (512, 1024, 2048, 4096, 6144, 8192)
 _SUFFIX_BUCKETS = (256, 512, 1024, 2048, 4096, 8192)
 # Prefix entries are per-run static (one compile each), so an even finer
 # ladder is cheap — and a tight prefix bucket matters doubly, because pad
-# slots in [0, P) are streamed by EVERY subsequent decode step.
-_PREFIX_BUCKETS = (128, 256) + _LEN_BUCKETS
+# slots in [0, P) are streamed by EVERY subsequent decode step (the BCG
+# system prompts measure ~550-770 and ~1580-1620 tokens, hence the 768
+# and 1792 rungs).
+_PREFIX_BUCKETS = (128, 256, 512, 768, 1024, 1536, 1792, 2048, 4096, 6144, 8192)
 
 # BCG_TPU_TIMING=1 prints per-call prefill/decode wall times.
 _TIMING = os.environ.get("BCG_TPU_TIMING", "") not in ("", "0")
@@ -294,7 +296,20 @@ class JaxEngine(InferenceEngine):
         # BPE merges cannot straddle the split.
         self.prefix_caching = getattr(config, "prefix_caching", True)
         self._prefix_safe = prefix_split_safe(config.model_name)
-        self._prefix_cache: Dict[str, Dict[str, Any]] = {}
+        from collections import OrderedDict
+
+        # Keyed (prefix, bucket): see _get_prefix_entry.
+        self._prefix_cache: "OrderedDict[Tuple[str, int], Dict[str, Any]]" = (
+            OrderedDict()
+        )
+        self._prefix_lens_memo: Dict[str, int] = {}
+        self._prefix_bytes = 0
+        self._prefix_active: set = set()
+        self._prefix_over_budget_warned = False
+        # Prefix-KV budget: a fraction of device memory when known (the
+        # weights/decode-cache OOM guard covers the rest), else a fixed
+        # allowance ample for CPU tests.
+        self._prefix_budget = 4 << 30
         # One-time constants for the hbm_utilization OOM guard.  Leaf
         # .nbytes is the GLOBAL size while bytes_limit is ONE device's.
         # Weights shard over the tp axis only (replicated across dp/sp —
@@ -311,6 +326,8 @@ class JaxEngine(InferenceEngine):
             self._mem_limit = stats.get("bytes_limit")
         except Exception:
             self._mem_limit = None
+        if self._mem_limit:
+            self._prefix_budget = min(4 << 30, int(self._mem_limit * 0.25))
 
     # ------------------------------------------------------------- tokenizing
 
@@ -361,21 +378,39 @@ class JaxEngine(InferenceEngine):
 
     # --------------------------------------------------------- prefix caching
 
-    def _get_prefix_entry(self, prefix: str, limit: int) -> Optional[Dict[str, Any]]:
-        """Prefill (once) and cache the KV for a static prompt prefix.
+    def _prefix_len(self, prefix: str) -> int:
+        """Token count of a prefix (memoized — called every batch)."""
+        n = self._prefix_lens_memo.get(prefix)
+        if n is None:
+            n = len(self.tokenizer.encode(prefix))
+            self._prefix_lens_memo[prefix] = n
+        return n
 
-        Returns ``None`` when the prefix is too long to leave useful room
-        for a suffix — the caller then falls back to full-prompt prefill.
+    def _get_prefix_entry(
+        self, prefix: str, limit: int, bucket: int
+    ) -> Optional[Dict[str, Any]]:
+        """Prefill (once) and cache the KV for a static prompt prefix at
+        the given bucket size.
+
+        The caller picks ONE bucket for every prefix in the batch (the
+        smallest rung covering the longest prefix): uniform entry shapes
+        keep the cache-assembly jit signature stable — per-entry buckets
+        minted a fresh (shape-pattern, order) retrace+compile every time
+        the hidden role assignment reshuffled between games.
+
+        Returns ``None`` when the prefix cannot fit — the caller then
+        falls back to full-prompt prefill.
         """
-        entry = self._prefix_cache.get(prefix)
+        key = (prefix, bucket)
+        entry = self._prefix_cache.get(key)
         if entry is not None:
+            self._prefix_cache.move_to_end(key)  # LRU touch
             return entry
         toks = self.tokenizer.encode(prefix)
         if not toks or len(toks) > limit - 64:
             return None
-        buckets = [b for b in _PREFIX_BUCKETS if b <= limit]
-        Pb = next((b for b in buckets if b >= len(toks)), None)
-        if Pb is None:
+        Pb = bucket
+        if Pb > limit or len(toks) > Pb:
             return None
         tokens = np.full((1, Pb), self.tokenizer.pad_id, dtype=np.int32)
         valid = np.zeros((1, Pb), dtype=bool)
@@ -386,10 +421,46 @@ class JaxEngine(InferenceEngine):
             self.params, tokens=jnp.asarray(tokens), valid=jnp.asarray(valid),
             cache=cache,
         )
-        if len(self._prefix_cache) >= 8:  # a run uses <=4 (2 roles x 2 phases)
-            self._prefix_cache.pop(next(iter(self._prefix_cache)))
         entry = {"kv": kv, "valid": valid[0], "len": len(toks), "bucket": Pb}
-        self._prefix_cache[prefix] = entry
+        # Size-aware LRU.  System prompts embed the agent id ("You are
+        # agent_3 ..."), so a 10-agent run holds ~20 DISTINCT prefixes
+        # (per agent x per phase) — a small fixed cap would thrash and
+        # re-prefill ~B entries every call.  Evict by BYTES, not count:
+        # the working set (a few GB at 1-2K-token buckets) must fit
+        # alongside weights and the decode cache.
+        entry_bytes = sum(
+            getattr(a, "nbytes", 0) for a in jax.tree.leaves(kv)
+        )
+        self._prefix_bytes += entry_bytes
+        entry["bytes"] = entry_bytes
+        self._prefix_cache[key] = entry
+        # Evict LRU-first, but never a key of the batch being assembled
+        # (_prefix_active): evicting mid-batch would re-prefill the whole
+        # working set on EVERY call — the thrash this cache exists to
+        # prevent.  If the active set alone exceeds the budget the cache
+        # runs over it for the call (the HBM spike is inherent to the
+        # batch); warn once so the operator can shrink it.
+        evictable = [
+            k for k in self._prefix_cache if k not in self._prefix_active
+        ]
+        while self._prefix_bytes > self._prefix_budget and evictable:
+            old = self._prefix_cache.pop(evictable.pop(0))
+            self._prefix_bytes -= old["bytes"]
+        if (
+            self._prefix_bytes > self._prefix_budget
+            and not self._prefix_over_budget_warned
+        ):
+            import warnings
+
+            warnings.warn(
+                f"prefix-KV working set ({self._prefix_bytes / 1e9:.1f} GB) "
+                f"exceeds its budget ({self._prefix_budget / 1e9:.1f} GB); "
+                "prefix caching will hold it anyway for this batch — "
+                "reduce agents per call or disable prefix_caching if HBM "
+                "is tight",
+                stacklevel=2,
+            )
+            self._prefix_over_budget_warned = True
         return entry
 
     @staticmethod
@@ -398,11 +469,13 @@ class JaxEngine(InferenceEngine):
         suffix+decode tail, for every layer, in one traced computation.
 
         ``entry_kvs``: tuple (one per unique prefix) of per-layer kv lists,
-        each array [1, Pb, ...] (scales [1, Hkv, Pb]); ``gid`` [B] maps
-        rows to entries.  Shapes are static under jit, so the pad widths
-        and the target P = max(Pb) specialize at trace time.
+        each array [1, Pb, Hkv, Dh] (int8 layout [1, Hkv, Pb, Dh]; scales
+        [1, Hkv, Pb]); ``gid`` [B] maps rows to entries.  Shapes are
+        static under jit, so the pad widths and the target P = max(Pb)
+        specialize at trace time.
         """
-        P = max(e[0]["k"].shape[1] for e in entry_kvs)
+        s_axis = 2 if "k_scale" in entry_kvs[0][0] else 1
+        P = max(e[0]["k"].shape[s_axis] for e in entry_kvs)
 
         def stack(name, pad_axis, pad_value, li):
             arrs = []
@@ -424,8 +497,13 @@ class JaxEngine(InferenceEngine):
 
         cache = []
         for li in range(len(entry_kvs[0])):
-            layer = {"k": stack("k", 1, 0, li), "v": stack("v", 1, 0, li)}
-            if "k_scale" in entry_kvs[0][li]:
+            quantized = "k_scale" in entry_kvs[0][li]
+            kv_axis = 2 if quantized else 1  # int8 layout is [B, Hkv, S, Dh]
+            layer = {
+                "k": stack("k", kv_axis, 0, li),
+                "v": stack("v", kv_axis, 0, li),
+            }
+            if quantized:
                 layer["k_scale"] = stack("k_scale", 2, 1, li)
                 layer["v_scale"] = stack("v_scale", 2, 1, li)
             cache.append(layer)
@@ -442,15 +520,28 @@ class JaxEngine(InferenceEngine):
         # the most decode slots — admitting a longer prefix would prefill
         # and cache an entry the limits_s guard below can never accept.
         limit = self.max_model_len - max(budgets) - 1
+        # One bucket for the whole batch: the smallest rung covering the
+        # longest prefix (uniform entry shapes — see _get_prefix_entry).
+        uniq_prefixes = list(dict.fromkeys(p for p, _ in parts))
+        max_len = max(self._prefix_len(p) for p in uniq_prefixes)
+        if max_len == 0 or max_len > limit - 64:
+            return None
+        P = next(
+            (b for b in _PREFIX_BUCKETS if b >= max_len and b <= limit), None
+        )
+        if P is None:
+            return None
         entries: Dict[str, Dict[str, Any]] = {}
-        for p, _ in parts:
-            if p not in entries:
-                e = self._get_prefix_entry(p, limit)
+        self._prefix_active = {(p, P) for p in uniq_prefixes}
+        try:
+            for p in uniq_prefixes:
+                e = self._get_prefix_entry(p, limit, P)
                 if e is None:
                     return None
                 entries[p] = e
+        finally:
+            self._prefix_active = set()
         uniq = list(entries)
-        P = max(entries[p]["bucket"] for p in uniq)
         max_new = max(budgets)
         limits_s = [self.max_model_len - b - 1 - P for b in budgets]
         if min(limits_s) < 1:
@@ -998,3 +1089,5 @@ class JaxEngine(InferenceEngine):
         self.params = None
         self._decode_loops.clear()
         self._prefix_cache.clear()
+        self._prefix_bytes = 0
+        self._prefix_lens_memo.clear()

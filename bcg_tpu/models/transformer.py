@@ -167,15 +167,23 @@ def attention(q, k, v, mask, scale, impl: str = "xla"):
 # ------------------------------------------------------------------ forward
 
 def _write_cache(entry: Dict, k, v, pos) -> Dict:
-    """Write fresh k/v into the cache entry (quantizing if it is int8)."""
+    """Write fresh k/v into the cache entry (quantizing if it is int8).
+
+    Quantized entries store k/v [B, Hkv, S, Dh] (S-major-of-last-two):
+    int8 arrays tile as (32, 128) on the last two dims, so a kernel block
+    slicing S x Dh is native — the bf16 layout's [.., S, Hkv, Dh] would
+    hand Mosaic (1, 128)-row int8 blocks (measured ~70x slower decode).
+    """
     new = dict(entry)
     if "k_scale" in entry:
         from bcg_tpu.ops.decode_attention import quantize_kv
 
-        kq, ksc = quantize_kv(k)   # ksc: [B, T, Hkv]
+        kq, ksc = quantize_kv(k)   # kq: [B, T, Hkv, Dh]; ksc: [B, T, Hkv]
         vq, vsc = quantize_kv(v)
-        new["k"] = jax.lax.dynamic_update_slice(entry["k"], kq, (0, pos, 0, 0))
-        new["v"] = jax.lax.dynamic_update_slice(entry["v"], vq, (0, pos, 0, 0))
+        new["k"] = jax.lax.dynamic_update_slice(
+            entry["k"], kq.transpose(0, 2, 1, 3), (0, 0, pos, 0))
+        new["v"] = jax.lax.dynamic_update_slice(
+            entry["v"], vq.transpose(0, 2, 1, 3), (0, 0, pos, 0))
         new["k_scale"] = jax.lax.dynamic_update_slice(
             entry["k_scale"], ksc.transpose(0, 2, 1), (0, 0, pos))
         new["v_scale"] = jax.lax.dynamic_update_slice(
@@ -206,24 +214,25 @@ def _cache_attention(q, entry: Dict, mask, scale, impl: str):
     if quantized:
         from bcg_tpu.ops.decode_attention import dequantize_kv
 
-        # Scales are cached [B, Hkv, S]; the (slow-path) full dequant
-        # wants [B, S, Hkv] to broadcast against [B, S, Hkv, Dh].
-        k = dequantize_kv(k, entry["k_scale"].transpose(0, 2, 1)).astype(q.dtype)
-        v = dequantize_kv(v, entry["v_scale"].transpose(0, 2, 1)).astype(q.dtype)
+        # Quantized cache layout is [B, Hkv, S, Dh] with scales
+        # [B, Hkv, S]; the (slow-path) full dequant transposes back to
+        # the attention layout [B, S, Hkv, Dh].
+        k = dequantize_kv(k, entry["k_scale"]).transpose(0, 2, 1, 3).astype(q.dtype)
+        v = dequantize_kv(v, entry["v_scale"]).transpose(0, 2, 1, 3).astype(q.dtype)
     return _xla_attention(q, k, v, mask[:, None, :], scale)
 
 
 def _dequant_slice(entry: Dict, name: str, upto: int, dtype) -> jax.Array:
-    """Cache slots [0, upto) of k or v, dequantized if stored int8."""
-    raw = entry[name][:, :upto]
+    """Cache slots [0, upto) of k or v as [B, upto, Hkv, Dh], dequantized
+    (and transposed out of the [B, Hkv, S, Dh] storage) if stored int8."""
     scale_name = f"{name}_scale"
     if scale_name not in entry:
-        return raw.astype(dtype)
+        return entry[name][:, :upto].astype(dtype)
     from bcg_tpu.ops.decode_attention import dequantize_kv
 
     return dequantize_kv(
-        raw, entry[scale_name][:, :, :upto].transpose(0, 2, 1)
-    ).astype(dtype)
+        entry[name][:, :, :upto], entry[scale_name][:, :, :upto]
+    ).transpose(0, 2, 1, 3).astype(dtype)
 
 
 def _block(
@@ -300,11 +309,13 @@ def init_kv_cache(
 ):
     """Per-layer list of {k, v[, k_scale, v_scale]} leaves.
 
-    k/v are [B, S, Hkv, Dh]; with ``quantized`` they are int8 with f32
-    per-(position, kv-head) absmax scales stored [B, Hkv, S] (S minor —
-    the lane-aligned layout the Pallas decode kernel consumes directly) —
-    halving the HBM traffic of the bandwidth-bound decode step (the
-    kernel dequantizes in VMEM; see ops/decode_attention.py).
+    k/v are [B, S, Hkv, Dh]; with ``quantized`` they are int8 stored
+    [B, Hkv, S, Dh] — int8 tiles as (32, 128) over the last two dims, so
+    an S x Dh kernel block is Mosaic-native (the bf16 axis order would
+    hand it (1, 128)-row int8 blocks) — with f32 per-(position, kv-head)
+    absmax scales stored [B, Hkv, S] (S minor, lane-aligned).  Halves the
+    HBM traffic of the bandwidth-bound decode step; the kernels
+    dequantize in VMEM (see ops/decode_attention.py).
 
     Kept as separate pytree leaves (not one stacked array) so the
     ``dynamic_update_slice`` in each decode step is a pure per-buffer
@@ -312,13 +323,14 @@ def init_kv_cache(
     layout would force a gather + restack copy of the whole cache every
     token."""
     shape = (batch, max_len, spec.num_kv_heads, spec.head_dim)
+    qshape = (batch, spec.num_kv_heads, max_len, spec.head_dim)
     layers = []
     for _ in range(spec.num_layers):
         if quantized:
             scale_shape = (batch, spec.num_kv_heads, max_len)
             layers.append({
-                "k": jnp.zeros(shape, jnp.int8),
-                "v": jnp.zeros(shape, jnp.int8),
+                "k": jnp.zeros(qshape, jnp.int8),
+                "v": jnp.zeros(qshape, jnp.int8),
                 "k_scale": jnp.ones(scale_shape, jnp.float32),
                 "v_scale": jnp.ones(scale_shape, jnp.float32),
             })
@@ -522,11 +534,12 @@ def _block_chunk(
         if quantized:
             from bcg_tpu.ops.decode_attention import dequantize_kv
 
-            # Slow fallback (off-TPU / unaligned head dim): full dequant.
+            # Slow fallback (off-TPU / unaligned head dim): full dequant
+            # out of the [B, Hkv, S, Dh] storage layout.
             ck = dequantize_kv(
-                ck, new_entry["k_scale"].transpose(0, 2, 1)).astype(q.dtype)
+                ck, new_entry["k_scale"]).transpose(0, 2, 1, 3).astype(q.dtype)
             cv = dequantize_kv(
-                cv, new_entry["v_scale"].transpose(0, 2, 1)).astype(q.dtype)
+                cv, new_entry["v_scale"]).transpose(0, 2, 1, 3).astype(q.dtype)
         attn_out = attention(
             q, ck, cv, attn_mask, scale, "xla" if quantized else impl
         )

@@ -13,11 +13,13 @@ kernels for the same reason, ``vllm_agent.py:34-55``).  This kernel:
   ``group`` query heads of that kv head at once (an [group, Dh] MXU tile
   instead of ``group`` separate vector products).
 
-Layouts: q [B, H, Dh]; k/v [B, S, Hkv, Dh] (cache layout, any dtype);
-scales [B, Hkv, S] when quantized (S minor-most: that is both the
-lane-aligned Mosaic layout and what the cache stores, so no per-step
-transpose ever happens); mask [B, S] bool (attendable slots).
-Returns [B, H, Dh] in q's dtype.
+Layouts: q [B, H, Dh]; bf16 k/v [B, S, Hkv, Dh] (cache layout); int8
+k/v [B, Hkv, S, Dh] — int8 arrays tile as (32, 128) over the last two
+dims, so the kernel's (block_s, Dh) block is Mosaic-native, where the
+bf16 axis order would hand it (1, 128)-row int8 blocks (measured ~70x
+slower); scales [B, Hkv, S] (S minor-most, lane-aligned, exactly what
+the cache stores — no per-step transpose); mask [B, S] bool (attendable
+slots).  Returns [B, H, Dh] in q's dtype.
 """
 
 from __future__ import annotations
@@ -45,13 +47,18 @@ def _decode_kernel(
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
     q = q_ref[0, 0]                          # [rows, Dh]
-    k = k_ref[0, :, 0, :]                    # [Sblk, Dh]
-    v = v_ref[0, :, 0, :]
     mask = mask_ref[0]                       # [M, Sblk] bool
 
     if quantized:
+        # int8 cache layout [B, Hkv, S, Dh]: the block's last two dims
+        # are (Sblk, Dh) — Mosaic-native (32, 128) int8 tiles.
+        k = k_ref[0, 0]                      # [Sblk, Dh] int8
+        v = v_ref[0, 0]
         k = k.astype(jnp.float32) * ks_ref[0, 0][:, None]
         v = v.astype(jnp.float32) * vs_ref[0, 0][:, None]
+    else:
+        k = k_ref[0, :, 0, :]                # [Sblk, Dh]
+        v = v_ref[0, :, 0, :]
     k = k.astype(q.dtype)
     v = v.astype(q.dtype)
 
@@ -99,24 +106,34 @@ def decode_attention(
     block_s: int = 512,
     interpret: bool = False,
 ):
-    """q [B, H, Dh], k/v [B, S, Hkv, Dh], mask [B, S] -> [B, H, Dh]."""
-    B, H, Dh = q.shape
-    S, Hkv = k.shape[1], k.shape[2]
-    group = H // Hkv
-    quantized = k_scale is not None
+    """q [B, H, Dh], mask [B, S] -> [B, H, Dh].
 
-    kp = _pad_s(k, block_s)
-    vp = _pad_s(v, block_s)
-    mp = _pad_s(mask, block_s, axis=1)[:, None, :]  # [B, 1, S]
-    # Scales arrive [B, Hkv, S] (cache layout): S minor-most keeps the
-    # Mosaic block (1, 1, block_s) lane-aligned with no copy here.
+    k/v: [B, S, Hkv, Dh] bf16, or — when ``k_scale`` is given — the int8
+    cache layout [B, Hkv, S, Dh] (int8 tiles natively as (32, 128) over
+    the last two dims; the bf16 axis order would hand Mosaic (1, 128)-row
+    int8 blocks, measured ~70x slower).  Scales [B, Hkv, S].
+    """
+    B, H, Dh = q.shape
+    quantized = k_scale is not None
     if quantized:
+        Hkv, S = k.shape[1], k.shape[2]
+        kp = _pad_s(k, block_s, axis=2)
+        vp = _pad_s(v, block_s, axis=2)
+        kv_spec = pl.BlockSpec((1, 1, block_s, Dh), lambda b, h, s: (b, h, s, 0))
         ksp = _pad_s(k_scale, block_s, axis=2)
         vsp = _pad_s(v_scale, block_s, axis=2)
-    else:  # dummy operands so the kernel signature is stable
-        ksp = jnp.ones((B, Hkv, kp.shape[1]), jnp.float32)
+        Sp = kp.shape[2]
+    else:
+        S, Hkv = k.shape[1], k.shape[2]
+        kp = _pad_s(k, block_s)
+        vp = _pad_s(v, block_s)
+        kv_spec = pl.BlockSpec((1, block_s, 1, Dh), lambda b, h, s: (b, s, h, 0))
+        Sp = kp.shape[1]
+        # dummy operands so the kernel signature is stable
+        ksp = jnp.ones((B, Hkv, Sp), jnp.float32)
         vsp = ksp
-    Sp = kp.shape[1]
+    group = H // Hkv
+    mp = _pad_s(mask, block_s, axis=1)[:, None, :]  # [B, 1, S]
     nS = Sp // block_s
 
     qg = q.reshape(B, Hkv, group, Dh)
@@ -130,8 +147,8 @@ def decode_attention(
         grid=(B, Hkv, nS),
         in_specs=[
             pl.BlockSpec((1, 1, group, Dh), lambda b, h, s: (b, h, 0, 0)),
-            pl.BlockSpec((1, block_s, 1, Dh), lambda b, h, s: (b, s, h, 0)),
-            pl.BlockSpec((1, block_s, 1, Dh), lambda b, h, s: (b, s, h, 0)),
+            kv_spec,
+            kv_spec,
             pl.BlockSpec((1, 1, block_s), lambda b, h, s: (b, h, s)),
             pl.BlockSpec((1, 1, block_s), lambda b, h, s: (b, h, s)),
             pl.BlockSpec((1, 1, block_s), lambda b, h, s: (b, 0, s)),
@@ -159,28 +176,34 @@ def chunk_decode_attention(
 ):
     """Fast-forward chunk decode over the (possibly int8) cache.
 
-    q [B, K, H, Dh] (K chunk positions), k/v [B, S, Hkv, Dh],
-    mask [B, K, S] -> [B, K, H, Dh].  Same streaming/online-softmax/
+    q [B, K, H, Dh] (K chunk positions), mask [B, K, S] -> [B, K, H, Dh];
+    k/v [B, S, Hkv, Dh] bf16 or the int8 cache layout [B, Hkv, S, Dh]
+    (see :func:`decode_attention`).  Same streaming/online-softmax/
     in-VMEM-dequant design as :func:`decode_attention`, with an
     [K*group, Dh] query tile per (batch, kv-head) program — K=4, group=2
     is an 8-row MXU tile, where the prefill flash kernel would pad the
     4 chunk rows to a 128-row query block (32x wasted work).
     """
     B, K, H, Dh = q.shape
-    S, Hkv = k.shape[1], k.shape[2]
-    group = H // Hkv
     quantized = k_scale is not None
-
-    kp = _pad_s(k, block_s)
-    vp = _pad_s(v, block_s)
-    mp = _pad_s(mask, block_s, axis=2)              # [B, K, Sp]
     if quantized:
+        Hkv = k.shape[1]
+        kp = _pad_s(k, block_s, axis=2)
+        vp = _pad_s(v, block_s, axis=2)
+        kv_spec = pl.BlockSpec((1, 1, block_s, Dh), lambda b, h, s: (b, h, s, 0))
         ksp = _pad_s(k_scale, block_s, axis=2)
         vsp = _pad_s(v_scale, block_s, axis=2)
+        Sp = kp.shape[2]
     else:
-        ksp = jnp.ones((B, Hkv, kp.shape[1]), jnp.float32)
+        Hkv = k.shape[2]
+        kp = _pad_s(k, block_s)
+        vp = _pad_s(v, block_s)
+        kv_spec = pl.BlockSpec((1, block_s, 1, Dh), lambda b, h, s: (b, s, h, 0))
+        Sp = kp.shape[1]
+        ksp = jnp.ones((B, Hkv, Sp), jnp.float32)
         vsp = ksp
-    Sp = kp.shape[1]
+    group = H // Hkv
+    mp = _pad_s(mask, block_s, axis=2)              # [B, K, Sp]
     nS = Sp // block_s
 
     # [B, K, Hkv, group, Dh] -> [B, Hkv, K*group, Dh]: position-major row
@@ -200,8 +223,8 @@ def chunk_decode_attention(
         grid=(B, Hkv, nS),
         in_specs=[
             pl.BlockSpec((1, 1, K * group, Dh), lambda b, h, s: (b, h, 0, 0)),
-            pl.BlockSpec((1, block_s, 1, Dh), lambda b, h, s: (b, s, h, 0)),
-            pl.BlockSpec((1, block_s, 1, Dh), lambda b, h, s: (b, s, h, 0)),
+            kv_spec,
+            kv_spec,
             pl.BlockSpec((1, 1, block_s), lambda b, h, s: (b, h, s)),
             pl.BlockSpec((1, 1, block_s), lambda b, h, s: (b, h, s)),
             pl.BlockSpec((1, K, block_s), lambda b, h, s: (b, 0, s)),

@@ -14,7 +14,9 @@ from bcg_tpu.parallel.distributed import (
     shutdown,
 )
 from bcg_tpu.parallel.mesh import build_mesh, mesh_axes
-from bcg_tpu.parallel.sharding import param_sharding, shard_params, kv_cache_sharding
+from bcg_tpu.parallel.sharding import (
+    param_sharding, shard_params, kv_cache_sharding, kv_scale_sharding,
+)
 
 __all__ = [
     "build_mesh",
@@ -26,4 +28,5 @@ __all__ = [
     "shard_params",
     "shutdown",
     "kv_cache_sharding",
+    "kv_scale_sharding",
 ]

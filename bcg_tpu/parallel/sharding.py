@@ -89,9 +89,23 @@ def shard_params(params: Dict, spec: ModelSpec, mesh: Mesh) -> Dict:
     return place([], params)
 
 
-def kv_cache_sharding(mesh: Mesh) -> NamedSharding:
-    """[B, S, Hkv, Dh]: batch over dp, sequence over sp, heads over tp."""
+def kv_cache_sharding(mesh: Mesh, quantized: bool = False) -> NamedSharding:
+    """Sharding for KV-cache k/v leaves: batch over dp, sequence over sp,
+    kv-heads over tp.
+
+    bf16 caches are [B, S, Hkv, Dh]; quantized (int8) caches store
+    [B, Hkv, S, Dh] (models/transformer.py init_kv_cache), so the axis
+    order flips.  int8 scale leaves ([B, Hkv, S]) need
+    ``kv_scale_sharding`` instead.
+    """
+    if quantized:
+        return NamedSharding(mesh, P("dp", "tp", "sp", None))
     return NamedSharding(mesh, P("dp", "sp", "tp", None))
+
+
+def kv_scale_sharding(mesh: Mesh) -> NamedSharding:
+    """int8 KV scale leaves [B, Hkv, S]: dp x tp x sp."""
+    return NamedSharding(mesh, P("dp", "tp", "sp"))
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:

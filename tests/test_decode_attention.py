@@ -50,8 +50,10 @@ def test_int8_kv_close_to_fp():
     ref = _reference(q, k, v, mask, scale)
     kq, ks = quantize_kv(k)
     vq, vs = quantize_kv(v)
-    # decode_attention consumes the cache's [B, Hkv, S] scale layout
-    out = decode_attention(q, kq, vq, mask, scale,
+    # decode_attention consumes the cache's int8 layout: k/v
+    # [B, Hkv, S, Dh], scales [B, Hkv, S]
+    out = decode_attention(q, kq.transpose(0, 2, 1, 3),
+                           vq.transpose(0, 2, 1, 3), mask, scale,
                            k_scale=ks.transpose(0, 2, 1),
                            v_scale=vs.transpose(0, 2, 1),
                            block_s=128, interpret=True)
@@ -104,7 +106,8 @@ def test_chunk_int8_close_to_fp():
     ref = _xla_attention(q, k, v, mask, scale)
     kq, ks = quantize_kv(k)
     vq, vs = quantize_kv(v)
-    out = chunk_decode_attention(q, kq, vq, mask, scale,
+    out = chunk_decode_attention(q, kq.transpose(0, 2, 1, 3),
+                                 vq.transpose(0, 2, 1, 3), mask, scale,
                                  k_scale=ks.transpose(0, 2, 1),
                                  v_scale=vs.transpose(0, 2, 1),
                                  block_s=128, interpret=True)
