@@ -68,6 +68,16 @@ _TIMING = os.environ.get("BCG_TPU_TIMING", "") not in ("", "0")
 _comp_cache_enabled = False
 
 
+class BudgetError(ValueError):
+    """A request whose token budget cannot fit the context window.
+
+    The ONLY generation-time error class the engine converts into
+    per-row ``{"error": ...}`` results; anything else (XLA/Pallas
+    compile failures, runtime errors) propagates — see
+    batch_generate_json.
+    """
+
+
 def _enable_compilation_cache() -> None:
     """Persist compiled XLA executables across processes.
 
@@ -370,7 +380,7 @@ class JaxEngine(InferenceEngine):
         decode budget)."""
         limits = [self.max_model_len - b - 1 for b in budgets]
         if min(limits) < 1:
-            raise ValueError(
+            raise BudgetError(
                 f"max_tokens={max(budgets)} leaves no room for a prompt "
                 f"within max_model_len={self.max_model_len}"
             )
@@ -1026,7 +1036,14 @@ class JaxEngine(InferenceEngine):
         schemas = [schema for _, _, schema in prompts]
         try:
             texts = self._run_guided(parts, schemas, temperature, max_tokens)
-        except ValueError as e:
+        except BudgetError as e:
+            # ONLY the engine's own budget check degrades to error dicts
+            # (the caller's retry ladder absorbs them).  A broad
+            # `except ValueError` here once swallowed a Pallas LOWERING
+            # error: every call "failed fast", every agent silently
+            # abstained, and the bench printed a 6x-too-good number —
+            # compiler/runtime errors must crash, not masquerade as bad
+            # LLM output.
             return [{"error": "generation_failed", "message": str(e)} for _ in prompts]
         results = []
         for text in texts:

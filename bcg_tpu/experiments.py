@@ -171,9 +171,22 @@ def run_scale_sweep(
     return results
 
 
+def run_model_sweep(models: List[str], **kwargs) -> List[Dict]:
+    """BASELINE.json config 5: the Q2 mixed honest/Byzantine population
+    swept across model families (each model boots its own engine; the
+    reference would re-run its CLI per `MODEL_PRESETS` entry,
+    config.py:20-30)."""
+    results = []
+    for m in models:
+        r = run_preset(PRESETS["q2"], model_name=m, **kwargs)
+        r["preset"] = f"model-sweep:{m}"
+        results.append(r)
+    return results
+
+
 def main(argv: Optional[List[str]] = None) -> None:
     p = argparse.ArgumentParser(description="BCG paper-experiment presets")
-    p.add_argument("preset", choices=[*PRESETS, "scale-sweep"])
+    p.add_argument("preset", choices=[*PRESETS, "scale-sweep", "model-sweep"])
     p.add_argument("--runs", type=int, default=1)
     p.add_argument("--model", type=str, default=None)
     p.add_argument("--backend", type=str, default=None, choices=["jax", "fake"])
@@ -181,6 +194,10 @@ def main(argv: Optional[List[str]] = None) -> None:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--agents", type=str, default="16,32,64",
                    help="scale-sweep agent counts, comma-separated")
+    p.add_argument("--models", type=str,
+                   default="Qwen/Qwen3-32B,mistralai/Mistral-Small-Instruct-2409",
+                   help="model-sweep model names, comma-separated "
+                        "(BASELINE.json config 5)")
     p.add_argument("--byzantine-fraction", type=float, default=0.0,
                    help="scale-sweep Byzantine share of each population")
     p.add_argument("--concurrency", type=int, default=1,
@@ -199,6 +216,13 @@ def main(argv: Optional[List[str]] = None) -> None:
             [int(x) for x in args.agents.split(",")],
             byzantine_fraction=args.byzantine_fraction, **common,
         )
+        print(json.dumps([{k: r[k] for k in ("preset", "aggregate")} for r in out], indent=2))
+    elif args.preset == "model-sweep":
+        if common.pop("model_name"):
+            p.error("model-sweep takes --models (a comma-separated list), "
+                    "not --model")
+        models = [m.strip() for m in args.models.split(",") if m.strip()]
+        out = run_model_sweep(models, **common)
         print(json.dumps([{k: r[k] for k in ("preset", "aggregate")} for r in out], indent=2))
     else:
         out = run_preset(PRESETS[args.preset], **common)

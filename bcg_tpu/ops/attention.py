@@ -33,7 +33,8 @@ _NEG_INF = -1e30
 # ------------------------------------------------------------------ pallas
 
 def _flash_kernel(
-    q_ref, k_ref, v_ref, mask_ref, o_ref, m_scr, l_scr, acc_scr, *, scale, num_s_blocks
+    q_ref, k_ref, v_ref, mask_ref, blk_any_ref, o_ref,
+    m_scr, l_scr, acc_scr, *, scale, num_s_blocks,
 ):
     s = pl.program_id(3)
 
@@ -43,30 +44,43 @@ def _flash_kernel(
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    q = q_ref[0, 0]                      # [Tblk, Dh]
-    k = k_ref[0, 0]                      # [Sblk, Dh]
-    v = v_ref[0, 0]                      # [Sblk, Dh]
-    mask = mask_ref[0]                   # [Tblk, Sblk] bool
+    # Block skipping: a fully-masked (q-block, kv-block) pair contributes
+    # nothing to the online softmax (p == 0, m/l/acc unchanged), so skip
+    # its two MXU dots entirely.  In a left-padded suffix prefill over a
+    # cached prefix, the causal upper triangle plus the pad region is
+    # ~25-40% of all blocks — prefill attention is compute-bound at game
+    # shapes, so skipped blocks are wall-clock (the DMA still pipelines,
+    # but it overlaps the remaining compute).  The liveness table lives
+    # whole in SMEM ((1,1,1) VMEM blocks are not lowerable on TPU);
+    # int32 because SMEM scalar reads of bool are not supported either.
+    b, t = pl.program_id(0), pl.program_id(2)
 
-    scores = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale                            # [Tblk, Sblk]
-    scores = jnp.where(mask, scores, _NEG_INF)
+    @pl.when(blk_any_ref[b, t, s] != 0)
+    def _compute():
+        q = q_ref[0, 0]                      # [Tblk, Dh]
+        k = k_ref[0, 0]                      # [Sblk, Dh]
+        v = v_ref[0, 0]                      # [Sblk, Dh]
+        mask = mask_ref[0]                   # [Tblk, Sblk] bool
 
-    m_prev = m_scr[...]                  # [Tblk, 1]
-    m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
-    alpha = jnp.exp(m_prev - m_new)
-    # Multiply by the mask: with the finite -1e30 sentinel, a fully-masked
-    # row has m_new == -1e30 and exp(scores - m_new) == 1, so the mask —
-    # not the exponential — must zero forbidden entries.
-    p = jnp.exp(scores - m_new) * mask.astype(jnp.float32)
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale                            # [Tblk, Sblk]
+        scores = jnp.where(mask, scores, _NEG_INF)
 
-    m_scr[...] = m_new
-    l_scr[...] = alpha * l_scr[...] + jnp.sum(p, axis=-1, keepdims=True)
-    acc_scr[...] = alpha * acc_scr[...] + jax.lax.dot_general(
-        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
+        m_prev = m_scr[...]                  # [Tblk, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        # Multiply by the mask: with the finite -1e30 sentinel, a fully-
+        # masked row has m_new == -1e30 and exp(scores - m_new) == 1, so
+        # the mask — not the exponential — must zero forbidden entries.
+        p = jnp.exp(scores - m_new) * mask.astype(jnp.float32)
+
+        m_scr[...] = m_new
+        l_scr[...] = alpha * l_scr[...] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[...] = alpha * acc_scr[...] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
 
     @pl.when(s == num_s_blocks - 1)
     def _finish():
@@ -83,6 +97,13 @@ def _pallas_flash(q, k, v, mask, scale, block_q: int, block_kv: int,
     group = H // Hkv
     nT, nS = T // block_q, S // block_kv
 
+    # Per-(q-block, kv-block) liveness for the kernel's skip guard.
+    blk_any = (
+        mask.reshape(B, nT, block_q, nS, block_kv)
+        .any(axis=(2, 4))
+        .astype(jnp.int32)
+    )
+
     kernel = functools.partial(_flash_kernel, scale=scale, num_s_blocks=nS)
     return pl.pallas_call(
         kernel,
@@ -96,6 +117,7 @@ def _pallas_flash(q, k, v, mask, scale, block_q: int, block_kv: int,
                 (1, 1, block_kv, Dh), lambda b, h, t, s, g=group: (b, h // g, s, 0)
             ),
             pl.BlockSpec((1, block_q, block_kv), lambda b, h, t, s: (b, t, s)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, Dh), lambda b, h, t, s: (b, h, t, 0)),
         out_shape=jax.ShapeDtypeStruct((B, H, T, Dh), q.dtype),
@@ -108,7 +130,7 @@ def _pallas_flash(q, k, v, mask, scale, block_q: int, block_kv: int,
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(q, k, v, mask)
+    )(q, k, v, mask, blk_any)
 
 
 def _pad_to(x, axis: int, multiple: int, value=0):

@@ -169,6 +169,22 @@ def main() -> None:
         rounds_done += 1
     elapsed = time.perf_counter() - t0
 
+    # Sanity: a real engine must actually have DECODED.  When every LLM
+    # call errors out, agents silently abstain and rounds finish in
+    # milliseconds — a broad exception-to-error-dict path once turned a
+    # Pallas lowering bug into a 6x-too-good number here.  Refuse to
+    # report a throughput that never ran the model.
+    if backend != "fake" and not getattr(engine, "last_decode_steps", 0):
+        print(json.dumps({
+            "metric": "agent_decisions_per_sec",
+            "value": 0.0,
+            "unit": "decisions/sec",
+            "vs_baseline": 0.0,
+            "error": "engine produced no decode steps during the measured "
+                     "window - every LLM call failed; see run logs",
+        }))
+        return
+
     # decide + vote are each one guided LLM generation per agent per round.
     decisions = 2 * n_agents * rounds_done
     decisions_per_sec = decisions / elapsed

@@ -37,6 +37,21 @@ class TestExperiments:
         assert outs[0]["per_run"][0]["num_byzantine"] == 2
         assert outs[0]["per_run"][0]["num_honest"] == 6
 
+    def test_model_sweep_runs_each_model(self):
+        from bcg_tpu.experiments import run_model_sweep
+
+        outs = run_model_sweep(
+            ["bcg-tpu/tiny-test", "bcg-tpu/bench-1b"], runs=1,
+            backend="fake", max_rounds=3, seed=0,
+        )
+        assert [o["preset"] for o in outs] == [
+            "model-sweep:bcg-tpu/tiny-test", "model-sweep:bcg-tpu/bench-1b",
+        ]
+        for o in outs:
+            # Q2 composition (8H+2B) per BASELINE.json config 5.
+            assert o["per_run"][0]["num_byzantine"] == 2
+            assert o["per_run"][0]["num_honest"] == 8
+
     def test_aggregate_empty_values(self):
         agg = aggregate([{"consensus_reached": True, "total_rounds": 3}])
         assert agg["byzantine_infiltration_rate"] is None
