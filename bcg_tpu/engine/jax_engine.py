@@ -237,8 +237,17 @@ class JaxEngine(InferenceEngine):
         if params is not None:
             self.params = params
         elif config.model_name.startswith("bcg-tpu/"):
-            # Hermetic presets: random weights (no checkpoint needed).
-            self.params = init_params(self.spec, jax.random.PRNGKey(0))
+            # Hermetic presets: random weights (no checkpoint needed),
+            # quantized leaf-by-leaf as they are created — the same
+            # streaming the checkpoint loader does, so an 8B-class bench
+            # never holds the full bf16 tree (which alone OOMs a 16 GB
+            # chip).
+            from bcg_tpu.models.quantize import quantize_leaf_transform
+
+            self.params = init_params(
+                self.spec, jax.random.PRNGKey(0),
+                leaf_transform=quantize_leaf_transform(self.spec) if quantize else None,
+            )
         else:
             from bcg_tpu.models.loader import load_checkpoint_params
             from bcg_tpu.models.quantize import quantize_leaf_transform
@@ -337,7 +346,13 @@ class JaxEngine(InferenceEngine):
         except Exception:
             self._mem_limit = None
         if self._mem_limit:
-            self._prefix_budget = min(4 << 30, int(self._mem_limit * 0.25))
+            # Weight-aware: the prefix cache may only use a slice of what
+            # the model leaves free (an 8B int8 model on a 16 GB chip
+            # leaves ~7 GB for KV + prefixes + workspace).
+            free = self._mem_limit - self._param_bytes / self._tp_devices
+            self._prefix_budget = min(
+                4 << 30, max(256 << 20, int(free * 0.25))
+            )
 
     # ------------------------------------------------------------- tokenizing
 

@@ -111,6 +111,15 @@ MODEL_SPECS: Dict[str, ModelSpec] = {
         num_heads=16, num_kv_heads=8, head_dim=128,
         intermediate_size=6144, qk_norm=True, max_position=8192,
     ),
+    # Qwen3-8B dims with random weights: real-scale single-chip serving
+    # (int8 weights ~8.8 GB incl. the bf16 embedding — fits one v5e-16GB
+    # chip with the KV cache and a reduced prefix-cache budget).
+    "bcg-tpu/bench-8b": ModelSpec(
+        name="bcg-tpu/bench-8b",
+        vocab_size=151936, hidden_size=4096, num_layers=36,
+        num_heads=32, num_kv_heads=8, head_dim=128,
+        intermediate_size=12288, qk_norm=True, max_position=8192,
+    ),
 }
 
 

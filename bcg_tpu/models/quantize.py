@@ -22,6 +22,7 @@ Norm vectors stay bf16.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Dict, Union
 
 import jax
@@ -36,13 +37,31 @@ DenseWeight = Union[jax.Array, QuantizedDense]
 _QUANT_LEAVES = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
 
 
-def quantize_weight(w: jax.Array) -> QuantizedDense:
-    """[in, out] bf16/f32 -> int8 + per-output-channel f32 absmax scale."""
+def _quantize_impl(w: jax.Array) -> QuantizedDense:
     w32 = w.astype(jnp.float32)
     absmax = jnp.max(jnp.abs(w32), axis=0)
     scale = jnp.maximum(absmax, 1e-12) / 127.0
     q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
     return {"q": q, "scale": scale}
+
+
+_quantize_consuming = partial(jax.jit, donate_argnums=0)(_quantize_impl)
+_quantize_preserving = jax.jit(_quantize_impl)
+
+
+def quantize_weight(w, consume: bool = False) -> QuantizedDense:
+    """[in, out] bf16/f32 -> int8 + per-output-channel f32 absmax scale.
+
+    Jitted so the op chain fuses: run eagerly it materializes a full f32
+    copy of the weight (2x bf16) — quantizing an 8B model's [D, V] head
+    that way OOMs a 16 GB chip during INIT.  ``consume=True``
+    additionally donates the source buffer (peak = int8 output only) —
+    pass it ONLY for a tensor the caller owns exclusively; the default
+    preserves the input, matching ``quantize_params(consume=False)``'s
+    contract that the bf16 tree stays usable.
+    """
+    fn = _quantize_consuming if consume else _quantize_preserving
+    return fn(jnp.asarray(w))
 
 
 def is_quantized(w: DenseWeight) -> bool:
@@ -96,7 +115,7 @@ def quantize_params(params: Dict, spec: ModelSpec, consume: bool = False) -> Dic
         for k in list(layer):
             v = layer[k]
             if k in _QUANT_LEAVES:
-                new_layer[k] = quantize_weight(v)
+                new_layer[k] = quantize_weight(v, consume=consume)
                 if consume:
                     del layer[k]
                 del v  # drop the local bf16 reference immediately
@@ -105,11 +124,11 @@ def quantize_params(params: Dict, spec: ModelSpec, consume: bool = False) -> Dic
         out_layers.append(new_layer)
     out["layers"] = out_layers
     if "lm_head" in params:
-        out["lm_head"] = quantize_weight(params["lm_head"])
+        out["lm_head"] = quantize_weight(params["lm_head"], consume=consume)
         if consume:
             del params["lm_head"]
     elif spec.tie_embeddings:
-        out["lm_head"] = quantize_weight(params["embed"].T)
+        out["lm_head"] = quantize_weight(params["embed"].T, consume=True)
     return out
 
 
@@ -121,7 +140,7 @@ def quantize_leaf_transform(spec: ModelSpec):
     def transform(logical: str, tensor):
         leaf = logical.split(".")[-1]
         if leaf in _QUANT_LEAVES or leaf == "lm_head":
-            return quantize_weight(tensor)
+            return quantize_weight(tensor, consume=True)
         return tensor
 
     return transform
@@ -132,5 +151,5 @@ def ensure_quantized_head(params: Dict, spec: ModelSpec) -> Dict:
     leaf-transform load (which never sees an ``lm_head`` tensor) built the
     rest of the tree."""
     if "lm_head" not in params and spec.tie_embeddings:
-        params["lm_head"] = quantize_weight(params["embed"].T)
+        params["lm_head"] = quantize_weight(params["embed"].T, consume=True)
     return params

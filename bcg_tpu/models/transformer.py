@@ -36,7 +36,7 @@ TransformerParams = Dict  # pytree: see init_params for the layout
 # ----------------------------------------------------------------- building
 
 def init_params(
-    spec: ModelSpec, key: jax.Array, dtype=jnp.bfloat16
+    spec: ModelSpec, key: jax.Array, dtype=jnp.bfloat16, leaf_transform=None
 ) -> TransformerParams:
     """Random-init parameters with the HF-compatible logical layout.
 
@@ -51,29 +51,38 @@ def init_params(
       layers.l.w_gate/w_up [D, F]   layers.l.w_down [F, D]
       final_norm       [D]
       lm_head          [D, V]       (absent when tie_embeddings)
+
+    ``leaf_transform(logical_name, tensor)`` (same hook as the streamed
+    checkpoint loader) is applied to each dense weight AS IT IS CREATED,
+    so e.g. int8 quantization never holds the whole bf16 model: an
+    8B-class random-weight bench would otherwise OOM a 16 GB chip during
+    init alone.
     """
     keys = iter(jax.random.split(key, 4 + spec.num_layers * 7))
 
-    def _init_dense(k, shape):
+    def _init_dense(k, logical, shape):
         fan_in = shape[0]
-        return (jax.random.normal(k, shape, jnp.float32) / math.sqrt(fan_in)).astype(dtype)
+        w = (jax.random.normal(k, shape, jnp.float32) / math.sqrt(fan_in)).astype(dtype)
+        return leaf_transform(logical, w) if leaf_transform else w
 
     params: Dict = {
-        "embed": _init_dense(next(keys), (spec.vocab_size, spec.hidden_size)),
+        "embed": _init_dense(next(keys), "embed", (spec.vocab_size, spec.hidden_size)),
         "final_norm": jnp.ones((spec.hidden_size,), dtype),
         "layers": [],
     }
-    for _ in range(spec.num_layers):
+    for li in range(spec.num_layers):
+        pre = f"layers.{li}."
+
         layer = {
             "attn_norm": jnp.ones((spec.hidden_size,), dtype),
-            "wq": _init_dense(next(keys), (spec.hidden_size, spec.q_size)),
-            "wk": _init_dense(next(keys), (spec.hidden_size, spec.kv_size)),
-            "wv": _init_dense(next(keys), (spec.hidden_size, spec.kv_size)),
-            "wo": _init_dense(next(keys), (spec.q_size, spec.hidden_size)),
+            "wq": _init_dense(next(keys), pre + "wq", (spec.hidden_size, spec.q_size)),
+            "wk": _init_dense(next(keys), pre + "wk", (spec.hidden_size, spec.kv_size)),
+            "wv": _init_dense(next(keys), pre + "wv", (spec.hidden_size, spec.kv_size)),
+            "wo": _init_dense(next(keys), pre + "wo", (spec.q_size, spec.hidden_size)),
             "mlp_norm": jnp.ones((spec.hidden_size,), dtype),
-            "w_gate": _init_dense(next(keys), (spec.hidden_size, spec.intermediate_size)),
-            "w_up": _init_dense(next(keys), (spec.hidden_size, spec.intermediate_size)),
-            "w_down": _init_dense(next(keys), (spec.intermediate_size, spec.hidden_size)),
+            "w_gate": _init_dense(next(keys), pre + "w_gate", (spec.hidden_size, spec.intermediate_size)),
+            "w_up": _init_dense(next(keys), pre + "w_up", (spec.hidden_size, spec.intermediate_size)),
+            "w_down": _init_dense(next(keys), pre + "w_down", (spec.intermediate_size, spec.hidden_size)),
         }
         if spec.qk_norm:
             layer["q_norm"] = jnp.ones((spec.head_dim,), dtype)
@@ -84,7 +93,9 @@ def init_params(
             layer["bv"] = jnp.zeros((spec.kv_size,), dtype)
         params["layers"].append(layer)
     if not spec.tie_embeddings:
-        params["lm_head"] = _init_dense(next(keys), (spec.hidden_size, spec.vocab_size))
+        params["lm_head"] = _init_dense(
+            next(keys), "lm_head", (spec.hidden_size, spec.vocab_size)
+        )
     return params
 
 

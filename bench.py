@@ -113,6 +113,9 @@ def main() -> None:
             kv_cache_dtype=kv_dtype,
             decode_fast_forward=_env_flag("BENCH_FAST_FORWARD", True),
             guided_compact_json=_env_flag("BENCH_COMPACT_JSON", True),
+            # Off for models whose weights+KV leave no room for cached
+            # prefix KV (e.g. bench-8b on a 16 GB chip).
+            prefix_caching=_env_flag("BENCH_PREFIX_CACHING", True),
         ),
         metrics=dataclasses.replace(
             base.metrics, save_results=False, generate_plots=False
