@@ -405,6 +405,19 @@ class BCGSimulation:
         for agent in self.agents.values():
             agent.memory.add_round_summary(summary, max_history=ROUND_SUMMARY_HISTORY)
 
+    def set_engine(self, engine) -> None:
+        """Swap the inference engine for this simulation AND its agents.
+
+        Lets a driver route a simulation through a
+        :class:`~bcg_tpu.engine.collective.CollectiveEngine` proxy for the
+        duration of a lockstep wave (cross-game batching) and back —
+        agents hold their own engine reference for the sequential-retry
+        path, so both must move together.
+        """
+        self.engine = engine
+        for agent in self.agents.values():
+            agent.engine = engine
+
     # ------------------------------------------------------------- round loop
 
     def run_round(self) -> None:

@@ -28,8 +28,11 @@ full-precision parity runs), BENCH_KV_DTYPE (default bfloat16; int8
 opts into the quantized KV cache), BENCH_FAST_FORWARD /
 BENCH_COMPACT_JSON (default ON — forced-chain fast-forward decoding
 and whitespace-free generation grammar; set 0 to disable; composes
-with BENCH_KV_DTYPE=int8 via the Pallas chunk decode kernel).  The
-emitted JSON labels every knob.
+with BENCH_KV_DTYPE=int8 via the Pallas chunk decode kernel),
+BENCH_CONCURRENCY (G concurrent games merged into shared device
+batches per phase; decisions/sec then counts all G games),
+BENCH_PREFIX_CACHING (0 to disable cached prefix KV for models whose
+weights leave no room).  The emitted JSON labels every knob.
 """
 
 from __future__ import annotations
@@ -141,36 +144,99 @@ def main() -> None:
             engine=engine,
         )
 
-    # Warmup: round 1 pays XLA compilation for the initial shapes; a
-    # round >= 2 covers the history-grown prompt bucket.  Terminated
-    # games are replaced, and warmup keeps going until a round >= 2 has
-    # actually run (a replacement game restarts at round 1), so the
-    # measured window is compile-free.
-    warm_seed = 1000
-    warmed = 0
-    saw_round2 = False
-    while warmed < warmup_rounds or not saw_round2:
-        if sim.game.game_over:
-            sim = fresh_sim(warm_seed)
-            warm_seed += 1
-        sim.run_round()
-        warmed += 1
-        saw_round2 = saw_round2 or len(sim.game.rounds) >= 2
-        if warmed >= warmup_rounds + 6:  # pathological termination streak
-            break
+    # BENCH_CONCURRENCY=G batches G lockstep games into shared device
+    # batches per phase (engine/collective.py): decode streams the whole
+    # model per step regardless of rows, so G concurrent games cost far
+    # less than G sequential runs.  Each round is a thread wave over a
+    # fresh CollectiveEngine; terminated games are replaced BETWEEN waves
+    # so the merged batch stays G * agents rows (stable compiled shapes).
+    concurrency = int(os.environ.get("BENCH_CONCURRENCY", "1"))
 
-    # A game may terminate at any round (random-weight votes are
-    # correlated); keep starting fresh games until N rounds are measured.
-    rounds_done = 0
+    def run_wave(sims) -> None:
+        from bcg_tpu.engine.collective import run_concurrent_simulations
+
+        def make(s):
+            def go(collective):
+                s.set_engine(collective)
+                try:
+                    s.run_round()
+                finally:
+                    s.set_engine(engine)
+            return go
+
+        outs = run_concurrent_simulations(
+            engine, [make(s) for s in sims], len(sims)
+        )
+        for o in outs:
+            if isinstance(o, BaseException):
+                raise o
+
+    warm_seed = 1000
     seed = 1
-    t0 = time.perf_counter()
-    while rounds_done < measured_rounds:
-        if sim.game.game_over:
-            sim = fresh_sim(seed)  # cheap: no engine re-init, no compile
-            seed += 1
-        sim.run_round()
-        rounds_done += 1
-    elapsed = time.perf_counter() - t0
+    if concurrency > 1:
+        sims = [fresh_sim(warm_seed + i) for i in range(concurrency)]
+
+        def replace_done(sims, next_seed):
+            out = []
+            for s in sims:
+                if s.game.game_over:
+                    out.append(fresh_sim(next_seed))
+                    next_seed += 1
+                else:
+                    out.append(s)
+            return out, next_seed
+
+        warmed, saw_round2 = 0, False
+        while warmed < warmup_rounds or not saw_round2:
+            run_wave(sims)
+            warmed += 1
+            saw_round2 = saw_round2 or any(
+                len(s.game.rounds) >= 2 for s in sims
+            )
+            sims, seed = replace_done(sims, seed)
+            if warmed >= warmup_rounds + 6:
+                break
+
+        waves = 0
+        t0 = time.perf_counter()
+        while waves < measured_rounds:
+            # Replace at the TOP (like the single-game path): the final
+            # wave's terminations aren't pointlessly rebuilt on the clock.
+            sims, seed = replace_done(sims, seed)
+            run_wave(sims)
+            waves += 1
+        elapsed = time.perf_counter() - t0
+        rounds_done = waves * concurrency
+    else:
+        # Warmup: round 1 pays XLA compilation for the initial shapes; a
+        # round >= 2 covers the history-grown prompt bucket.  Terminated
+        # games are replaced, and warmup keeps going until a round >= 2
+        # has actually run (a replacement game restarts at round 1), so
+        # the measured window is compile-free.
+        warmed = 0
+        saw_round2 = False
+        while warmed < warmup_rounds or not saw_round2:
+            if sim.game.game_over:
+                sim = fresh_sim(warm_seed)
+                warm_seed += 1
+            sim.run_round()
+            warmed += 1
+            saw_round2 = saw_round2 or len(sim.game.rounds) >= 2
+            if warmed >= warmup_rounds + 6:  # pathological termination streak
+                break
+
+        # A game may terminate at any round (random-weight votes are
+        # correlated); keep starting fresh games until N rounds are
+        # measured.
+        rounds_done = 0
+        t0 = time.perf_counter()
+        while rounds_done < measured_rounds:
+            if sim.game.game_over:
+                sim = fresh_sim(seed)  # cheap: no engine re-init, no compile
+                seed += 1
+            sim.run_round()
+            rounds_done += 1
+        elapsed = time.perf_counter() - t0
 
     # Sanity: a real engine must actually have DECODED.  When every LLM
     # call errors out, agents silently abstain and rounds finish in
@@ -202,6 +268,7 @@ def main() -> None:
         "extra": {
             "rounds_per_sec": round(rounds_done / elapsed, 4),
             "rounds_measured": rounds_done,
+            "concurrency": concurrency,
             "agents": n_agents,
             "model": model,
             "backend": backend,
@@ -209,6 +276,7 @@ def main() -> None:
             "kv_cache_dtype": cfg.engine.kv_cache_dtype,
             "fast_forward": cfg.engine.decode_fast_forward,
             "compact_json": cfg.engine.guided_compact_json,
+            "prefix_caching": cfg.engine.prefix_caching,
             "platform": platform,
             "elapsed_sec": round(elapsed, 2),
             "baseline_note": "denominator is an ESTIMATED reference rate "
