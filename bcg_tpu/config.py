@@ -92,6 +92,12 @@ class EngineConfig:
     # KV across every round's calls (auto-disabled for template families
     # whose prefix/suffix split is not a special-token boundary).
     prefix_caching: bool = True
+    # Chunked prefill: process full-prompt prefills in slices of this
+    # many tokens (0 = one pass).  Caps activation memory at
+    # O(batch * chunk) — required to serve 8B-class models on a single
+    # 16 GB chip, where whole-prompt prefill temps alone exceed the HBM
+    # left after weights + KV cache.
+    prefill_chunk: int = 0
     # Forced-chain fast-forward: ride each sampled token's DFA-forced
     # continuation (JSON skeleton) through the same decode weight pass.
     # Greedy-equivalent to the standard loop; ~1.5x decode cache slots

@@ -231,6 +231,12 @@ class JaxEngine(InferenceEngine):
         # ops/decode_attention.py chunk_decode_attention); off-TPU the
         # fallback dequantizes the whole cache per step — correct, slow.
         self.fast_forward = bool(getattr(config, "decode_fast_forward", False))
+        self.prefill_chunk = int(getattr(config, "prefill_chunk", 0) or 0)
+        if self.prefill_chunk < 0:
+            raise ValueError(
+                f"prefill_chunk={self.prefill_chunk}: expected 0 (disabled) "
+                "or a positive token count"
+            )
 
         quantize = config.quantization == "int8"
         owns_params = params is None
@@ -895,6 +901,65 @@ class JaxEngine(InferenceEngine):
             parts, batch, sig, real_B, temps, budgets, top_p
         )
 
+    def _prefill_possibly_chunked(self, tokens, valid, L: int, cache,
+                                  prefix_valid=None, prefix_lens=None):
+        """Prefill ``tokens`` (optionally against an existing cached
+        prefix occupying slots ``[0, P)``) in ``prefill_chunk``-sized
+        slices when configured (0 = single pass).
+
+        Chunked prefill caps activation memory at O(B * chunk) instead of
+        O(B * L): a [10, 4096]-token batch through an 8B model needs
+        several 640 MB f32 rope/attention temps, which is exactly what a
+        weights+cache-full 16 GB chip does not have.  Chunk k attends the
+        cached KV of everything before it plus itself — the same
+        computation ``prefill_with_prefix`` already implements for prefix
+        caching, so each slice reuses that jit (one compile per distinct
+        chunk offset, persistent-cached).  Left-padding composes: early
+        all-pad slices write masked-off KV that later chunks never see.
+        Applies on BOTH prompt paths — full-prompt and prefix-cached
+        suffix (the suffix region's chunks extend the prefix).
+        """
+        C = self.prefill_chunk
+        has_prefix = prefix_valid is not None
+        P = prefix_valid.shape[1] if has_prefix else 0
+        if not C or L <= C:
+            if has_prefix:
+                return self._prefill_suffix(
+                    self.params, tokens=jnp.asarray(tokens),
+                    valid=jnp.asarray(valid), cache=cache,
+                    prefix_valid=jnp.asarray(prefix_valid),
+                    prefix_lens=jnp.asarray(prefix_lens),
+                )
+            return self._prefill(
+                self.params, tokens=jnp.asarray(tokens),
+                valid=jnp.asarray(valid), cache=cache,
+            )
+        if has_prefix:
+            base_lens = np.asarray(prefix_lens, dtype=np.int64)
+        first_logits = None
+        for start in range(0, L, C):
+            tok_c = jnp.asarray(tokens[:, start:start + C])
+            val_c = jnp.asarray(valid[:, start:start + C])
+            if start == 0 and not has_prefix:
+                first_logits, cache = self._prefill(
+                    self.params, tokens=tok_c, valid=val_c, cache=cache
+                )
+                continue
+            if has_prefix:
+                pv = np.concatenate(
+                    [prefix_valid, valid[:, :start]], axis=1
+                )
+                pl = base_lens + valid[:, :start].sum(axis=1)
+            else:
+                pv = valid[:, :start]
+                pl = valid[:, :start].sum(axis=1)
+            first_logits, cache = self._prefill_suffix(
+                self.params, tokens=tok_c, valid=val_c, cache=cache,
+                prefix_valid=jnp.asarray(pv),
+                prefix_lens=jnp.asarray(pl.astype(np.int32)),
+            )
+        return first_logits, cache
+
     def _decode_batch(
         self, parts, batch, sig_prefix, real_B, temps, budgets,
         top_p,
@@ -922,11 +987,9 @@ class JaxEngine(InferenceEngine):
             prepped = self._prepare_prefixed_batch(parts, budgets, decode_slots)
         if prepped is not None:
             tokens, valid, Ls, cache, prefix_valid, prefix_lens, P = prepped
-            first_logits, cache = self._prefill_suffix(
-                self.params, tokens=jnp.asarray(tokens),
-                valid=jnp.asarray(valid), cache=cache,
-                prefix_valid=jnp.asarray(prefix_valid),
-                prefix_lens=jnp.asarray(prefix_lens),
+            first_logits, cache = self._prefill_possibly_chunked(
+                tokens, valid, Ls, cache,
+                prefix_valid=prefix_valid, prefix_lens=prefix_lens,
             )
             L = P + Ls
             S = L + decode_slots
@@ -940,9 +1003,8 @@ class JaxEngine(InferenceEngine):
             cache = init_kv_cache(
                 self.spec, B, L + decode_slots, quantized=self.kv_quantized
             )
-            first_logits, cache = self._prefill(
-                self.params, tokens=jnp.asarray(tokens), valid=jnp.asarray(valid),
-                cache=cache,
+            first_logits, cache = self._prefill_possibly_chunked(
+                tokens, valid, L, cache
             )
             S = L + decode_slots
             valid_mask = np.zeros((B, S), dtype=bool)

@@ -19,7 +19,7 @@ denominator is an estimate of its steady-state rate on its own config
 4*50/300 ≈ 0.67 decisions/sec).  It is an estimate, not a measurement;
 the absolute `value` is the number to track round over round.
 
-Env overrides: BENCH_ROUNDS (measured rounds, default 2),
+Env overrides: BENCH_ROUNDS (measured rounds, default 3),
 BENCH_MODEL (spec name), BENCH_BACKEND=fake for a hermetic smoke run,
 BENCH_QUANTIZATION (default int8 — measured fastest WITH fast-forward:
 3.34 dec/s vs 3.22 bf16+ff vs 3.00 bf16 plain vs 2.27 int8 plain on
@@ -57,7 +57,9 @@ def main() -> None:
     model = os.environ.get("BENCH_MODEL", "bcg-tpu/bench-1b")
     backend = os.environ.get("BENCH_BACKEND", "jax")
     quant_env = os.environ.get("BENCH_QUANTIZATION", "int8")
-    measured_rounds = int(os.environ.get("BENCH_ROUNDS", "2"))
+    # 3 measured rounds (~10 s window): 2-round windows showed +-8% noise
+    # from retry-ladder luck; the attach/warmup cost already dominates.
+    measured_rounds = int(os.environ.get("BENCH_ROUNDS", "3"))
     # Two warmup rounds: round 1 compiles the initial shapes; round 2
     # covers the history-grown prompt's length bucket, so the measured
     # window is (normally) compile-free.
@@ -119,6 +121,10 @@ def main() -> None:
             # Off for models whose weights+KV leave no room for cached
             # prefix KV (e.g. bench-8b on a 16 GB chip).
             prefix_caching=_env_flag("BENCH_PREFIX_CACHING", True),
+            # Chunked prefill slice (tokens; 0 = whole prompt in one
+            # pass).  Needed alongside BENCH_PREFIX_CACHING=0 for
+            # 8B-class models on one chip.
+            prefill_chunk=int(os.environ.get("BENCH_PREFILL_CHUNK", "0")),
         ),
         metrics=dataclasses.replace(
             base.metrics, save_results=False, generate_plots=False
@@ -277,6 +283,7 @@ def main() -> None:
             "fast_forward": cfg.engine.decode_fast_forward,
             "compact_json": cfg.engine.guided_compact_json,
             "prefix_caching": cfg.engine.prefix_caching,
+            "prefill_chunk": cfg.engine.prefill_chunk,
             "platform": platform,
             "elapsed_sec": round(elapsed, 2),
             "baseline_note": "denominator is an ESTIMATED reference rate "
