@@ -222,61 +222,66 @@ class TestGuaranteedParse:
 
 
 class TestChunkedPrefill:
-    def test_chunked_matches_single_pass(self):
-        """prefill_chunk slices the full-prompt prefill through the
-        prefix-suffix jit; greedy output must be identical to one-pass
-        prefill (same KV, same positions, chunk boundaries invisible)."""
+    VOTE_SCHEMA = {
+        "type": "object",
+        "properties": {"d": {"type": "string", "enum": ["stop", "continue"]}},
+        "required": ["d"],
+        "additionalProperties": False,
+    }
+
+    @staticmethod
+    def _engine_pair(prefill_chunk: int, prefix_caching: bool):
+        """(one-pass engine, chunked engine) over identical configs."""
         import dataclasses
 
         from bcg_tpu.config import EngineConfig
         from bcg_tpu.engine.jax_engine import JaxEngine
 
-        schema = {
-            "type": "object",
-            "properties": {"d": {"type": "string", "enum": ["stop", "continue"]}},
-            "required": ["d"],
-            "additionalProperties": False,
-        }
         base = EngineConfig(backend="jax", model_name="bcg-tpu/tiny-test",
-                            max_model_len=2048, prefix_caching=False)
-        one = JaxEngine(base)
-        chunked = JaxEngine(dataclasses.replace(base, prefill_chunk=64))
-        prompts = [
-            ("sys " * 40, "user prompt " * 30, schema),   # multi-chunk
-            ("other sys " * 25, "short", schema),          # ragged lengths
-        ]
+                            max_model_len=2048, prefix_caching=prefix_caching)
+        return JaxEngine(base), JaxEngine(
+            dataclasses.replace(base, prefill_chunk=prefill_chunk)
+        )
+
+    def _assert_chunked_matches(self, prompts, prefill_chunk, prefix_caching):
+        one, chunked = self._engine_pair(prefill_chunk, prefix_caching)
         r_one = one.batch_generate_json(prompts, temperature=0.0, max_tokens=24)
-        r_chunked = chunked.batch_generate_json(prompts, temperature=0.0, max_tokens=24)
+        r_chunked = chunked.batch_generate_json(
+            prompts, temperature=0.0, max_tokens=24
+        )
         assert r_chunked == r_one
         assert all("error" not in r for r in r_one)
         one.shutdown()
         chunked.shutdown()
 
+    def test_chunked_matches_single_pass(self):
+        """prefill_chunk slices the full-prompt prefill through the
+        prefix-suffix jit; greedy output must be identical to one-pass
+        prefill (same KV, same positions, chunk boundaries invisible)."""
+        self._assert_chunked_matches(
+            [
+                ("sys " * 40, "user prompt " * 30, self.VOTE_SCHEMA),  # multi-chunk
+                ("other sys " * 25, "short", self.VOTE_SCHEMA),        # ragged lengths
+            ],
+            prefill_chunk=64, prefix_caching=False,
+        )
+
     def test_chunked_with_prefix_caching_matches(self):
         """The suffix region of a prefix-cached prefill chunks too (each
         chunk extends the cached prefix) — greedy-identical output."""
-        import dataclasses
+        self._assert_chunked_matches(
+            [("sys " * 60, "user prompt " * 40, self.VOTE_SCHEMA)],
+            prefill_chunk=64, prefix_caching=True,
+        )
 
-        from bcg_tpu.config import EngineConfig
-        from bcg_tpu.engine.jax_engine import JaxEngine
-
-        schema = {
-            "type": "object",
-            "properties": {"d": {"type": "string", "enum": ["stop", "continue"]}},
-            "required": ["d"],
-            "additionalProperties": False,
-        }
-        base = EngineConfig(backend="jax", model_name="bcg-tpu/tiny-test",
-                            max_model_len=2048, prefix_caching=True)
-        one = JaxEngine(base)
-        chunked = JaxEngine(dataclasses.replace(base, prefill_chunk=64))
-        prompts = [("sys " * 60, "user prompt " * 40, schema)]
-        r_one = one.batch_generate_json(prompts, temperature=0.0, max_tokens=24)
-        r_chunked = chunked.batch_generate_json(prompts, temperature=0.0, max_tokens=24)
-        assert r_chunked == r_one
-        assert "error" not in r_one[0]
-        one.shutdown()
-        chunked.shutdown()
+    def test_non_divisor_chunk_matches(self):
+        """A chunk size that does not divide the bucketed length (512 %
+        100 != 0) leaves a ragged final slice — output must still match
+        one-pass exactly."""
+        self._assert_chunked_matches(
+            [("sys " * 50, "user words " * 25, self.VOTE_SCHEMA)],
+            prefill_chunk=100, prefix_caching=False,
+        )
 
     def test_negative_chunk_rejected(self):
         import dataclasses
