@@ -61,6 +61,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Corrupt this fraction of LLM responses (resilience experiments)")
     p.add_argument("--fault-seed", type=int, default=None,
                    help="Seed for fault injection")
+    p.add_argument("--protocol", type=str, default=None,
+                   choices=["a2a_sim", "lossy_sim"],
+                   help="Communication protocol (lossy_sim adds seeded message drops/delays)")
+    p.add_argument("--drop-prob", type=float, default=None,
+                   help="lossy_sim: per-message drop probability")
+    p.add_argument("--delay-prob", type=float, default=None,
+                   help="lossy_sim: probability a message arrives 1..max-delay rounds late")
+    p.add_argument("--max-delay", type=int, default=None,
+                   help="lossy_sim: maximum delivery delay in rounds")
     return p
 
 
@@ -114,6 +123,25 @@ def config_from_args(args) -> BCGConfig:
         network = dataclasses.replace(network, topology_type=args.topology)
     if args.spmd_exchange:
         network = dataclasses.replace(network, spmd_exchange=True)
+    communication = base.communication
+    if args.protocol:
+        communication = dataclasses.replace(communication, protocol_type=args.protocol)
+    channel_knobs = (args.drop_prob, args.delay_prob, args.max_delay)
+    if any(k is not None for k in channel_knobs) and \
+            communication.protocol_type != "lossy_sim":
+        # The reliable channel ignores these — running a "30%-loss"
+        # experiment over a perfect channel must fail loudly, not
+        # silently produce wrong science.
+        raise SystemExit(
+            "Error: --drop-prob/--delay-prob/--max-delay require "
+            "--protocol lossy_sim"
+        )
+    if args.drop_prob is not None:
+        communication = dataclasses.replace(communication, drop_prob=args.drop_prob)
+    if args.delay_prob is not None:
+        communication = dataclasses.replace(communication, delay_prob=args.delay_prob)
+    if args.max_delay is not None:
+        communication = dataclasses.replace(communication, max_delay_rounds=args.max_delay)
     metrics = base.metrics
     if args.results_dir:
         metrics = dataclasses.replace(metrics, results_dir=args.results_dir)
@@ -127,6 +155,7 @@ def config_from_args(args) -> BCGConfig:
     return BCGConfig(
         game=game,
         network=network,
+        communication=communication,
         engine=engine,
         metrics=metrics,
         verbose=args.verbose,

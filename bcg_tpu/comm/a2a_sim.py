@@ -136,19 +136,29 @@ class A2ASimProtocol(CommunicationProtocol):
 
     def send_message(self, sender_id: int, receiver_id: int, message: A2AMessage) -> None:
         """Buffer a point-to-point message after neighbour validation and
-        duplicate suppression (reference a2a_sim.py:157-181)."""
+        duplicate suppression (reference a2a_sim.py:157-181).
+
+        Validation, dedup, and the sent-count are the CHANNEL-INDEPENDENT
+        contract; delivery itself goes through :meth:`_route` so
+        subclasses (e.g. the lossy channel) override only the routing
+        decision.
+        """
         if receiver_id not in self.topology.get(sender_id, []):
             raise ValueError(
                 f"Agent {sender_id} cannot send to {receiver_id}: not in neighbor set"
             )
         if message in self.delivered:
             return
-        inbox = self.message_buffer.setdefault(message.round, {}).setdefault(
-            receiver_id, []
-        )
-        inbox.append(message)
         self.delivered.add(message)
         self._round_counts[message.round] = self._round_counts.get(message.round, 0) + 1
+        self._route(receiver_id, message)
+
+    def _route(self, receiver_id: int, message: A2AMessage) -> None:
+        """Deliver into the receiver's inbox for the message's round
+        (ideal channel: on time, always)."""
+        self.message_buffer.setdefault(message.round, {}).setdefault(
+            receiver_id, []
+        ).append(message)
 
     def broadcast_to_neighbors(
         self,
@@ -211,6 +221,46 @@ class A2ASimProtocol(CommunicationProtocol):
         self.delivered.clear()
         self._round_counts.clear()
         self.current_round = 0
+
+    # ------------------------------------------------------ checkpointing
+
+    def snapshot(self) -> Dict:
+        """JSON-serializable channel state (in-flight buffers + counters)
+        for per-round checkpoint/resume.  The delivered set is derived
+        from the buffered messages on restore (GC'd rounds' entries were
+        already discarded)."""
+        return {
+            "message_buffer": {
+                str(r): {
+                    str(a): [m.to_dict() for m in inbox]
+                    for a, inbox in boxes.items()
+                }
+                for r, boxes in self.message_buffer.items()
+            },
+            "round_counts": {str(r): c for r, c in self._round_counts.items()},
+            "current_round": self.current_round,
+            "current_phase": self.current_phase,
+        }
+
+    def restore(self, blob: Dict) -> None:
+        self.message_buffer = {
+            int(r): {
+                int(a): [A2AMessage.from_dict(d) for d in inbox]
+                for a, inbox in boxes.items()
+            }
+            for r, boxes in blob["message_buffer"].items()
+        }
+        self.delivered = {
+            m
+            for boxes in self.message_buffer.values()
+            for inbox in boxes.values()
+            for m in inbox
+        }
+        self._round_counts = {
+            int(r): c for r, c in blob["round_counts"].items()
+        }
+        self.current_round = blob["current_round"]
+        self.current_phase = blob["current_phase"]
 
     def create_client(self, agent_id: int) -> "A2ASimClient":
         return A2ASimClient(agent_id=agent_id, protocol=self)

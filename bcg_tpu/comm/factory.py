@@ -39,7 +39,21 @@ def create_protocol(
     return builder(num_agents=num_agents, topology=topology, config=config or {})
 
 
+def _build_lossy(num_agents, topology, config):
+    from bcg_tpu.comm.lossy_sim import LossySimProtocol
+
+    return LossySimProtocol(
+        num_agents,
+        topology,
+        drop_prob=config.get("drop_prob", 0.0),
+        delay_prob=config.get("delay_prob", 0.0),
+        max_delay_rounds=config.get("max_delay_rounds", 1),
+        seed=config.get("seed", 0),  # None = unseeded (fresh entropy)
+    )
+
+
 register_protocol(
     "a2a_sim",
     lambda num_agents, topology, config: A2ASimProtocol(num_agents, topology),
 )
+register_protocol("lossy_sim", _build_lossy)

@@ -81,10 +81,18 @@ class AgentNetwork:
 
     def get_network_stats(self) -> Dict[str, Any]:
         total_messages = self.protocol.get_total_message_count()
-        return {
+        stats = {
             "num_agents": self.num_agents,
             "topology_type": self.topology.topology_type,
             "current_round": self.current_round,
             "total_messages": total_messages,
             "avg_degree": self.topology.avg_degree,
         }
+        # Unreliable channels (comm/lossy_sim.py) report their fault
+        # counts so lossy experiments can attribute outcomes to actual
+        # realized losses, not just the configured probabilities.
+        fault_stats = getattr(self.protocol, "get_fault_stats", None)
+        if fault_stats is not None:
+            for k, v in fault_stats().items():
+                stats[f"channel_{k}"] = v
+        return stats

@@ -34,9 +34,18 @@ DEFAULT_MODEL = "qwen3-14b"
 
 @dataclass(frozen=True)
 class CommunicationConfig:
-    """Protocol selection (reference COMMUNICATION_CONFIG, config.py:7-9)."""
+    """Protocol selection (reference COMMUNICATION_CONFIG, config.py:7-9).
+
+    The lossy-channel knobs apply when ``protocol_type="lossy_sim"``
+    (:mod:`bcg_tpu.comm.lossy_sim`): seeded message drops and cross-round
+    delivery delays as an experimental axis the reference's idealized
+    channel cannot express.
+    """
 
     protocol_type: str = "a2a_sim"
+    drop_prob: float = 0.0
+    delay_prob: float = 0.0
+    max_delay_rounds: int = 1
 
 
 @dataclass(frozen=True)

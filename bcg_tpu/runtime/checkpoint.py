@@ -16,12 +16,18 @@ def save_checkpoint(sim, path: str) -> str:
     """Serialize simulation state (game, agent memories, network round)."""
     os.makedirs(os.path.dirname(path), exist_ok=True)
     blob = {
-        "version": 1,
+        "version": 2,
         "run_number": sim.run_number,
         "game": sim.game.snapshot(),
         "agents": {aid: agent.snapshot() for aid, agent in sim.agents.items()},
         "network_round": sim.network.current_round,
     }
+    # Channel state: in-flight (delayed) messages, fault-RNG position,
+    # counters — without it a resumed lossy_sim run silently loses
+    # delayed proposals and replays the fault RNG from its initial seed.
+    snap = getattr(sim.network.protocol, "snapshot", None)
+    if snap is not None:
+        blob["protocol"] = snap()
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(blob, f)
@@ -70,4 +76,8 @@ def resume_simulation(path: str, config=None, engine=None):
                 sim.agents[aid].set_initial_value(game_agent.initial_value)
                 sim.agents[aid].my_value = agent_blob["my_value"]
     sim.network.current_round = blob["network_round"]
+    proto_blob = blob.get("protocol")
+    restore = getattr(sim.network.protocol, "restore", None)
+    if proto_blob is not None and restore is not None:
+        restore(proto_blob)
     return sim

@@ -156,6 +156,7 @@ def save_json_results(
     metrics: Dict,
     game,
     message_count: int,
+    network_stats: Optional[Dict] = None,
 ) -> str:
     """results/json/run_NNN.json (reference main.py:813-834)."""
     json_dir = os.path.join(results_dir, "json")
@@ -179,6 +180,10 @@ def save_json_results(
         ],
         "final_state": game.get_game_state(),
         "a2a_message_count": message_count,
+        # Includes channel_dropped/channel_delayed for unreliable
+        # channels (comm/lossy_sim.py) so lossy experiments can attribute
+        # outcomes to realized losses.
+        "network_stats": network_stats or {},
     }
     with open(path, "w") as f:
         json.dump(results, f, indent=2)

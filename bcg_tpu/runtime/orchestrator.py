@@ -113,10 +113,29 @@ class BCGSimulation:
 
         num_agents = game_cfg.num_honest + game_cfg.num_byzantine
         self.topology = build_topology(num_agents, self.config.network)
+        comm_cfg = self.config.communication
+        if self.config.network.spmd_exchange and comm_cfg.protocol_type != "a2a_sim":
+            # The SPMD path exchanges values via one all_gather and never
+            # touches the host protocol — a lossy channel configured with
+            # it would be silently ignored (drops/delays never applied).
+            raise ValueError(
+                f"spmd_exchange bypasses the host protocol; "
+                f"protocol_type={comm_cfg.protocol_type!r} would have no "
+                "effect. Use the host exchange path for unreliable-channel "
+                "experiments."
+            )
         protocol = create_protocol(
-            self.config.communication.protocol_type,
+            comm_cfg.protocol_type,
             num_agents=num_agents,
             topology=self.topology.adjacency_list,
+            config={
+                "drop_prob": comm_cfg.drop_prob,
+                "delay_prob": comm_cfg.delay_prob,
+                "max_delay_rounds": comm_cfg.max_delay_rounds,
+                # None = unseeded: fresh channel-fault realizations per
+                # run, mirroring the game's own unseeded behavior.
+                "seed": game_cfg.seed,
+            },
         )
         self.network = AgentNetwork(self.topology, protocol=protocol)
 
@@ -696,6 +715,7 @@ class BCGSimulation:
             metrics=metrics,
             game=self.game,
             message_count=message_count,
+            network_stats=self.network.get_network_stats(),
         )
         csv_path = save_metrics_csv(
             self.config.metrics.results_dir, self.run_number, metrics

@@ -186,6 +186,48 @@ class TestCheckpoint:
         sim2.run_round()
         assert sim2.game.current_round >= sim.game.current_round
 
+    def test_checkpoint_restores_lossy_channel_state(self, tmp_path):
+        """Channel state (in-flight delayed messages, fault counters, RNG
+        position) must survive checkpoint/resume — a resumed lossy run
+        continues the exact seeded fault stream."""
+        from bcg_tpu.config import CommunicationConfig
+
+        cfg = dataclasses.replace(
+            make_config(tmp_path=tmp_path, nh=4, nb=1, max_rounds=10, seed=11),
+            communication=CommunicationConfig(
+                protocol_type="lossy_sim", drop_prob=0.3, delay_prob=0.3,
+                max_delay_rounds=2,
+            ),
+            metrics=MetricsConfig(
+                save_results=True,
+                results_dir=str(tmp_path),
+                checkpoint_every_round=True,
+            ),
+        )
+        sim = BCGSimulation(config=cfg, engine=FakeEngine(seed=2, policy="schema_min"))
+        sim.run_round()
+        ckpt = tmp_path / "checkpoints" / "run_001.json"
+        assert ckpt.exists()
+
+        from bcg_tpu.runtime.checkpoint import resume_simulation
+
+        cfg2 = dataclasses.replace(
+            cfg, metrics=dataclasses.replace(cfg.metrics, save_results=False)
+        )
+        sim2 = resume_simulation(
+            str(ckpt), config=cfg2, engine=FakeEngine(seed=2, policy="schema_min")
+        )
+        p1, p2 = sim.network.protocol, sim2.network.protocol
+        assert p2.get_fault_stats() == p1.get_fault_stats()
+        assert p2._rng.getstate() == p1._rng.getstate()
+        assert p2.message_buffer == p1.message_buffer  # in-flight delayed
+        # (Exact post-resume fault-stream continuation is proven at the
+        # protocol level — test_comm.py — where inputs are controlled;
+        # here the engines' own sampling streams are not checkpointed, so
+        # round content may differ.)  The resumed game must keep running.
+        sim2.run_round()
+        assert sim2.game.current_round >= sim.game.current_round
+
     def test_resume_unseeded_preserves_byzantine_roles(self, tmp_path):
         # Without a seed, a fresh simulation would roll a DIFFERENT
         # Byzantine assignment; resume must rebuild agents from the
