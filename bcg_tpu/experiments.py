@@ -94,6 +94,7 @@ def run_preset(
     byzantine: Optional[int] = None,
     concurrency: int = 1,
     fault_rate: float = 0.0,
+    drop_prob: float = 0.0,
 ) -> Dict:
     """Run a preset ``runs`` times and aggregate.
 
@@ -105,13 +106,15 @@ def run_preset(
     (README.md:55-70).
 
     ``fault_rate`` corrupts that fraction of LLM responses per run
-    (engine/fault.py), making resilience-vs-fault-rate curves a one-flag
-    sweep.
+    (engine/fault.py); ``drop_prob`` routes the games over the lossy
+    channel (comm/lossy_sim.py) with that per-message drop probability —
+    together they make resilience-vs-fault curves (LLM-side and
+    channel-side) one-flag sweeps.
     """
     import dataclasses
 
     from bcg_tpu.api import resolve_engine_config
-    from bcg_tpu.config import BCGConfig
+    from bcg_tpu.config import BCGConfig, CommunicationConfig
 
     n_honest = honest if honest is not None else preset.honest
     n_byz = byzantine if byzantine is not None else preset.byzantine
@@ -119,6 +122,18 @@ def run_preset(
         resolve_engine_config(model_name, backend), fault_rate=fault_rate
     )
     base_cfg = dataclasses.replace(BCGConfig(), engine=engine_cfg)
+    if drop_prob:
+        # Fail BEFORE any engine boot (same invariant as fault_rate,
+        # engine/interface.py): a config typo must not cost a multi-GB
+        # weight load first.
+        if not 0.0 <= drop_prob <= 1.0:
+            raise ValueError(f"drop_prob={drop_prob}: expected [0, 1]")
+        base_cfg = dataclasses.replace(
+            base_cfg,
+            communication=CommunicationConfig(
+                protocol_type="lossy_sim", drop_prob=drop_prob
+            ),
+        )
 
     def make_run(r: int):
         def go(engine=None):
@@ -206,11 +221,16 @@ def main(argv: Optional[List[str]] = None) -> None:
     p.add_argument("--fault-rate", type=float, default=0.0,
                    help="Corrupt this fraction of LLM responses per run "
                         "(resilience-vs-fault-rate sweeps)")
+    p.add_argument("--drop-prob", type=float, default=0.0,
+                   help="Route games over the lossy channel with this "
+                        "per-message drop probability "
+                        "(resilience-vs-loss sweeps)")
     args = p.parse_args(argv)
 
     common = dict(runs=args.runs, model_name=args.model, backend=args.backend,
                   max_rounds=args.rounds, seed=args.seed,
-                  concurrency=args.concurrency, fault_rate=args.fault_rate)
+                  concurrency=args.concurrency, fault_rate=args.fault_rate,
+                  drop_prob=args.drop_prob)
     if args.preset == "scale-sweep":
         out = run_scale_sweep(
             [int(x) for x in args.agents.split(",")],

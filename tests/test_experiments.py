@@ -52,6 +52,22 @@ class TestExperiments:
             assert o["per_run"][0]["num_byzantine"] == 2
             assert o["per_run"][0]["num_honest"] == 8
 
+    def test_drop_prob_routes_over_lossy_channel(self, monkeypatch):
+        import bcg_tpu.comm.lossy_sim as ls
+
+        built = []
+        orig = ls.LossySimProtocol.__init__
+
+        def spy(self, *a, **k):
+            built.append(k.get("drop_prob"))
+            return orig(self, *a, **k)
+
+        monkeypatch.setattr(ls.LossySimProtocol, "__init__", spy)
+        out = run_preset(PRESETS["q1-baseline"], runs=1, backend="fake",
+                         max_rounds=4, seed=5, drop_prob=0.5)
+        assert built == [0.5]  # the game really ran over the lossy channel
+        assert out["aggregate"]["runs"] == 1
+
     def test_aggregate_empty_values(self):
         agg = aggregate([{"consensus_reached": True, "total_rounds": 3}])
         assert agg["byzantine_infiltration_rate"] is None
