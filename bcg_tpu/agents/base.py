@@ -125,6 +125,44 @@ class BCGAgent:
                 lines.append(f"    Reasoning: {reasoning[:VOTE_REASONING_SNIPPET]}")
         return "\n".join(lines)
 
+    def _shared_proposals_block(self) -> str:
+        """Global proposals view for vote-phase shared-core caching:
+        byte-IDENTICAL across agents when every agent received every
+        broadcast (fully-connected reliable delivery — the orchestrator
+        gates the mode on exactly that).  Sorted by agent id, no "(you)"
+        marker — identity lives in the per-agent prompt tail; abstaining
+        agents broadcast nothing and appear nowhere."""
+        entries = {
+            sid: (int(value), reasoning)
+            for sid, value, reasoning in self.received_proposals
+        }
+        if self.my_value is not None:
+            # Mirror the orchestrator's broadcast fallback text exactly so
+            # this agent's own line matches what every OTHER agent shows.
+            own = self.last_reasoning or f"Proposing value: {int(self.my_value)}"
+            entries[self.agent_id] = (int(self.my_value), own)
+        lines = []
+        for sid in sorted(entries):
+            value, reasoning = entries[sid]
+            lines.append(f"  {sid}: {value}")
+            if reasoning:
+                lines.append(f"    Reasoning: {reasoning[:VOTE_REASONING_SNIPPET]}")
+        return "\n".join(lines) if lines else "  (no proposals this round)"
+
+    def _vote_identity_block(self) -> str:
+        """Per-agent tail companion of :meth:`_shared_proposals_block`:
+        carries the identity and own-proposal status the shared core
+        omits."""
+        if self.my_value is not None:
+            snippet = (
+                self.last_reasoning or f"Proposing value: {int(self.my_value)}"
+            )[:VOTE_REASONING_SNIPPET]
+            return (
+                f"You are {self.agent_id}. Your proposal this round: "
+                f"{int(self.my_value)}\nYour reasoning: {snippet}"
+            )
+        return f"You are {self.agent_id}. You ABSTAINED this round"
+
     # ------------------------------------------------------ abstract surface
 
     def build_system_prompt(self, game_state: Dict) -> str:
@@ -236,7 +274,11 @@ class BCGAgent:
         temperature: float,
         max_tokens: int,
     ) -> Optional[Dict]:
-        """Engine-level retry loop with corrective re-prompting."""
+        """Engine-level retry loop with corrective re-prompting.
+
+        ``round_prompt`` may be a plain string or a ``(core, tail)`` pair
+        (vote-phase shared-core caching); the corrective retry text
+        appends to the TAIL so the cached core stays byte-identical."""
         prompt = round_prompt
         for attempt in range(1, self.max_json_retries + 1):
             result = self.engine.generate_json(
@@ -249,11 +291,14 @@ class BCGAgent:
             if "error" not in result and validate(result):
                 return result
             if attempt < self.max_json_retries:
-                prompt = (
-                    f"{round_prompt}\n\n"
-                    f"RETRY ATTEMPT {attempt + 1}/{self.max_json_retries}:\n"
+                retry_text = (
+                    f"\n\nRETRY ATTEMPT {attempt + 1}/{self.max_json_retries}:\n"
                     f"{retry_suffix}"
                 )
+                if isinstance(round_prompt, tuple):
+                    prompt = (round_prompt[0], round_prompt[1] + retry_text)
+                else:
+                    prompt = round_prompt + retry_text
         return None
 
     def _decision_retry_suffix(self) -> str:

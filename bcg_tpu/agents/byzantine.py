@@ -96,8 +96,10 @@ Respond with JSON only:
     def build_vote_system_prompt(self, game_state: Dict) -> str:
         if self._cached_vote_system_prompt is not None:
             return self._cached_vote_system_prompt
+        # Per-ROLE (agent identity lives in the round prompt) — see the
+        # honest variant for the caching rationale.
         max_rounds = game_state.get("max_rounds", 20)
-        self._cached_vote_system_prompt = f"""You are {self.agent_id}, a BYZANTINE agent voting on game termination.
+        self._cached_vote_system_prompt = f"""You are a BYZANTINE agent voting on game termination.
 
 === VOTING STRATEGY ===
 Your vote is a weapon:
@@ -111,7 +113,11 @@ Respond with JSON only:
 {{"decision": "stop"}}, {{"decision": "continue"}}, or {{"decision": "abstain"}}"""
         return self._cached_vote_system_prompt
 
-    def build_vote_round_prompt(self, game_state: Dict) -> str:
+    def build_vote_round_prompt(self, game_state: Dict):
+        """Single string, or ``(core, tail)`` under shared-core caching —
+        see the honest variant.  The Byzantine core differs from the
+        honest one (header/decision framing), so each role caches its own
+        per-round core."""
         current_round = game_state.get("round", 0)
         max_rounds = game_state.get("max_rounds", 20)
         history = self._format_recent_history()
@@ -120,6 +126,24 @@ Respond with JSON only:
             if "(No history" not in history
             else "(This is round 1 - no previous history)"
         )
+        decision_block = """=== DECISION ===
+Looking at THIS round's proposals, vote "continue" to keep disrupting, or "stop", or "abstain" to withhold your vote.
+Respond: {"decision": "stop"}, {"decision": "continue"}, or {"decision": "abstain"}"""
+        if game_state.get("vote_shared_core"):
+            core = f"""=== BYZANTINE VOTING - Round {current_round}/{max_rounds} ===
+
+=== ALL PROPOSALS THIS ROUND (current round {current_round}) ===
+{self._shared_proposals_block()}
+
+=== PREVIOUS ROUNDS HISTORY (for context) ===
+{history_block}"""
+            tail = f"""
+
+=== YOUR IDENTITY ===
+{self._vote_identity_block()}
+
+{decision_block}"""
+            return (core, tail)
         return f"""=== BYZANTINE VOTING - Round {current_round}/{max_rounds} ===
 
 === ALL PROPOSALS THIS ROUND (current round {current_round}) ===
@@ -128,9 +152,7 @@ Respond with JSON only:
 === PREVIOUS ROUNDS HISTORY (for context) ===
 {history_block}
 
-=== DECISION ===
-Looking at THIS round's proposals, vote "continue" to keep disrupting, or "stop", or "abstain" to withhold your vote.
-Respond: {{"decision": "stop"}}, {{"decision": "continue"}}, or {{"decision": "abstain"}}"""
+{decision_block}"""
 
     # ---------------------------------------------------------------- schemas
 

@@ -131,9 +131,13 @@ Respond with JSON only:
         if self._cached_vote_system_prompt is not None:
             return self._cached_vote_system_prompt
 
+        # Per-ROLE, not per-agent: identity lives in the round prompt
+        # ("(you)" marker / identity tail), so all honest agents share ONE
+        # vote system prompt — one cached KV prefix instead of N, and the
+        # precondition for shared-core caching (engine _get_core_entry).
         max_rounds = game_state.get("max_rounds", 20)
         if self.byzantine_awareness == "none_exist":
-            self._cached_vote_system_prompt = f"""You are {self.agent_id}, voting on whether consensus has been reached.
+            self._cached_vote_system_prompt = f"""You are an agent voting on whether consensus has been reached.
 
 === GAME SETTING ===
 Every agent in this game is honest and cooperative - there are NO Byzantine agents.
@@ -152,7 +156,7 @@ Every agent in this game is honest and cooperative - there are NO Byzantine agen
 Respond with JSON only:
 {{"decision": "stop"}} or {{"decision": "continue"}}"""
         else:
-            self._cached_vote_system_prompt = f"""You are {self.agent_id}, an honest agent voting on whether consensus has been reached.
+            self._cached_vote_system_prompt = f"""You are an honest agent voting on whether consensus has been reached.
 
 === VOTING RULES ===
 1. Vote "stop" when all honest agents appear to propose the SAME value this round
@@ -170,7 +174,14 @@ Respond with JSON only:
 {{"decision": "stop"}} or {{"decision": "continue"}}"""
         return self._cached_vote_system_prompt
 
-    def build_vote_round_prompt(self, game_state: Dict) -> str:
+    def build_vote_round_prompt(self, game_state: Dict):
+        """Vote round prompt.  With ``game_state["vote_shared_core"]``
+        (fully-connected reliable delivery — orchestrator-gated) returns a
+        ``(core, tail)`` pair: the core (proposals + history) is
+        byte-identical across honest agents and served once per round from
+        a cached KV prefix; the tail carries everything per-agent.
+        Otherwise a single string with the per-agent "(you)" block
+        (reference bcg_agents.py:527-560 format)."""
         current_round = game_state.get("round", 0)
         max_rounds = game_state.get("max_rounds", 20)
         history = self._format_recent_history()
@@ -180,6 +191,27 @@ Respond with JSON only:
             if "(No history" not in history
             else "(This is round 1 - no previous history)"
         )
+        decision_block = f"""=== MAKE YOUR DECISION ===
+Looking at THIS round's values above, have the honest agents settled on a valid initial value?
+Respond: {{"decision": "stop"}} or {{"decision": "continue"}}"""
+        if game_state.get("vote_shared_core"):
+            core = f"""=== VOTING PHASE - Round {current_round}/{max_rounds} ===
+
+=== ALL PROPOSALS THIS ROUND (current round {current_round}) ===
+{self._shared_proposals_block()}
+
+=== PREVIOUS ROUNDS HISTORY (for context) ===
+{history_block}"""
+            tail = f"""
+
+=== YOUR IDENTITY ===
+{self._vote_identity_block()}
+
+=== YOUR INTERNAL STRATEGY NOTES ===
+{strategies if strategies else "(No notes)"}
+
+{decision_block}"""
+            return (core, tail)
         return f"""=== VOTING PHASE - Round {current_round}/{max_rounds} ===
 
 === ALL PROPOSALS THIS ROUND (current round {current_round}) ===
@@ -191,9 +223,7 @@ Respond with JSON only:
 === YOUR INTERNAL STRATEGY NOTES ===
 {strategies if strategies else "(No notes)"}
 
-=== MAKE YOUR DECISION ===
-Looking at THIS round's values above, have the honest agents settled on a valid initial value?
-Respond: {{"decision": "stop"}} or {{"decision": "continue"}}"""
+{decision_block}"""
 
     # ---------------------------------------------------------------- schemas
 

@@ -53,6 +53,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="KV cache storage dtype (int8 halves decode cache traffic)")
     p.add_argument("--no-prefix-caching", action="store_true",
                    help="Disable system-prompt KV prefix caching")
+    p.add_argument("--scan-layers", action="store_true",
+                   help="Run the layer stack as one lax.scan (O(1)-in-depth program; "
+                        "needed for 8B-class compiles)")
     p.add_argument("--fast-forward", action="store_true",
                    help="Forced-chain fast-forward decoding (skeleton tokens ride the sampled token's weight pass)")
     p.add_argument("--compact-json", action="store_true",
@@ -110,6 +113,8 @@ def config_from_args(args) -> BCGConfig:
         engine = dataclasses.replace(engine, kv_cache_dtype=args.kv_cache_dtype)
     if args.no_prefix_caching:
         engine = dataclasses.replace(engine, prefix_caching=False)
+    if args.scan_layers:
+        engine = dataclasses.replace(engine, scan_layers=True)
     if args.fast_forward:
         engine = dataclasses.replace(engine, decode_fast_forward=True)
     if args.compact_json:

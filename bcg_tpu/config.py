@@ -119,6 +119,11 @@ class EngineConfig:
     # whitespace-tolerant guided outputs.
     guided_compact_json: bool = False
     disable_qwen3_thinking: bool = True
+    # Run the layer stack as ONE lax.scan over stacked weights instead of
+    # unrolling every layer into the HLO.  Program size becomes O(1) in
+    # depth — required where compile infrastructure rejects 36-layer
+    # unrolled 8B programs (this environment's remote-compile helper).
+    scan_layers: bool = False
     attention_impl: str = "auto"  # auto | pallas | xla
     # Fake-backend determinism seed (ignored by the real engine).
     fake_seed: int = 0

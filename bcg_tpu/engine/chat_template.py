@@ -74,6 +74,36 @@ def format_chat_parts(
     return _chatml(system_prompt, user_prompt)
 
 
+def format_chat_parts3(
+    model_name: str,
+    system_prompt: str,
+    core: str,
+    tail: str,
+    disable_qwen3_thinking: bool = True,
+) -> Tuple[str, str, str]:
+    """(prefix, core_text, tail_text) thirds of the chat prompt, where
+    ``core + tail`` is the user turn.  Invariant: the concatenation of
+    the three equals ``format_chat_prompt(system, core + tail)`` exactly.
+
+    Used by vote-phase shared-core prefix caching: ``core`` (the round's
+    proposals + history, identical across agents of a role) is prefilled
+    once per round against the cached role-system prefix; only the tiny
+    per-agent ``tail`` prefills per row.  The core_text keeps the user
+    opener; the tail_text keeps the closer (and the Qwen3 ``/no_think``
+    switch, which belongs at the END of the user turn).
+    """
+    prefix, suffix = format_chat_parts(
+        model_name, system_prompt, core + tail, disable_qwen3_thinking
+    )
+    if not core:
+        return prefix, "", suffix
+    idx = suffix.find(core)
+    if idx < 0:  # defensive: template transformed the user text
+        return prefix, "", suffix
+    cut = idx + len(core)
+    return prefix, suffix[:cut], suffix[cut:]
+
+
 def format_chat_prompt(
     model_name: str,
     system_prompt: str,

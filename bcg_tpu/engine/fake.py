@@ -86,6 +86,8 @@ class FakeEngine(InferenceEngine):
         self.call_count += 1
         if self.call_count <= self.fail_first_n_calls:
             return {"error": "fake_injected_failure", "message": "injected"}
+        if isinstance(prompt, tuple):  # (shared_core, tail) vote prompts
+            prompt = "".join(prompt)
         return self._respond(system_prompt or "", prompt, schema)
 
     def batch_generate_json(self, prompts, temperature=0.8, max_tokens=512):
@@ -93,6 +95,8 @@ class FakeEngine(InferenceEngine):
         out = []
         for system_prompt, user_prompt, schema in prompts:
             self.call_count += 1
+            if isinstance(user_prompt, tuple):  # (shared_core, tail)
+                user_prompt = "".join(user_prompt)
             if self.call_count <= self.fail_first_n_calls:
                 out.append({"error": "fake_injected_failure", "message": "injected"})
             else:

@@ -87,11 +87,15 @@ class InferenceEngine(ABC):
         max_tokens: Union[int, Sequence[int]] = 512,
     ) -> List[Dict[str, Any]]:
         """Batched schema-guided generation over (system, user, schema)
-        tuples.  Unlike the reference (vllm_agent.py:417-455, which falls
-        back to sequential calls when schemas differ), implementations here
-        are expected to batch heterogeneous schemas via per-sequence DFA
-        masks.  ``temperature`` / ``max_tokens`` may be scalars or per-row
-        sequences — see :meth:`batch_generate`."""
+        tuples.  ``user`` is a plain string, or a ``(shared_core, tail)``
+        pair — engines with KV prefix caching may serve the core (a
+        segment identical across rows of a role, e.g. the vote phase's
+        proposals block) from a shared cached prefix; engines without
+        simply join the pair.  Unlike the reference (vllm_agent.py:417-455,
+        which falls back to sequential calls when schemas differ),
+        implementations here are expected to batch heterogeneous schemas
+        via per-sequence DFA masks.  ``temperature`` / ``max_tokens`` may
+        be scalars or per-row sequences — see :meth:`batch_generate`."""
 
     def shutdown(self) -> None:
         """Release device resources (reference vllm_agent.py:506-551)."""

@@ -13,6 +13,7 @@ special ids.  Two implementations:
 
 from __future__ import annotations
 
+import os
 from typing import List, Optional, Sequence
 
 
@@ -82,7 +83,7 @@ class HFTokenizer(Tokenizer):
     path (this build environment has no network egress; checkpoints must
     already be on disk)."""
 
-    def __init__(self, path: str, vocab_id: int = 2):
+    def __init__(self, path: str, vocab_id: Optional[int] = None):
         from transformers import AutoTokenizer
 
         # local_files_only: a bare name would otherwise trigger ~minutes of
@@ -95,8 +96,71 @@ class HFTokenizer(Tokenizer):
         self.pad_id = (
             self.tk.pad_token_id if self.tk.pad_token_id is not None else self.eos_id
         )
+        if vocab_id is None:
+            # Distinct HF vocabularies must not share a guided-DFA cache
+            # slot (the cache key is (vocab_id, vocab_len) —
+            # guided/processor.py): derive a stable id from the local
+            # checkpoint path.  2..2**30 keeps clear of the reserved
+            # ByteTokenizer id 1.
+            import zlib
+
+            vocab_id = 2 + (zlib.crc32(os.path.abspath(path).encode()) % (1 << 30))
         self.vocab_id = vocab_id
         self._byte_decoder = _gpt2_byte_decoder()
+        self._byte_level = self._detect_byte_level()
+        # Added tokens (special or not) are stored as RAW strings in the
+        # vocab, never byte-encoded — they must bypass the byte table.
+        added = getattr(self.tk, "added_tokens_decoder", {}) or {}
+        self._added_ids = set(added)
+        # Control tokens are marked special in tokenizer.json's
+        # added_tokens (AddedToken.special) — transformers only surfaces
+        # the config-registered ones via all_special_ids, but ALL of them
+        # must be forbidden in guided decoding (b'' in the DFA).
+        self._special_ids = set(self.tk.all_special_ids) | {
+            tid for tid, tok in added.items() if getattr(tok, "special", False)
+        }
+
+    def _detect_byte_level(self) -> bool:
+        """True for GPT-2-style byte-level-BPE vocabs (Qwen, Llama-3,
+        GPT-2), False for true SentencePiece vocabs (Llama-2, Mistral
+        pre-tekken).
+
+        The vocab family decides how token strings map to bytes; checking
+        string CONTENT per token (the old heuristic: "has a metaspace →
+        SentencePiece") mis-decodes any byte-BPE vocab entry that happens
+        to contain a literal ``▁`` — e.g. an added token — corrupting the
+        token DFA for every schema.  Introspect the backend tokenizer's
+        declared pre-tokenizer/decoder instead; fall back to a whole-vocab
+        scan for the byte-level space marker ``Ġ`` (U+0120), which every
+        byte-BPE vocab contains and no SentencePiece vocab does.
+        """
+        import json as _json
+
+        backend = getattr(self.tk, "backend_tokenizer", None)
+        if backend is not None:
+            try:
+                spec = _json.loads(backend.to_str())
+
+                def _types(node):
+                    if not isinstance(node, dict):
+                        return set()
+                    out = {node.get("type")}
+                    for sub in node.get("pretokenizers", []) or []:
+                        out |= _types(sub)
+                    for sub in node.get("decoders", []) or []:
+                        out |= _types(sub)
+                    return out
+
+                kinds = _types(spec.get("pre_tokenizer") or {})
+                kinds |= _types(spec.get("decoder") or {})
+                kinds |= {(spec.get("model") or {}).get("type")}
+                if "ByteLevel" in kinds:
+                    return True
+                if "Metaspace" in kinds:
+                    return False
+            except Exception:
+                pass
+        return any("Ġ" in t for t in self.tk.get_vocab())
 
     def encode(self, text: str) -> List[int]:
         return self.tk.encode(text, add_special_tokens=False)
@@ -105,16 +169,26 @@ class HFTokenizer(Tokenizer):
         return self.tk.decode(list(ids), skip_special_tokens=True)
 
     def _token_to_bytes(self, token: str, tid: int) -> bytes:
-        if tid in self.tk.all_special_ids:
+        if tid in self._special_ids:
             return b""
-        # SentencePiece metaspace.
-        if "▁" in token:
-            return token.replace("▁", " ").encode("utf-8")
-        # GPT-2 byte-unicode.
-        try:
-            return bytes(self._byte_decoder[ch] for ch in token)
-        except KeyError:
+        if tid in self._added_ids:
+            # Non-special added token: raw string, whatever the family.
             return token.encode("utf-8")
+        if self._byte_level:
+            # GPT-2 byte-unicode table (fix vs round 1: byte-level is
+            # decided per VOCAB, so a literal metaspace inside a byte-BPE
+            # token can no longer divert it to the SentencePiece branch).
+            try:
+                return bytes(self._byte_decoder[ch] for ch in token)
+            except KeyError:
+                return token.encode("utf-8")
+        # True SentencePiece: byte-fallback pieces <0xNN>, metaspace = " ".
+        if len(token) == 6 and token.startswith("<0x") and token.endswith(">"):
+            try:
+                return bytes([int(token[3:5], 16)])
+            except ValueError:
+                pass
+        return token.replace("▁", " ").encode("utf-8")
 
     def token_bytes(self) -> List[bytes]:
         out = [b""] * self.vocab_size

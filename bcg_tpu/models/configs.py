@@ -97,6 +97,22 @@ MODEL_SPECS: Dict[str, ModelSpec] = {
         intermediate_size=16384, rope_theta=1_000_000.0,
         rms_eps=1e-5, max_position=32768,
     ),
+    # Hermetic HF-artifact specs (models/hf_fixture.py): loaded through
+    # the REAL checkpoint pipeline — AutoTokenizer + safetensors shards +
+    # config.json on local disk — with random weights.  `tiny` proves the
+    # pipeline on CPU in tests; `bench-1b` is the TPU-scale variant.
+    "bcg-hf/tiny": ModelSpec(
+        name="bcg-hf/tiny",
+        vocab_size=512, hidden_size=64, num_layers=2,
+        num_heads=4, num_kv_heads=2, head_dim=16,
+        intermediate_size=128, qk_norm=True, max_position=2048,
+    ),
+    "bcg-hf/bench-1b": ModelSpec(
+        name="bcg-hf/bench-1b",
+        vocab_size=32768, hidden_size=2048, num_layers=16,
+        num_heads=16, num_kv_heads=8, head_dim=128,
+        intermediate_size=6144, qk_norm=True, max_position=8192,
+    ),
     # Hermetic tiny model: byte tokenizer vocabulary, runs on CPU in ms.
     "bcg-tpu/tiny-test": ModelSpec(
         name="bcg-tpu/tiny-test",

@@ -1,0 +1,239 @@
+"""Hermetic HuggingFace artifact construction.
+
+This environment has zero network egress, so the real-model pipeline —
+``find_checkpoint_dir`` → ``load_checkpoint_params`` →
+``HFTokenizer.token_bytes`` → token DFA → chat template (everything the
+reference gets from the HF hub + vLLM boot, ``vllm_agent.py:100-157``) —
+cannot be proven against a downloaded Qwen3 checkpoint.  It CAN be
+proven against a *genuine* artifact set constructed on disk:
+
+* a real byte-level-BPE ``tokenizer.json`` built with the ``tokenizers``
+  library — GPT-2 byte-unicode alphabet, trained merges, ChatML special
+  tokens — loaded through ``transformers.AutoTokenizer`` exactly like a
+  hub checkpoint;
+* a real-layout safetensors checkpoint: HF parameter names
+  (``model.layers.{i}.self_attn.q_proj.weight`` …), ``[out, in]``
+  projection layout, bf16 storage, multi-shard with an index file;
+* an HF-style ``config.json`` carrying the architecture fields.
+
+Nothing in the loading path knows these artifacts are synthetic — the
+only difference from a hub snapshot is that the weights are random.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Iterable, List, Optional
+
+import numpy as np
+
+from bcg_tpu.models.configs import ModelSpec, spec_for_model
+
+# ChatML specials, matching the chat_template fallback family used for
+# bcg-hf/* model names (engine/chat_template.py).
+CHATML_SPECIALS = ["<|endoftext|>", "<|im_start|>", "<|im_end|>"]
+# A literal-metaspace token added as a NON-special vocab entry: the
+# round-1 ``_token_to_bytes`` heuristic (metaspace checked before the
+# byte table) silently mis-decoded exactly this shape of entry in a
+# byte-level-BPE vocab — kept in the fixture as a permanent regression
+# input for the tokenizer tests.
+METASPACE_PROBE_TOKEN = "▁probe▁"
+
+# Shard size cap: small enough that the bench-1b fixture splits into
+# several shards, exercising the loader's name->file indexing.
+_MAX_SHARD_BYTES = 1 << 30
+
+
+def _training_corpus() -> Iterable[str]:
+    """Synthetic corpus shaped like the game's actual token stream:
+    prompt prose, agent/value vocabulary, and JSON decision payloads."""
+    base = [
+        "You are agent_{i} in a multi-agent consensus game. Your current "
+        "value is {v}. Propose a value between 0 and 50.",
+        '{{"internal_strategy": "converge toward the median of recent '
+        'proposals", "value": {v}, "public_reasoning": "Values are '
+        'clustering near {v}, so I am moving toward the group."}}',
+        '{{"decision": "continue"}} {{"decision": "stop"}} '
+        '{{"decision": "abstain"}}',
+        "Round {i}: agent_{i} value: {v} | Reasoning: moving toward the "
+        "median to reach consensus quickly.",
+        "The quick brown fox jumps over the lazy dog. 0123456789 "
+        "agreement rate, Byzantine agents may exist, vote to terminate.",
+        "history shows values 12, 17, 23, 25, 25, 25 converging; "
+        "suspicious outlier at 49 ignored.",
+    ]
+    for i in range(64):
+        for t in base:
+            yield t.format(i=i % 10, v=(i * 7) % 51)
+
+
+def build_tokenizer_files(out_dir: str, vocab_size: int) -> None:
+    """Train and save a byte-level-BPE tokenizer into ``out_dir``.
+
+    ``vocab_size`` counts the FULL tokenizer vocabulary: trained
+    byte-level entries + ChatML specials + the metaspace probe token.
+    """
+    from tokenizers import Tokenizer, decoders, models, pre_tokenizers, trainers
+
+    n_added = len(CHATML_SPECIALS) + 1
+    tok = Tokenizer(models.BPE(unk_token=None))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=vocab_size - n_added,
+        special_tokens=[],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+        show_progress=False,
+    )
+    tok.train_from_iterator(_training_corpus(), trainer)
+    tok.add_special_tokens(CHATML_SPECIALS)
+    tok.add_tokens([METASPACE_PROBE_TOKEN])
+    os.makedirs(out_dir, exist_ok=True)
+    tok.save(os.path.join(out_dir, "tokenizer.json"))
+    with open(os.path.join(out_dir, "tokenizer_config.json"), "w") as f:
+        json.dump(
+            {
+                "tokenizer_class": "PreTrainedTokenizerFast",
+                "eos_token": "<|im_end|>",
+                "pad_token": "<|endoftext|>",
+                "bos_token": None,
+                "additional_special_tokens": ["<|im_start|>"],
+                "model_max_length": 8192,
+            },
+            f,
+            indent=2,
+        )
+    with open(os.path.join(out_dir, "special_tokens_map.json"), "w") as f:
+        json.dump({"eos_token": "<|im_end|>", "pad_token": "<|endoftext|>"}, f)
+
+
+def _hf_config(spec: ModelSpec) -> Dict:
+    """HF ``config.json`` payload for the Qwen3-style architecture."""
+    return {
+        "architectures": ["Qwen3ForCausalLM"],
+        "model_type": "qwen3",
+        "vocab_size": spec.vocab_size,
+        "hidden_size": spec.hidden_size,
+        "num_hidden_layers": spec.num_layers,
+        "num_attention_heads": spec.num_heads,
+        "num_key_value_heads": spec.num_kv_heads,
+        "head_dim": spec.head_dim,
+        "intermediate_size": spec.intermediate_size,
+        "rope_theta": spec.rope_theta,
+        "rms_norm_eps": spec.rms_eps,
+        "max_position_embeddings": spec.max_position,
+        "tie_word_embeddings": spec.tie_embeddings,
+        "torch_dtype": "bfloat16",
+    }
+
+
+def _tensor_specs(spec: ModelSpec) -> List:
+    """(hf_name, shape) for every tensor, HF ``[out, in]`` layout —
+    mirror of the loader's ``_LAYER_MAP``/``_TOP_MAP`` so generated
+    checkpoints and the loader can never drift apart silently."""
+    from bcg_tpu.models.loader import _LAYER_MAP, _TOP_MAP, _TRANSPOSED
+
+    shapes = {
+        "embed": (spec.vocab_size, spec.hidden_size),
+        "final_norm": (spec.hidden_size,),
+        "lm_head": (spec.vocab_size, spec.hidden_size),
+        "attn_norm": (spec.hidden_size,),
+        "wq": (spec.q_size, spec.hidden_size),
+        "wk": (spec.kv_size, spec.hidden_size),
+        "wv": (spec.kv_size, spec.hidden_size),
+        "bq": (spec.q_size,),
+        "bk": (spec.kv_size,),
+        "bv": (spec.kv_size,),
+        "wo": (spec.hidden_size, spec.q_size),
+        "q_norm": (spec.head_dim,),
+        "k_norm": (spec.head_dim,),
+        "mlp_norm": (spec.hidden_size,),
+        "w_gate": (spec.intermediate_size, spec.hidden_size),
+        "w_up": (spec.intermediate_size, spec.hidden_size),
+        "w_down": (spec.hidden_size, spec.intermediate_size),
+    }
+    del _TRANSPOSED  # layout already expressed in `shapes`
+    out = []
+    for logical, hf_name in _TOP_MAP.items():
+        if logical == "lm_head" and spec.tie_embeddings:
+            continue
+        out.append((hf_name, shapes[logical]))
+    for i in range(spec.num_layers):
+        for logical, template in _LAYER_MAP.items():
+            if logical in ("q_norm", "k_norm") and not spec.qk_norm:
+                continue
+            if logical in ("bq", "bk", "bv") and not spec.attn_bias:
+                continue
+            out.append((template.format(i=i), shapes[logical]))
+    return out
+
+
+def build_checkpoint(
+    model_name: str,
+    out_dir: Optional[str] = None,
+    seed: int = 0,
+    force: bool = False,
+) -> str:
+    """Materialize the full HF artifact set for ``model_name`` (a
+    ``bcg-hf/*`` spec) and return the checkpoint directory.
+
+    Idempotent: an existing complete checkpoint is returned as-is unless
+    ``force``.  Weights are N(0, 0.02) bf16 — random, but stored and
+    laid out exactly like a hub snapshot.
+    """
+    import ml_dtypes
+    from safetensors.numpy import save_file
+
+    spec = spec_for_model(model_name)
+    if spec is None:
+        raise ValueError(f"no ModelSpec registered for {model_name!r}")
+    if out_dir is None:
+        out_dir = os.path.join("checkpoints", model_name.replace("/", "--"))
+    done_marker = os.path.join(out_dir, ".complete")
+    if os.path.exists(done_marker) and not force:
+        return out_dir
+    os.makedirs(out_dir, exist_ok=True)
+
+    # Tokenizer vocab leaves headroom below the model vocab, like real
+    # families (Qwen3: tokenizer 151669 < embedding 151936).
+    build_tokenizer_files(out_dir, vocab_size=spec.vocab_size - 64)
+    with open(os.path.join(out_dir, "config.json"), "w") as f:
+        json.dump(_hf_config(spec), f, indent=2)
+
+    rng = np.random.default_rng(seed)
+    specs = _tensor_specs(spec)
+    shards: List[List] = [[]]
+    shard_bytes = 0
+    for hf_name, shape in specs:
+        nbytes = int(np.prod(shape)) * 2
+        if shard_bytes and shard_bytes + nbytes > _MAX_SHARD_BYTES:
+            shards.append([])
+            shard_bytes = 0
+        shards[-1].append((hf_name, shape))
+        shard_bytes += nbytes
+
+    index = {"metadata": {"total_size": 0}, "weight_map": {}}
+    n = len(shards)
+    for si, shard in enumerate(shards, start=1):
+        fname = (
+            "model.safetensors"
+            if n == 1
+            else f"model-{si:05d}-of-{n:05d}.safetensors"
+        )
+        tensors = {}
+        for hf_name, shape in shard:
+            arr = rng.standard_normal(shape, dtype=np.float32) * 0.02
+            if hf_name.endswith("norm.weight"):
+                arr = np.ones(shape, dtype=np.float32)
+            tensors[hf_name] = arr.astype(ml_dtypes.bfloat16)
+            index["weight_map"][hf_name] = fname
+            index["metadata"]["total_size"] += tensors[hf_name].nbytes
+        save_file(tensors, os.path.join(out_dir, fname))
+    if n > 1:
+        with open(os.path.join(out_dir, "model.safetensors.index.json"), "w") as f:
+            json.dump(index, f, indent=2)
+
+    with open(done_marker, "w") as f:
+        f.write("ok\n")
+    return out_dir

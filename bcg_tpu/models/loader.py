@@ -55,6 +55,9 @@ def find_checkpoint_dir(model_name: str) -> Optional[str]:
         candidates.append(os.path.join(env, model_name.replace("/", "--")))
         candidates.append(env)
     candidates.append(model_name)  # model_name may itself be a path
+    # Repo-local checkpoints (e.g. the hermetic bcg-hf/* artifact sets
+    # built by models/hf_fixture.py).
+    candidates.append(os.path.join("checkpoints", model_name.replace("/", "--")))
     hf_home = os.environ.get("HF_HOME", os.path.expanduser("~/.cache/huggingface"))
     snap_root = os.path.join(
         hf_home, "hub", f"models--{model_name.replace('/', '--')}", "snapshots"

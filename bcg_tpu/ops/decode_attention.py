@@ -36,7 +36,7 @@ _NEG_INF = -1e30
 
 def _decode_kernel(
     q_ref, k_ref, v_ref, ks_ref, vs_ref, mask_ref, o_ref,
-    m_scr, l_scr, acc_scr, *, scale, num_s_blocks, quantized, group,
+    m_scr, l_scr, acc_scr, *, scale, num_s_blocks, quantized,
 ):
     s = pl.program_id(2)
 
@@ -51,11 +51,15 @@ def _decode_kernel(
 
     if quantized:
         # int8 cache layout [B, Hkv, S, Dh]: the block's last two dims
-        # are (Sblk, Dh) — Mosaic-native (32, 128) int8 tiles.
+        # are (Sblk, Dh) — Mosaic-native (32, 128) int8 tiles.  Scale
+        # blocks span ALL kv heads (a (1, Sblk) slice would violate the
+        # Mosaic sublane rule — block dims must be 8-multiples or whole);
+        # each program selects its head row.
+        h = pl.program_id(1)
         k = k_ref[0, 0]                      # [Sblk, Dh] int8
         v = v_ref[0, 0]
-        k = k.astype(jnp.float32) * ks_ref[0, 0][:, None]
-        v = v.astype(jnp.float32) * vs_ref[0, 0][:, None]
+        k = k.astype(jnp.float32) * ks_ref[0, h][:, None]
+        v = v.astype(jnp.float32) * vs_ref[0, h][:, None]
     else:
         k = k_ref[0, :, 0, :]                # [Sblk, Dh]
         v = v_ref[0, :, 0, :]
@@ -63,11 +67,9 @@ def _decode_kernel(
     v = v.astype(q.dtype)
 
     # Single-step decode passes one mask row shared by every query row
-    # (broadcast); the chunk variant passes one row per chunk position
-    # (rows are laid out position-major, so repeat by ``group``).
-    if mask.shape[0] > 1:
-        mask = jnp.repeat(mask, group, axis=0)  # [rows, Sblk]
-
+    # (broadcast [1, Sblk]); the chunk variant pre-repeats per query row
+    # HOST-SIDE ([rows, Sblk]) so the kernel never relies on Mosaic
+    # lowering of an in-kernel repeat.
     scores = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale                                # [rows, Sblk]
@@ -140,7 +142,6 @@ def decode_attention(
 
     kernel = functools.partial(
         _decode_kernel, scale=scale, num_s_blocks=nS, quantized=quantized,
-        group=group,
     )
     out = pl.pallas_call(
         kernel,
@@ -149,8 +150,8 @@ def decode_attention(
             pl.BlockSpec((1, 1, group, Dh), lambda b, h, s: (b, h, 0, 0)),
             kv_spec,
             kv_spec,
-            pl.BlockSpec((1, 1, block_s), lambda b, h, s: (b, h, s)),
-            pl.BlockSpec((1, 1, block_s), lambda b, h, s: (b, h, s)),
+            pl.BlockSpec((1, Hkv, block_s), lambda b, h, s: (b, 0, s)),
+            pl.BlockSpec((1, Hkv, block_s), lambda b, h, s: (b, 0, s)),
             pl.BlockSpec((1, 1, block_s), lambda b, h, s: (b, 0, s)),
         ],
         out_specs=pl.BlockSpec((1, 1, group, Dh), lambda b, h, s: (b, h, 0, 0)),
@@ -204,6 +205,10 @@ def chunk_decode_attention(
         vsp = ksp
     group = H // Hkv
     mp = _pad_s(mask, block_s, axis=2)              # [B, K, Sp]
+    # Pre-repeat per query row (position-major: row k*group+g = mask[k]),
+    # so the kernel indexes mask rows directly instead of repeating
+    # in-kernel (no reliance on Mosaic repeat lowering; see _decode_kernel).
+    mp = jnp.repeat(mp, group, axis=1)              # [B, K*group, Sp]
     nS = Sp // block_s
 
     # [B, K, Hkv, group, Dh] -> [B, Hkv, K*group, Dh]: position-major row
@@ -216,7 +221,6 @@ def chunk_decode_attention(
 
     kernel = functools.partial(
         _decode_kernel, scale=scale, num_s_blocks=nS, quantized=quantized,
-        group=group,
     )
     out = pl.pallas_call(
         kernel,
@@ -225,9 +229,9 @@ def chunk_decode_attention(
             pl.BlockSpec((1, 1, K * group, Dh), lambda b, h, s: (b, h, 0, 0)),
             kv_spec,
             kv_spec,
-            pl.BlockSpec((1, 1, block_s), lambda b, h, s: (b, h, s)),
-            pl.BlockSpec((1, 1, block_s), lambda b, h, s: (b, h, s)),
-            pl.BlockSpec((1, K, block_s), lambda b, h, s: (b, 0, s)),
+            pl.BlockSpec((1, Hkv, block_s), lambda b, h, s: (b, 0, s)),
+            pl.BlockSpec((1, Hkv, block_s), lambda b, h, s: (b, 0, s)),
+            pl.BlockSpec((1, K * group, block_s), lambda b, h, s: (b, 0, s)),
         ],
         out_specs=pl.BlockSpec(
             (1, 1, K * group, Dh), lambda b, h, s: (b, h, 0, 0)

@@ -46,7 +46,9 @@ _SPECS = {
 }
 
 
-def param_sharding(logical_name: str, spec: ModelSpec, mesh: Mesh) -> NamedSharding:
+def param_sharding(
+    logical_name: str, spec: ModelSpec, mesh: Mesh, stacked: bool = False
+) -> NamedSharding:
     """Sharding for a logical parameter path like ``layers.3.wq``.
 
     int8-quantized weights appear as ``...wq.q`` / ``...wq.scale`` leaves
@@ -55,6 +57,10 @@ def param_sharding(logical_name: str, spec: ModelSpec, mesh: Mesh) -> NamedShard
     over ``tp`` for column-parallel parents (wq/wk/wv/w_gate/w_up, and the
     vocab-dim lm_head), replicated for row-parallel parents (wo/w_down,
     whose sharded dim is the input).
+
+    ``stacked``: the leaf carries a leading [num_layers] dim
+    (scan-over-layers layout, transformer.stack_layer_params) — the
+    layer axis replicates and every other axis keeps its spec.
     """
     parts = logical_name.split(".")
     leaf = parts[-1]
@@ -72,11 +78,17 @@ def param_sharding(logical_name: str, spec: ModelSpec, mesh: Mesh) -> NamedShard
     if quant_kind == "scale":
         # Per-output-channel vector: keep the weight's OUTPUT-dim axis.
         pspec = P(pspec[-1] if len(pspec) > 0 else None)
+    if stacked:
+        pspec = P(*((None,) + tuple(pspec)))
     return NamedSharding(mesh, pspec)
 
 
 def shard_params(params: Dict, spec: ModelSpec, mesh: Mesh) -> Dict:
-    """Apply partition specs to every leaf of the param pytree."""
+    """Apply partition specs to every leaf of the param pytree.
+
+    Handles both layouts: per-layer list (``layers.3.wq``) and stacked
+    scan-over-layers (``layers.wq`` with a leading layer dim)."""
+    stacked_layers = isinstance(params.get("layers"), dict)
 
     def place(path_parts, subtree):
         if isinstance(subtree, dict):
@@ -84,7 +96,10 @@ def shard_params(params: Dict, spec: ModelSpec, mesh: Mesh) -> Dict:
         if isinstance(subtree, list):
             return [place(path_parts + [str(i)], v) for i, v in enumerate(subtree)]
         logical = ".".join(path_parts)
-        return jax.device_put(subtree, param_sharding(logical, spec, mesh))
+        stacked = stacked_layers and path_parts and path_parts[0] == "layers"
+        return jax.device_put(
+            subtree, param_sharding(logical, spec, mesh, stacked=stacked)
+        )
 
     return place([], params)
 

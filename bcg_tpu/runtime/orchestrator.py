@@ -141,6 +141,16 @@ class BCGSimulation:
 
         self.engine = engine if engine is not None else create_engine(self.config.engine)
         self.profiler = SimulationProfiler()
+        # Vote-phase shared-core prompt caching is only sound when every
+        # agent provably received every broadcast — fully-connected
+        # topology over the reliable channel (the SPMD exchange also
+        # qualifies: it requires a2a_sim and delivers the full mask).
+        # Ring/grid/custom topologies or a lossy channel give agents
+        # DIFFERENT inboxes, so each keeps its per-agent prompt.
+        self._vote_shared_core = (
+            self.config.network.topology_type == "fully_connected"
+            and self.config.communication.protocol_type == "a2a_sim"
+        )
 
         self.agents: Dict = {}
         self._plotted = False
@@ -448,6 +458,7 @@ class BCGSimulation:
 
         phase = Phase.PROPOSE
         game_state = self.game.get_game_state()
+        game_state["vote_shared_core"] = self._vote_shared_core
         use_batched = (
             self.config.agent.use_batched_inference
             and self.config.agent.use_structured_output
@@ -655,9 +666,13 @@ class BCGSimulation:
     # ----------------------------------------------------------------- output
 
     def display_results(self) -> None:
-        """Final results display (reference main.py:693-790)."""
+        """Final results display (reference main.py:693-790).
+
+        Always printed to the console — the reference emits this block via
+        ``tee_print`` (main.py:792-850), so it is visible without --verbose.
+        """
         stats = self.game.get_statistics()
-        log = self.logger.log
+        log = self.logger.echo
         log("=" * 60)
         log("SIMULATION COMPLETE")
         log("=" * 60)

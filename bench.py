@@ -98,7 +98,22 @@ def main() -> None:
             }))
             return
 
-    kv_dtype = os.environ.get("BENCH_KV_DTYPE", "bfloat16")
+    # bcg-hf/* models run the REAL checkpoint pipeline (AutoTokenizer +
+    # safetensors + config.json from local disk, models/hf_fixture.py)
+    # instead of in-process random init — the weights are still random,
+    # but every loading/tokenization/DFA step is the one a hub
+    # checkpoint would take.  Built once; reused across runs.
+    if model.startswith("bcg-hf/"):
+        from bcg_tpu.models.hf_fixture import build_checkpoint
+
+        build_checkpoint(model)
+
+    # int8 KV default for 8B-class models: the bf16 cache alone pushes a
+    # 16 GB chip past capacity next to int8 weights (measured compile-time
+    # OOM); smaller models default bf16 (int8 KV loses wall-clock there).
+    kv_dtype = os.environ.get(
+        "BENCH_KV_DTYPE", "int8" if "8b" in model else "bfloat16"
+    )
     base = BCGConfig()
     cfg = dataclasses.replace(
         base,
@@ -125,6 +140,11 @@ def main() -> None:
             # pass).  Needed alongside BENCH_PREFIX_CACHING=0 for
             # 8B-class models on one chip.
             prefill_chunk=int(os.environ.get("BENCH_PREFILL_CHUNK", "0")),
+            # Scan-over-layers: O(1)-in-depth program, required for
+            # 8B-class compiles through the remote-compile helper
+            # (default ON for 8b models, off elsewhere — the unrolled
+            # form keeps better cache-update aliasing in the decode loop).
+            scan_layers=_env_flag("BENCH_SCAN_LAYERS", "8b" in model),
         ),
         metrics=dataclasses.replace(
             base.metrics, save_results=False, generate_plots=False
@@ -179,6 +199,18 @@ def main() -> None:
 
     warm_seed = 1000
     seed = 1
+
+    def _counters():
+        return (
+            getattr(engine, "total_decode_steps", 0),
+            getattr(engine, "total_rows", 0),
+            getattr(engine, "failed_rows", 0),
+            getattr(engine, "prefill_tokens", 0),
+            getattr(engine, "prefill_seconds", 0.0),
+            getattr(engine, "decode_seconds", 0.0),
+            getattr(engine, "decode_kv_bytes", 0),
+            getattr(engine, "decode_weight_passes", 0),
+        )
     if concurrency > 1:
         sims = [fresh_sim(warm_seed + i) for i in range(concurrency)]
 
@@ -204,6 +236,7 @@ def main() -> None:
                 break
 
         waves = 0
+        w0 = _counters()
         t0 = time.perf_counter()
         while waves < measured_rounds:
             # Replace at the TOP (like the single-game path): the final
@@ -235,6 +268,7 @@ def main() -> None:
         # correlated); keep starting fresh games until N rounds are
         # measured.
         rounds_done = 0
+        w0 = _counters()
         t0 = time.perf_counter()
         while rounds_done < measured_rounds:
             if sim.game.game_over:
@@ -244,12 +278,18 @@ def main() -> None:
             rounds_done += 1
         elapsed = time.perf_counter() - t0
 
-    # Sanity: a real engine must actually have DECODED.  When every LLM
-    # call errors out, agents silently abstain and rounds finish in
-    # milliseconds — a broad exception-to-error-dict path once turned a
-    # Pallas lowering bug into a 6x-too-good number here.  Refuse to
-    # report a throughput that never ran the model.
-    if backend != "fake" and not getattr(engine, "last_decode_steps", 0):
+    # Sanity: a real engine must actually have DECODED across the WHOLE
+    # measured window, not just the final call.  When LLM calls error out,
+    # agents silently abstain and rounds finish in milliseconds — a broad
+    # exception-to-error-dict path once turned a Pallas lowering bug into
+    # a 6x-too-good number here.  Refuse to report a throughput whose
+    # window never (or mostly never) ran the model.
+    w1 = _counters()
+    window_steps = w1[0] - w0[0]
+    window_rows = w1[1] - w0[1]
+    window_failed = w1[2] - w0[2]
+    failed_fraction = window_failed / window_rows if window_rows else 0.0
+    if backend != "fake" and window_steps <= 0:
         print(json.dumps({
             "metric": "agent_decisions_per_sec",
             "value": 0.0,
@@ -259,10 +299,66 @@ def main() -> None:
                      "window - every LLM call failed; see run logs",
         }))
         return
+    if backend != "fake" and failed_fraction > 0.5:
+        print(json.dumps({
+            "metric": "agent_decisions_per_sec",
+            "value": 0.0,
+            "unit": "decisions/sec",
+            "vs_baseline": 0.0,
+            "error": f"{failed_fraction:.0%} of generation rows in the "
+                     "measured window returned error dicts - throughput "
+                     "would mostly measure instant failures; see run logs",
+        }))
+        return
 
     # decide + vote are each one guided LLM generation per agent per round.
     decisions = 2 * n_agents * rounds_done
     decisions_per_sec = decisions / elapsed
+
+    # Achieved bandwidth / MFU over the measured window (VERDICT round-1
+    # weak #5: the bench JSON itself must carry utilization, not leave it
+    # to back-of-envelope).  v5e chip peaks; decode traffic = one full
+    # weight pass per loop iteration + the allocated KV window per step
+    # (engine accounting, jax_engine._decode_batch).
+    V5E_HBM_GBPS = 819.0
+    V5E_BF16_TFLOPS = 197.0
+    V5E_INT8_TFLOPS = 394.0
+    perf = {}
+    if backend != "fake":
+        dp_tokens = w1[3] - w0[3]
+        dp_secs = w1[4] - w0[4]
+        dc_secs = w1[5] - w0[5]
+        dc_kv = w1[6] - w0[6]
+        dc_passes = w1[7] - w0[7]
+        spec = engine.spec
+        layer_matmul = (
+            spec.hidden_size * (spec.q_size + 2 * spec.kv_size)  # q,k,v
+            + spec.q_size * spec.hidden_size                     # o
+            + 3 * spec.hidden_size * spec.intermediate_size      # mlp
+        )
+        matmul_params = spec.num_layers * layer_matmul
+        param_bytes = getattr(engine, "_param_bytes", 0)
+        peak_tflops = (
+            V5E_INT8_TFLOPS if cfg.engine.quantization == "int8"
+            else V5E_BF16_TFLOPS
+        )
+        if dp_secs > 0 and dp_tokens:
+            prefill_tflops = 2 * matmul_params * dp_tokens / dp_secs / 1e12
+            perf["prefill_mfu"] = round(prefill_tflops / peak_tflops, 4)
+            perf["prefill_tflops"] = round(prefill_tflops, 2)
+            perf["prefill_tokens"] = dp_tokens
+            perf["prefill_seconds"] = round(dp_secs, 2)
+        if dc_secs > 0 and dc_passes:
+            decode_bytes = dc_kv + dc_passes * param_bytes
+            gbps = decode_bytes / dc_secs / 1e9
+            perf["decode_gbps"] = round(gbps, 1)
+            perf["decode_hbm_util"] = round(gbps / V5E_HBM_GBPS, 4)
+            perf["decode_seconds"] = round(dc_secs, 2)
+            # ~rows per loop iteration = agents x concurrent games
+            # (retry sub-batches are smaller; this is an upper-ish bound).
+            perf["decode_tok_per_sec"] = round(
+                window_steps * n_agents * concurrency / dc_secs, 1
+            )
 
     result = {
         "metric": "agent_decisions_per_sec",
@@ -278,14 +374,22 @@ def main() -> None:
             "agents": n_agents,
             "model": model,
             "backend": backend,
+            "checkpoint": (
+                "none" if backend == "fake"
+                else "hf" if model.startswith("bcg-hf/")
+                else "random"
+            ),
             "quantization": cfg.engine.quantization,
             "kv_cache_dtype": cfg.engine.kv_cache_dtype,
             "fast_forward": cfg.engine.decode_fast_forward,
             "compact_json": cfg.engine.guided_compact_json,
             "prefix_caching": cfg.engine.prefix_caching,
             "prefill_chunk": cfg.engine.prefill_chunk,
+            "scan_layers": cfg.engine.scan_layers,
             "platform": platform,
             "elapsed_sec": round(elapsed, 2),
+            "window_decode_steps": window_steps,
+            "window_failed_row_fraction": round(failed_fraction, 4),
             "baseline_note": "denominator is an ESTIMATED reference rate "
             "(vLLM/A100, max_num_seqs=4); reference publishes no numbers",
         },

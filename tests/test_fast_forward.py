@@ -196,7 +196,7 @@ class TestCompactJson:
             decode_fast_forward=True, guided_compact_json=True,
         ))
         texts = ff._run_guided(
-            [("s ", "vote"), ("s ", "decide")], [VOTE, DECISION],
+            [("s ", "", "vote"), ("s ", "", "decide")], [VOTE, DECISION],
             temperature=0.7, max_tokens=120,
         )
         for t in texts:

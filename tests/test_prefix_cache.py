@@ -148,7 +148,7 @@ class TestEnginePrefixCaching:
         # below that yields a clean EMPTY output by design (see
         # TestGuaranteedParse in test_jax_engine.py).
         texts = engine._run_guided(
-            [("p1 ", "s1"), ("p2 ", "s2")],
+            [("p1 ", "", "s1"), ("p2 ", "", "s2")],
             [bounded, SCHEMA],
             temperature=[0.0, 0.9],
             max_tokens=[40, 30],

@@ -1,0 +1,238 @@
+"""Vote-phase shared-core prefix caching (VERDICT round-1 item #3).
+
+The round's proposals + history block is identical across agents of a
+role; under fully-connected reliable delivery the orchestrator switches
+vote prompts to ``(core, tail)`` pairs and the engine serves the core
+from a two-level cached KV prefix (role system -> per-round core),
+prefilling only the tiny per-agent tail per row.
+"""
+
+import dataclasses
+
+import pytest
+
+from bcg_tpu.agents import create_agent
+from bcg_tpu.config import BCGConfig, EngineConfig
+from bcg_tpu.engine.fake import FakeEngine
+from bcg_tpu.runtime.orchestrator import BCGSimulation
+
+GAME_STATE = {"round": 3, "max_rounds": 20, "vote_shared_core": True}
+
+
+def _agent(aid, byz=False):
+    a = create_agent(
+        agent_id=aid, is_byzantine=byz, engine=FakeEngine(),
+        value_range=(0, 50), byzantine_awareness="may_exist",
+    )
+    if not byz:
+        a.set_initial_value(10)
+    return a
+
+
+def _deliver(agents):
+    """Simulate full reliable delivery of every proposal."""
+    proposals = {
+        a.agent_id: (a.my_value, a.last_reasoning or f"Proposing value: {int(a.my_value)}")
+        for a in agents if a.my_value is not None
+    }
+    for a in agents:
+        a.receive_proposals([
+            (sid, v, r) for sid, (v, r) in sorted(proposals.items())
+            if sid != a.agent_id
+        ])
+
+
+class TestSharedCoreParts:
+    def test_cores_identical_across_same_role_agents(self):
+        agents = [_agent(f"agent_{i}") for i in range(4)]
+        for i, a in enumerate(agents):
+            a.my_value = 10 + i
+            a.last_reasoning = f"reasoning of {a.agent_id}"
+        _deliver(agents)
+        prompts = [a.build_vote_round_prompt(GAME_STATE) for a in agents]
+        assert all(isinstance(p, tuple) for p in prompts)
+        cores = {p[0] for p in prompts}
+        assert len(cores) == 1, "shared core must be byte-identical"
+        tails = [p[1] for p in prompts]
+        assert len(set(tails)) == 4, "tails must stay per-agent"
+        for a, (_, tail) in zip(agents, prompts):
+            assert f"You are {a.agent_id}." in tail
+
+    def test_core_contains_every_proposal_once(self):
+        agents = [_agent(f"agent_{i}") for i in range(3)]
+        for i, a in enumerate(agents):
+            a.my_value = 7 * (i + 1)
+        _deliver(agents)
+        core, _ = agents[0].build_vote_round_prompt(GAME_STATE)
+        for a in agents:
+            assert f"{a.agent_id}: {int(a.my_value)}" in core
+        assert "(you)" not in core
+
+    def test_abstainer_absent_from_core_present_in_tail(self):
+        agents = [_agent(f"agent_{i}") for i in range(3)]
+        agents[0].my_value = None  # abstained
+        agents[1].my_value = 5
+        agents[2].my_value = 5
+        _deliver(agents)
+        core, tail = agents[0].build_vote_round_prompt(GAME_STATE)
+        assert "agent_0" not in core
+        assert "You are agent_0. You ABSTAINED this round" in tail
+        # Other agents' cores identical to the abstainer's.
+        core1, _ = agents[1].build_vote_round_prompt(GAME_STATE)
+        assert core1 == core
+
+    def test_system_prompts_shared_per_role(self):
+        honest = [_agent(f"agent_{i}") for i in range(3)]
+        byz = [_agent(f"agent_{i}", byz=True) for i in range(3, 5)]
+        hsp = {a.build_vote_system_prompt(GAME_STATE) for a in honest}
+        bsp = {a.build_vote_system_prompt(GAME_STATE) for a in byz}
+        assert len(hsp) == 1 and len(bsp) == 1
+        assert hsp != bsp
+
+    def test_fallback_mode_single_string_with_you_marker(self):
+        a = _agent("agent_0")
+        a.my_value = 12
+        state = dict(GAME_STATE, vote_shared_core=False)
+        vp = a.build_vote_round_prompt(state)
+        assert isinstance(vp, str)
+        assert "agent_0 (you): 12" in vp
+
+    def test_byzantine_core_tail_structure(self):
+        b = _agent("agent_9", byz=True)
+        b.my_value = 3
+        core, tail = b.build_vote_round_prompt(GAME_STATE)
+        assert "BYZANTINE VOTING" in core
+        assert "You are agent_9." in tail
+        assert '"abstain"' in tail
+
+
+class TestOrchestratorGating:
+    def _cfg(self, **net):
+        base = BCGConfig()
+        return dataclasses.replace(
+            base,
+            game=dataclasses.replace(
+                base.game, num_honest=3, num_byzantine=1, max_rounds=3, seed=0
+            ),
+            network=dataclasses.replace(base.network, **net),
+            engine=dataclasses.replace(base.engine, backend="fake"),
+            metrics=dataclasses.replace(base.metrics, save_results=False),
+        )
+
+    def test_fully_connected_enables_shared_core(self):
+        sim = BCGSimulation(config=self._cfg())
+        assert sim._vote_shared_core is True
+
+    def test_ring_disables_shared_core(self):
+        sim = BCGSimulation(config=self._cfg(topology_type="ring"))
+        assert sim._vote_shared_core is False
+
+    def test_lossy_channel_disables_shared_core(self):
+        base = self._cfg()
+        cfg = dataclasses.replace(
+            base,
+            communication=dataclasses.replace(
+                base.communication, protocol_type="lossy_sim", drop_prob=0.3
+            ),
+        )
+        sim = BCGSimulation(config=cfg)
+        assert sim._vote_shared_core is False
+
+    def test_game_results_identical_shared_vs_disabled(self):
+        """The prompt restructuring must not change game OUTCOMES under
+        the fake engine (it parses the same values either way)."""
+        sim_a = BCGSimulation(config=self._cfg())
+        stats_a = sim_a.run()
+        sim_b = BCGSimulation(config=self._cfg())
+        sim_b._vote_shared_core = False
+        stats_b = sim_b.run()
+        assert stats_a["total_rounds"] == stats_b["total_rounds"]
+        assert stats_a["consensus_reached"] == stats_b["consensus_reached"]
+        assert stats_a["consensus_value"] == stats_b["consensus_value"]
+
+
+class TestEngineSharedCore:
+    SCHEMA = {
+        "type": "object",
+        "properties": {
+            "decision": {"type": "string", "enum": ["stop", "continue"]}
+        },
+        "required": ["decision"],
+        "additionalProperties": False,
+    }
+
+    def _engine(self, **kw):
+        from bcg_tpu.engine.jax_engine import JaxEngine
+
+        cfg = EngineConfig(
+            model_name="bcg-tpu/tiny-test", backend="jax", max_model_len=1024,
+            **kw,
+        )
+        return JaxEngine(cfg)
+
+    def test_three_part_greedy_matches_joined(self):
+        """(system, (core, tail), schema) must produce the same greedy
+        output as (system, core+tail, schema) — the cached-core path is a
+        pure optimization."""
+        eng = self._engine()
+        system = "You are an honest agent voting. " + "Rules. " * 30
+        core = "=== PROPOSALS ===\n  agent_0: 5\n  agent_1: 5\n" * 4
+        tails = [f"\n\nYou are agent_{i}. Decide now." for i in range(3)]
+        split_rows = [(system, (core, t), self.SCHEMA) for t in tails]
+        joined_rows = [(system, core + t, self.SCHEMA) for t in tails]
+        out_split = eng.batch_generate_json(split_rows, temperature=0.0, max_tokens=48)
+        eng2 = self._engine()
+        out_joined = eng2.batch_generate_json(joined_rows, temperature=0.0, max_tokens=48)
+        assert out_split == out_joined
+        assert all(r.get("decision") in ("stop", "continue") for r in out_split)
+        # One core entry, one system entry in the cache.
+        composite_keys = [k for k, _b in eng._prefix_cache if "\x1e" in k]
+        assert len(composite_keys) == 1
+
+    def test_core_entry_reused_across_calls(self):
+        eng = self._engine()
+        system = "System prompt. " + "Pad. " * 30
+        core = "Shared block. " * 40
+        rows = [(system, (core, f"\n\nAgent {i}."), self.SCHEMA) for i in range(2)]
+        eng.batch_generate_json(rows, temperature=0.0, max_tokens=48)
+        n_entries = len(eng._prefix_cache)
+        eng.batch_generate_json(rows, temperature=0.0, max_tokens=48)
+        assert len(eng._prefix_cache) == n_entries  # no re-prefill growth
+
+    def test_mixed_rows_core_and_plain(self):
+        eng = self._engine()
+        system = "System prompt. " + "Pad. " * 30
+        core = "Shared block. " * 40
+        rows = [
+            (system, (core, "\n\nAgent 0."), self.SCHEMA),
+            (system, "A plain user prompt with no core.", self.SCHEMA),
+        ]
+        out = eng.batch_generate_json(rows, temperature=0.0, max_tokens=48)
+        assert all("decision" in r for r in out)
+
+    def test_full_game_on_jax_engine_with_shared_core(self):
+        """End-to-end: a short game through the real engine exercises the
+        two-level vote path (orchestrator gates it on)."""
+        base = BCGConfig()
+        cfg = dataclasses.replace(
+            base,
+            game=dataclasses.replace(
+                base.game, num_honest=2, num_byzantine=1, max_rounds=2, seed=1
+            ),
+            engine=dataclasses.replace(
+                base.engine, model_name="bcg-tpu/tiny-test", backend="jax",
+                max_model_len=1024,
+            ),
+            llm=dataclasses.replace(
+                base.llm, max_tokens_decide=80, max_tokens_vote=40
+            ),
+            metrics=dataclasses.replace(base.metrics, save_results=False),
+        )
+        sim = BCGSimulation(config=cfg)
+        try:
+            stats = sim.run()
+        finally:
+            sim.engine.shutdown()
+            sim.close()
+        assert stats["total_rounds"] >= 1
+        assert sim.engine.failed_rows == 0
