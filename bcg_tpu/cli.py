@@ -60,6 +60,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Forced-chain fast-forward decoding (skeleton tokens ride the sampled token's weight pass)")
     p.add_argument("--compact-json", action="store_true",
                    help="Compact-JSON generation grammar (no inter-token whitespace)")
+    p.add_argument("--shared-core-votes", action="store_true",
+                   help="Serve the vote phase's shared proposals/history block from a "
+                        "cached KV prefix (restructures vote prompts; opt-in because "
+                        "the text diverges from the reference's format)")
     p.add_argument("--fault-rate", type=float, default=None,
                    help="Corrupt this fraction of LLM responses (resilience experiments)")
     p.add_argument("--fault-seed", type=int, default=None,
@@ -147,6 +151,9 @@ def config_from_args(args) -> BCGConfig:
         communication = dataclasses.replace(communication, delay_prob=args.delay_prob)
     if args.max_delay is not None:
         communication = dataclasses.replace(communication, max_delay_rounds=args.max_delay)
+    agent = base.agent
+    if args.shared_core_votes:
+        agent = dataclasses.replace(agent, shared_core_votes=True)
     metrics = base.metrics
     if args.results_dir:
         metrics = dataclasses.replace(metrics, results_dir=args.results_dir)
@@ -162,6 +169,7 @@ def config_from_args(args) -> BCGConfig:
         network=network,
         communication=communication,
         engine=engine,
+        agent=agent,
         metrics=metrics,
         verbose=args.verbose,
     )

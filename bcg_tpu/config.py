@@ -140,6 +140,17 @@ class AgentConfig:
 
     use_structured_output: bool = True
     use_batched_inference: bool = True
+    # Vote-phase shared-core prompt caching: restructure vote prompts so
+    # the (identical-per-role) proposals+history block is served from a
+    # cached KV prefix and only a short per-agent tail prefills.  The
+    # restructured prompt moves agent identity/strategy into a tail after
+    # the history and drops the per-agent "(you)" marker, so the
+    # LLM-visible text diverges from the reference's vote prompt format
+    # (bcg_agents.py:475-571).  Opt-in until a real-model A/B shows the
+    # distributions match (advisor round-2); requires fully_connected +
+    # a2a_sim (identical inboxes) to be sound, which the orchestrator
+    # additionally enforces.
+    shared_core_votes: bool = False
 
 
 @dataclass(frozen=True)

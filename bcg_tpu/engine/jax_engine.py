@@ -226,6 +226,21 @@ class JaxEngine(InferenceEngine):
                 "than bfloat16",
                 stacklevel=2,
             )
+        # Decode-cache length alignment.  The Pallas decode kernels
+        # stream the cache in BLOCK_S-sized S blocks and jnp.pad a
+        # misaligned cache — a full copy of every k/v/scale array per
+        # layer per step, measured as int8 KV losing ~4x to bf16
+        # (BENCH_NOTES rounds 1-2).  Allocating the cache pre-aligned
+        # makes that pad a no-op; the extra masked slots cost only their
+        # streaming bandwidth (<= BLOCK_S-1 slots).
+        if self.decode_attention_impl == "pallas":
+            from bcg_tpu.ops.decode_attention import BLOCK_S
+
+            # Any Pallas decode path pads (bf16 included, via explicit
+            # attention_impl="pallas") — align for all of them.
+            self._kv_align = BLOCK_S
+        else:
+            self._kv_align = 1
         self.max_model_len = config.max_model_len
         # Forced-chain fast-forward (guided/processor.py FF_CHUNK): each
         # decode step carries the sampled token plus its DFA-forced
@@ -333,6 +348,12 @@ class JaxEngine(InferenceEngine):
         self.decode_seconds = 0.0
         self.decode_kv_bytes = 0
         self.decode_weight_passes = 0
+        # Calls where prefix caching was configured but the batch fell
+        # back to full-prompt prefill (prefix unfittable/unbucketable).
+        # Silent disengagement once hid a disabled cache for a whole
+        # round (VERDICT round-2 weak #3) — counted and warned-once now.
+        self.prefix_fallbacks = 0
+        self._prefix_fallback_warned = False
         # Pad the token-byte table to the MODEL vocab (embedding tables are
         # padded past the tokenizer vocab, e.g. Qwen3 151669 -> 151936);
         # padding entries are b'' = forbidden, so logits and masks agree.
@@ -474,7 +495,15 @@ class JaxEngine(InferenceEngine):
         memo outgrows ``cap``."""
         if len(self._prefix_lens_memo) <= cap:
             return
-        live = {p for p, _b in self._prefix_cache}
+        # Composite core keys are "prefix\x1ecore" strings: a system
+        # prefix whose only surviving entries are composite is still hot
+        # (every _get_core_entry call re-reads its length), so its prefix
+        # component must count as live too.
+        live = set()
+        for p, _b in self._prefix_cache:
+            live.add(p)
+            if "\x1e" in p:
+                live.add(p.split("\x1e", 1)[0])
         self._prefix_lens_memo = {
             p: n for p, n in self._prefix_lens_memo.items() if p in live
         }
@@ -530,6 +559,10 @@ class JaxEngine(InferenceEngine):
             self.params, tokens=jnp.asarray(tokens), valid=jnp.asarray(valid),
             cache=cache,
         )
+        # Entry prefills run inside _decode_batch's t0->t1 window, so
+        # their (padded) positions must count toward prefill_tokens or
+        # miss-heavy windows understate MFU (advisor round-2).
+        self.prefill_tokens += Pb
         entry = {"kv": kv, "valid": valid[0], "len": len(toks), "bucket": Pb}
         # Size-aware LRU.  System prompts embed the agent id ("You are
         # agent_3 ..."), so a 10-agent run holds ~20 DISTINCT prefixes
@@ -668,10 +701,19 @@ class JaxEngine(InferenceEngine):
         core_toks = self.tokenizer.encode(core)
         if not core_toks:
             return None
-        # Level 1: the system prefix at its own natural rung.
+        Cb = next(
+            (b for b in _SUFFIX_BUCKETS if b >= len(core_toks)),
+            len(core_toks),
+        )
+        # Level 1: the system prefix at its own natural rung — bounded so
+        # the combined entry (P1b + Cb) still leaves suffix room below.
         p1_len = self._prefix_len(prefix)
+        p1_limit = limit - 64 - Cb
         P1_rung = next(
-            (b for b in _PREFIX_BUCKETS if b >= p1_len and b <= limit), None
+            (b for b in _PREFIX_BUCKETS if b >= p1_len and b <= p1_limit),
+            # Ladder overshoot with a prefix that itself fits: clamp to
+            # the limit (same rationale as _prepare_prefixed_batch).
+            p1_limit if 0 < p1_len <= p1_limit else None,
         )
         if P1_rung is None or p1_len == 0:
             return None
@@ -679,10 +721,6 @@ class JaxEngine(InferenceEngine):
         if e1 is None:
             return None
         P1b = e1["bucket"]
-        Cb = next(
-            (b for b in _SUFFIX_BUCKETS if b >= len(core_toks)),
-            len(core_toks),
-        )
         Pb = P1b + Cb
         if Pb > limit - 64:
             return None
@@ -702,6 +740,9 @@ class JaxEngine(InferenceEngine):
             cache=cache, prefix_valid=jnp.asarray(pv),
             prefix_lens=jnp.asarray([e1["len"]], np.int32),
         )
+        # Counted for the same reason as in _get_prefix_entry: this
+        # prefill happens inside the caller's prefill timing window.
+        self.prefill_tokens += Cb
         entry = {
             "kv": kv,
             "valid": np.concatenate([pv[0], cvalid[0]]),
@@ -787,10 +828,18 @@ class JaxEngine(InferenceEngine):
                 return None
             P_rung = next(
                 (b for b in _PREFIX_BUCKETS if b >= max_len and b <= limit),
-                None,
+                # The smallest covering rung overshoots the limit even
+                # though the prefix itself fits (checked above): clamp to
+                # limit - 64 instead of silently abandoning the prefix
+                # cache.  The 64-token slack keeps the limits_s guard
+                # below satisfiable (P == limit would fail it AFTER
+                # prefilling a dead limit-sized entry); max_len <=
+                # limit - 64 is guaranteed above, so the prefix fits.
+                # An off-ladder bucket costs one extra compile keyed by
+                # the (phase-stable) budget — re-prefilling every system
+                # prompt on every call costs far more.
+                limit - 64,
             )
-            if P_rung is None:
-                return None
         entries: Dict[Tuple[str, str], Dict[str, Any]] = {}
         # _get_*_entry registers each resolved key in _prefix_active
         # (protecting the batch's working set from its own evictions),
@@ -829,6 +878,8 @@ class JaxEngine(InferenceEngine):
             [uniq.index((p, c)) for p, c, _ in rows], dtype=np.int32
         )
         tail = Ls + (decode_slots if decode_slots is not None else max_new + 1)
+        # Align the total cache length (see _kv_align).
+        tail += (-(P + tail)) % self._kv_align
 
         # One jitted call assembles the whole batch cache.  Done eagerly
         # this was ~6 ops x num_layers separate device executions per LLM
@@ -843,7 +894,7 @@ class JaxEngine(InferenceEngine):
             e = entries[(p, c)]
             prefix_valid[i, : e["bucket"]] = e["valid"]
             prefix_lens[i] = e["len"]
-        return tokens, valid, Ls, cache, prefix_valid, prefix_lens, P
+        return tokens, valid, Ls, cache, prefix_valid, prefix_lens, P, P + tail
 
     # ------------------------------------------------------------ decode loop
 
@@ -1233,14 +1284,26 @@ class JaxEngine(InferenceEngine):
         prepped = None
         if self.prefix_caching and self._prefix_safe and all(p for p, _, _ in parts):
             prepped = self._prepare_prefixed_batch(parts, budgets, decode_slots)
+            if prepped is None:
+                self.prefix_fallbacks += 1
+                if not self._prefix_fallback_warned:
+                    import warnings
+
+                    warnings.warn(
+                        "prefix caching disengaged for this batch (prefix "
+                        "too long for the prompt window or unbucketable) — "
+                        "falling back to full-prompt prefill; further "
+                        "fallbacks are counted in engine.prefix_fallbacks",
+                        stacklevel=2,
+                    )
+                    self._prefix_fallback_warned = True
         if prepped is not None:
-            tokens, valid, Ls, cache, prefix_valid, prefix_lens, P = prepped
+            tokens, valid, Ls, cache, prefix_valid, prefix_lens, P, S = prepped
             first_logits, cache = self._prefill_possibly_chunked(
                 tokens, valid, Ls, cache,
                 prefix_valid=prefix_valid, prefix_lens=prefix_lens,
             )
             L = P + Ls
-            S = L + decode_slots
             valid_mask = np.zeros((B, S), dtype=bool)
             valid_mask[:, :P] = prefix_valid
             valid_mask[:, P:L] = valid
@@ -1248,14 +1311,15 @@ class JaxEngine(InferenceEngine):
         else:
             full_prompts = [p + c + t for p, c, t in parts]
             tokens, valid, L = self._prepare_batch(full_prompts, budgets)
+            S = L + decode_slots
+            S += (-S) % self._kv_align  # see _kv_align
             cache = init_kv_cache(
-                self.spec, B, L + decode_slots, quantized=self.kv_quantized,
+                self.spec, B, S, quantized=self.kv_quantized,
                 stacked=self.scan_layers,
             )
             first_logits, cache = self._prefill_possibly_chunked(
                 tokens, valid, L, cache
             )
-            S = L + decode_slots
             valid_mask = np.zeros((B, S), dtype=bool)
             valid_mask[:, :L] = valid
             prompt_lens = valid.sum(axis=1).astype(np.int32)
@@ -1311,7 +1375,9 @@ class JaxEngine(InferenceEngine):
             print(
                 f"[engine] decode B={B} L={L} max_new={max_new} "
                 f"steps={int(steps)} "
-                f"prefill={t1 - t0:.2f}s decode={t2 - t1:.2f}s",
+                f"prefill={t1 - t0:.2f}s decode={t2 - t1:.2f}s "
+                f"prefix={'hit' if prepped is not None else 'miss'} "
+                f"prefix_fallbacks={self.prefix_fallbacks}",
                 flush=True,
             )
         texts = []

@@ -362,8 +362,10 @@ def _run_layers(
     (each layer unrolled into the HLO — best when the program already
     compiles), or ONE ``lax.scan`` over stacked params + stacked cache
     (program size O(1) in depth — the 8B-unblocking path; see
-    ``stack_layer_params``).  The scanned cache rides scan's xs/ys, so
-    new entries stack back into the same [Lyr, ...] layout."""
+    ``stack_layer_params``).  The scanned cache rides the scan CARRY
+    (dynamic_index/dynamic_update per layer) — riding xs/ys would
+    materialize a second full cache, which OOMs at 8B — and keeps the
+    same [Lyr, ...] layout."""
     layers = params["layers"]
     if isinstance(layers, dict):
         # The cache rides the scan CARRY, not xs/ys: ys would be a second

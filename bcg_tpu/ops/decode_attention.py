@@ -33,6 +33,13 @@ from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
 
+# S-axis block size both kernels stream by.  Callers that ALLOCATE the
+# cache should round its length up to a multiple of this: `_pad_s` on a
+# misaligned cache is a jnp.pad — a full copy of every k/v/scale array
+# PER LAYER PER DECODE STEP, which is how the int8 cache measured ~4x
+# slower than bf16 in round 1-2 (the bf16 einsum path never pads).
+BLOCK_S = 512
+
 
 def _decode_kernel(
     q_ref, k_ref, v_ref, ks_ref, vs_ref, mask_ref, o_ref,
@@ -105,7 +112,7 @@ def _pad_s(x, block_s, axis=1, value=0):
 def decode_attention(
     q, k, v, mask, scale,
     k_scale=None, v_scale=None,
-    block_s: int = 512,
+    block_s: int = BLOCK_S,
     interpret: bool = False,
 ):
     """q [B, H, Dh], mask [B, S] -> [B, H, Dh].
@@ -172,7 +179,7 @@ def decode_attention(
 def chunk_decode_attention(
     q, k, v, mask, scale,
     k_scale=None, v_scale=None,
-    block_s: int = 512,
+    block_s: int = BLOCK_S,
     interpret: bool = False,
 ):
     """Fast-forward chunk decode over the (possibly int8) cache.

@@ -147,8 +147,12 @@ class BCGSimulation:
         # qualifies: it requires a2a_sim and delivers the full mask).
         # Ring/grid/custom topologies or a lossy channel give agents
         # DIFFERENT inboxes, so each keeps its per-agent prompt.
+        # Opt-in (AgentConfig.shared_core_votes): the restructured prompt
+        # diverges from the reference's vote format, so the default path
+        # keeps reference-shaped prompts (advisor round-2 finding).
         self._vote_shared_core = (
-            self.config.network.topology_type == "fully_connected"
+            self.config.agent.shared_core_votes
+            and self.config.network.topology_type == "fully_connected"
             and self.config.communication.protocol_type == "a2a_sim"
         )
 

@@ -164,6 +164,47 @@ class TestEnginePrefixCaching:
         assert len(engine.tokenizer.encode(texts[1])) <= 30
         engine.shutdown()
 
+    def test_cache_length_alignment(self):
+        """With a kv alignment set (the int8-Pallas configuration), the
+        allocated decode cache length rounds up to the alignment so the
+        decode kernels never jnp.pad (= copy) the cache per step — and
+        the extra masked slots leave greedy output unchanged."""
+        mk = lambda: JaxEngine(EngineConfig(
+            backend="jax", model_name="bcg-tpu/tiny-test", max_model_len=1024,
+        ))
+        engine = mk()
+        engine._kv_align = 64
+        prepped = engine._prepare_prefixed_batch(
+            [("You are the honest system prompt. ", "", "vote now")], [24], 25
+        )
+        assert prepped is not None
+        assert prepped[-1] % 64 == 0  # total cache length S
+        rows = [("You are the honest system prompt. ", "vote now", SCHEMA)]
+        out_aligned = engine.batch_generate_json(rows, temperature=0.0, max_tokens=24)
+        plain = mk()
+        out_plain = plain.batch_generate_json(rows, temperature=0.0, max_tokens=24)
+        assert out_aligned == out_plain
+        engine.shutdown()
+        plain.shutdown()
+
+    def test_prefix_fallback_counted_and_warned(self):
+        """A prefix the prompt window cannot hold disengages prefix
+        caching LOUDLY: warn-once + a prefix_fallbacks counter (silent
+        disengagement hid a disabled cache in round 2)."""
+        import pytest
+
+        engine = JaxEngine(EngineConfig(
+            backend="jax", model_name="bcg-tpu/tiny-test", max_model_len=128,
+        ))
+        rows = [("system prompt far too long for the window " * 3,
+                 "vote", SCHEMA)]
+        with pytest.warns(UserWarning, match="prefix caching disengaged"):
+            out = engine.batch_generate_json(rows, temperature=0.0, max_tokens=24)
+        assert engine.prefix_fallbacks == 1
+        assert len(engine._prefix_cache) == 0
+        assert out[0].get("decision") in ("stop", "continue")
+        engine.shutdown()
+
     def test_matches_uncached_engine_greedy(self):
         cfg = EngineConfig(backend="jax", model_name="bcg-tpu/tiny-test",
                            max_model_len=2048)

@@ -116,8 +116,24 @@ class TestOrchestratorGating:
             ),
             network=dataclasses.replace(base.network, **net),
             engine=dataclasses.replace(base.engine, backend="fake"),
+            # Shared-core is opt-in (prompt text diverges from the
+            # reference vote format); these tests exercise the opted-in
+            # topology/protocol gating.
+            agent=dataclasses.replace(base.agent, shared_core_votes=True),
             metrics=dataclasses.replace(base.metrics, save_results=False),
         )
+
+    def test_default_config_keeps_reference_prompts(self):
+        """Without the opt-in flag, vote prompts stay reference-shaped
+        even on the eligible fully_connected + a2a_sim default config."""
+        cfg = self._cfg()
+        cfg = dataclasses.replace(
+            cfg, agent=dataclasses.replace(cfg.agent, shared_core_votes=False)
+        )
+        assert BCGSimulation(config=cfg)._vote_shared_core is False
+        from bcg_tpu.config import AgentConfig
+
+        assert AgentConfig().shared_core_votes is False
 
     def test_fully_connected_enables_shared_core(self):
         sim = BCGSimulation(config=self._cfg())
@@ -223,6 +239,7 @@ class TestEngineSharedCore:
                 base.engine, model_name="bcg-tpu/tiny-test", backend="jax",
                 max_model_len=1024,
             ),
+            agent=dataclasses.replace(base.agent, shared_core_votes=True),
             llm=dataclasses.replace(
                 base.llm, max_tokens_decide=80, max_tokens_vote=40
             ),
