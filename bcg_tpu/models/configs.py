@@ -136,6 +136,25 @@ MODEL_SPECS: Dict[str, ModelSpec] = {
         num_heads=16, num_kv_heads=8, head_dim=128,
         intermediate_size=6144, qk_norm=True, max_position=8192,
     ),
+    # Family-fidelity fixtures (models/hf_fixture.py): Llama-3-shaped
+    # byte-BPE vocab (<|eot_id|> specials, header-id template) and a
+    # true-SentencePiece Mistral-shaped one ([INST] template, Metaspace
+    # pieces) — so template selection and tokenizer detection are proven
+    # against each family the reference special-cases
+    # (vllm_agent.py:199-292), not just ChatML.
+    "bcg-hf/tiny-llama3": ModelSpec(
+        name="bcg-hf/tiny-llama3",
+        vocab_size=512, hidden_size=64, num_layers=2,
+        num_heads=4, num_kv_heads=2, head_dim=16,
+        intermediate_size=128, rope_theta=500_000.0,
+        rms_eps=1e-5, max_position=2048,
+    ),
+    "bcg-hf/tiny-mistral": ModelSpec(
+        name="bcg-hf/tiny-mistral",
+        vocab_size=512, hidden_size=64, num_layers=2,
+        num_heads=4, num_kv_heads=2, head_dim=16,
+        intermediate_size=128, rms_eps=1e-5, max_position=2048,
+    ),
     # Hermetic tiny model: byte tokenizer vocabulary, runs on CPU in ms.
     "bcg-tpu/tiny-test": ModelSpec(
         name="bcg-tpu/tiny-test",

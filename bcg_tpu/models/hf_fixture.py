@@ -33,6 +33,31 @@ from bcg_tpu.models.configs import ModelSpec, spec_for_model
 # ChatML specials, matching the chat_template fallback family used for
 # bcg-hf/* model names (engine/chat_template.py).
 CHATML_SPECIALS = ["<|endoftext|>", "<|im_start|>", "<|im_end|>"]
+# Llama-3 specials (the header-id template family, reference
+# vllm_agent.py:236-252): fixture names containing "llama3" build a
+# byte-BPE vocab with these, so the Llama-3 template meets a
+# Llama-3-shaped vocabulary (VERDICT round-2 missing #3).
+LLAMA3_SPECIALS = [
+    "<|begin_of_text|>", "<|end_of_text|>",
+    "<|start_header_id|>", "<|end_header_id|>", "<|eot_id|>",
+]
+# True-SentencePiece specials (Llama-2/Mistral [INST] family,
+# vllm_agent.py:254-269): fixture names containing "mistral" build a
+# Metaspace-pretokenized vocab — the engine must DETECT it as
+# non-byte-level and route token bytes through the metaspace branch.
+SP_SPECIALS = ["<unk>", "<s>", "</s>"]
+
+
+def fixture_family(model_name: str) -> str:
+    """Tokenizer/template family for a ``bcg-hf/*`` fixture name —
+    intentionally the same name-substring dispatch the chat template
+    uses, so fixture artifacts and template selection can't disagree."""
+    m = model_name.lower()
+    if "llama3" in m or "llama-3" in m:
+        return "llama3"
+    if "mistral" in m or "llama" in m:
+        return "sentencepiece"
+    return "chatml"
 # A literal-metaspace token added as a NON-special vocab entry: the
 # round-1 ``_token_to_bytes`` heuristic (metaspace checked before the
 # byte table) silently mis-decoded exactly this shape of entry in a
@@ -68,51 +93,97 @@ def _training_corpus() -> Iterable[str]:
             yield t.format(i=i % 10, v=(i * 7) % 51)
 
 
-def build_tokenizer_files(out_dir: str, vocab_size: int) -> None:
-    """Train and save a byte-level-BPE tokenizer into ``out_dir``.
+def build_tokenizer_files(
+    out_dir: str, vocab_size: int, family: str = "chatml"
+) -> None:
+    """Train and save a tokenizer artifact set into ``out_dir``.
 
-    ``vocab_size`` counts the FULL tokenizer vocabulary: trained
-    byte-level entries + ChatML specials + the metaspace probe token.
+    ``vocab_size`` counts the FULL tokenizer vocabulary (trained entries
+    + specials + the metaspace probe token).  ``family``:
+
+    * ``chatml`` — byte-level BPE, ChatML specials (Qwen-style);
+    * ``llama3`` — byte-level BPE, Llama-3 header-id specials, eos
+      ``<|eot_id|>``;
+    * ``sentencepiece`` — Metaspace-pretokenized BPE (true-SentencePiece
+      shape: ``▁``-pieces, NOT byte-level), ``<s>``/``</s>`` specials.
     """
     from tokenizers import Tokenizer, decoders, models, pre_tokenizers, trainers
 
-    n_added = len(CHATML_SPECIALS) + 1
-    tok = Tokenizer(models.BPE(unk_token=None))
-    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
-    tok.decoder = decoders.ByteLevel()
-    trainer = trainers.BpeTrainer(
-        vocab_size=vocab_size - n_added,
-        special_tokens=[],
-        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
-        show_progress=False,
-    )
-    tok.train_from_iterator(_training_corpus(), trainer)
-    tok.add_special_tokens(CHATML_SPECIALS)
-    tok.add_tokens([METASPACE_PROBE_TOKEN])
     os.makedirs(out_dir, exist_ok=True)
-    tok.save(os.path.join(out_dir, "tokenizer.json"))
-    with open(os.path.join(out_dir, "tokenizer_config.json"), "w") as f:
-        json.dump(
-            {
+    if family == "sentencepiece":
+        tok = Tokenizer(models.BPE(unk_token="<unk>"))
+        tok.pre_tokenizer = pre_tokenizers.Metaspace()
+        tok.decoder = decoders.Metaspace()
+        trainer = trainers.BpeTrainer(
+            vocab_size=vocab_size - len(SP_SPECIALS),
+            special_tokens=SP_SPECIALS,
+            show_progress=False,
+        )
+        tok.train_from_iterator(_training_corpus(), trainer)
+        tok.save(os.path.join(out_dir, "tokenizer.json"))
+        cfg = {
+            "tokenizer_class": "PreTrainedTokenizerFast",
+            "eos_token": "</s>", "bos_token": "<s>",
+            "unk_token": "<unk>", "pad_token": "</s>",
+            "model_max_length": 8192,
+        }
+        specials_map = {"eos_token": "</s>", "bos_token": "<s>",
+                        "unk_token": "<unk>", "pad_token": "</s>"}
+    else:
+        specials = LLAMA3_SPECIALS if family == "llama3" else CHATML_SPECIALS
+        n_added = len(specials) + 1
+        tok = Tokenizer(models.BPE(unk_token=None))
+        tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+        tok.decoder = decoders.ByteLevel()
+        trainer = trainers.BpeTrainer(
+            vocab_size=vocab_size - n_added,
+            special_tokens=[],
+            initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+            show_progress=False,
+        )
+        tok.train_from_iterator(_training_corpus(), trainer)
+        tok.add_special_tokens(specials)
+        tok.add_tokens([METASPACE_PROBE_TOKEN])
+        tok.save(os.path.join(out_dir, "tokenizer.json"))
+        if family == "llama3":
+            cfg = {
+                "tokenizer_class": "PreTrainedTokenizerFast",
+                "eos_token": "<|eot_id|>",
+                "pad_token": "<|end_of_text|>",
+                "bos_token": "<|begin_of_text|>",
+                "model_max_length": 8192,
+            }
+            specials_map = {"eos_token": "<|eot_id|>",
+                            "pad_token": "<|end_of_text|>",
+                            "bos_token": "<|begin_of_text|>"}
+        else:
+            cfg = {
                 "tokenizer_class": "PreTrainedTokenizerFast",
                 "eos_token": "<|im_end|>",
                 "pad_token": "<|endoftext|>",
                 "bos_token": None,
                 "additional_special_tokens": ["<|im_start|>"],
                 "model_max_length": 8192,
-            },
-            f,
-            indent=2,
-        )
+            }
+            specials_map = {"eos_token": "<|im_end|>",
+                            "pad_token": "<|endoftext|>"}
+    with open(os.path.join(out_dir, "tokenizer_config.json"), "w") as f:
+        json.dump(cfg, f, indent=2)
     with open(os.path.join(out_dir, "special_tokens_map.json"), "w") as f:
-        json.dump({"eos_token": "<|im_end|>", "pad_token": "<|endoftext|>"}, f)
+        json.dump(specials_map, f)
 
 
 def _hf_config(spec: ModelSpec) -> Dict:
-    """HF ``config.json`` payload for the Qwen3-style architecture."""
+    """HF ``config.json`` payload (architecture family from the name)."""
+    family = fixture_family(spec.name)
+    arch, mtype = {
+        "llama3": (["LlamaForCausalLM"], "llama"),
+        "sentencepiece": (["MistralForCausalLM"], "mistral"),
+        "chatml": (["Qwen3ForCausalLM"], "qwen3"),
+    }[family]
     return {
-        "architectures": ["Qwen3ForCausalLM"],
-        "model_type": "qwen3",
+        "architectures": arch,
+        "model_type": mtype,
         "vocab_size": spec.vocab_size,
         "hidden_size": spec.hidden_size,
         "num_hidden_layers": spec.num_layers,
@@ -197,7 +268,10 @@ def build_checkpoint(
 
     # Tokenizer vocab leaves headroom below the model vocab, like real
     # families (Qwen3: tokenizer 151669 < embedding 151936).
-    build_tokenizer_files(out_dir, vocab_size=spec.vocab_size - 64)
+    build_tokenizer_files(
+        out_dir, vocab_size=spec.vocab_size - 64,
+        family=fixture_family(model_name),
+    )
     with open(os.path.join(out_dir, "config.json"), "w") as f:
         json.dump(_hf_config(spec), f, indent=2)
 

@@ -189,11 +189,10 @@ def test_load_checkpoint_params_from_fixture(hf_env):
 # ------------------------------------------------------------ end to end
 
 
-@pytest.mark.slow
-def test_full_game_through_hf_checkpoint(hf_env):
-    """THE hermetic real-model proof: a complete game through the real
-    JaxEngine — checkpoint discovery, safetensors loading, HFTokenizer
-    byte table, guided token DFA, ChatML template — on CPU."""
+def _run_short_game(model_name, n_honest=3, n_byz=1, max_rounds=2):
+    """A complete game through the real JaxEngine on CPU: checkpoint
+    discovery, safetensors loading, HFTokenizer byte table, guided token
+    DFA, family chat template."""
     import dataclasses
 
     from bcg_tpu.config import BCGConfig
@@ -203,10 +202,12 @@ def test_full_game_through_hf_checkpoint(hf_env):
     cfg = dataclasses.replace(
         base,
         game=dataclasses.replace(
-            base.game, num_honest=3, num_byzantine=1, max_rounds=2, seed=0
+            base.game, num_honest=n_honest, num_byzantine=n_byz,
+            max_rounds=max_rounds, seed=0,
         ),
         engine=dataclasses.replace(
-            base.engine, model_name=TINY, backend="jax", max_model_len=2048
+            base.engine, model_name=model_name, backend="jax",
+            max_model_len=2048,
         ),
         llm=dataclasses.replace(
             base.llm, max_tokens_decide=80, max_tokens_vote=40
@@ -224,3 +225,94 @@ def test_full_game_through_hf_checkpoint(hf_env):
     # The guided DFA guarantees parseable JSON: with a real tokenizer in
     # the loop, generation failures would show up as failed rows.
     assert sim.engine.failed_rows == 0
+    return stats
+
+
+@pytest.mark.slow
+def test_full_game_through_hf_checkpoint(hf_env):
+    """THE hermetic real-model proof (ChatML/byte-BPE family)."""
+    _run_short_game(TINY)
+
+
+# ------------------------------------------- family fidelity (VERDICT #7)
+
+LLAMA3 = "bcg-hf/tiny-llama3"
+MISTRAL = "bcg-hf/tiny-mistral"
+
+
+@pytest.fixture(scope="session")
+def llama3_checkpoint(tmp_path_factory):
+    root = tmp_path_factory.mktemp("hf_llama3")
+    return build_checkpoint(LLAMA3, out_dir=str(root / "bcg-hf--tiny-llama3"))
+
+
+@pytest.fixture(scope="session")
+def mistral_checkpoint(tmp_path_factory):
+    root = tmp_path_factory.mktemp("hf_mistral")
+    return build_checkpoint(MISTRAL, out_dir=str(root / "bcg-hf--tiny-mistral"))
+
+
+class TestLlama3Family:
+    def test_detection_template_and_seam(self, llama3_checkpoint):
+        from bcg_tpu.engine.chat_template import (
+            format_chat_parts, prefix_split_safe,
+        )
+        from bcg_tpu.models.hf_fixture import LLAMA3_SPECIALS
+
+        t = HFTokenizer(llama3_checkpoint)
+        assert t._byte_level is True
+        assert t.eos_id == t.tk.convert_tokens_to_ids("<|eot_id|>")
+        tb = t.token_bytes()
+        for s in LLAMA3_SPECIALS:
+            tid = t.tk.convert_tokens_to_ids(s)
+            assert t.encode(s) == [tid], s
+            assert tb[tid] == b""  # specials unreachable in guided decode
+        prefix, suffix = format_chat_parts(
+            LLAMA3, "You are agent_1.", "Pick a value."
+        )
+        assert "<|start_header_id|>system<|end_header_id|>" in prefix
+        assert prefix.endswith("<|eot_id|>")
+        assert suffix.endswith("<|start_header_id|>assistant<|end_header_id|>\n\n")
+        # The Llama-3 seam ends at a special-token boundary: prefix
+        # caching is sound on this family.
+        assert prefix_split_safe(LLAMA3)
+        assert t.encode(prefix) + t.encode(suffix) == t.encode(prefix + suffix)
+        text = '{"decision": "stop"}'
+        assert b"".join(tb[i] for i in t.encode(text)) == text.encode()
+
+    @pytest.mark.slow
+    def test_short_engine_game(self, llama3_checkpoint, monkeypatch):
+        monkeypatch.setenv(
+            "BCG_TPU_CHECKPOINT_DIR", os.path.dirname(llama3_checkpoint)
+        )
+        _run_short_game(LLAMA3, n_honest=2, n_byz=1, max_rounds=1)
+
+
+class TestMistralSPFamily:
+    def test_detection_and_template(self, mistral_checkpoint):
+        from bcg_tpu.engine.chat_template import (
+            format_chat_parts, prefix_split_safe,
+        )
+
+        t = HFTokenizer(mistral_checkpoint)
+        # True SentencePiece shape: Metaspace pieces, NOT byte-level.
+        assert t._byte_level is False
+        vocab = t.tk.get_vocab()
+        sp_pieces = [tok for tok in vocab if tok.startswith("▁") and len(tok) > 1]
+        assert sp_pieces, "SP vocab must contain metaspace pieces"
+        tb = t.token_bytes()
+        piece = sp_pieces[0]
+        assert tb[vocab[piece]] == piece.replace("▁", " ").encode()
+        assert t.eos_id == t.tk.convert_tokens_to_ids("</s>")
+        prefix, suffix = format_chat_parts(MISTRAL, "Sys rules.", "Decide.")
+        assert prefix.startswith("<s>[INST] <<SYS>>")
+        assert suffix.endswith("[/INST]")
+        # Bare-text seam: prefix caching must stay OFF for this family.
+        assert not prefix_split_safe(MISTRAL)
+
+    @pytest.mark.slow
+    def test_short_engine_game(self, mistral_checkpoint, monkeypatch):
+        monkeypatch.setenv(
+            "BCG_TPU_CHECKPOINT_DIR", os.path.dirname(mistral_checkpoint)
+        )
+        _run_short_game(MISTRAL, n_honest=2, n_byz=1, max_rounds=1)
