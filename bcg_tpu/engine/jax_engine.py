@@ -368,6 +368,8 @@ class JaxEngine(InferenceEngine):
         # round (VERDICT round-2 weak #3) — counted and warned-once now.
         self.prefix_fallbacks = 0
         self._prefix_fallback_warned = False
+        # Calls whose batch the hbm_utilization provisioner chunked.
+        self.provision_chunk_events = 0
         # Pad the token-byte table to the MODEL vocab (embedding tables are
         # padded past the tokenizer vocab, e.g. Qwen3 151669 -> 151936);
         # padding entries are b'' = forbidden, so logits and masks agree.
@@ -1185,10 +1187,15 @@ class JaxEngine(InferenceEngine):
         n = len(parts)
         temps = _per_row(temperature, n, float)
         budgets = _per_row(max_tokens, n, int)
-        # max_num_seqs (vLLM semantics, reference config.py:38): bound the
-        # concurrently decoded rows by chunking oversized batches.  Off by
-        # default on TPU — see EngineConfig.
+        # max_num_seqs (vLLM semantics, reference config.py:38) bounds the
+        # concurrently decoded rows by chunking oversized batches; the
+        # hbm_utilization provisioner derives a second cap from actual
+        # device memory (min of the two wins).  Off by default on TPU —
+        # see EngineConfig.
         cap = self.config.max_num_seqs
+        derived = self._provisioned_row_cap(parts, budgets)
+        if derived is not None:
+            cap = min(cap, derived) if cap else derived
         if cap and _pad_batch(n) > cap:
             step = _chunk_size(cap)
             out: List[str] = []
@@ -1387,8 +1394,9 @@ class JaxEngine(InferenceEngine):
         self.decode_weight_passes += int(steps)
         if _TIMING:
             print(
-                f"[engine] decode B={B} L={L} max_new={max_new} "
+                f"[engine] decode B={B} L={L} S={S} max_new={max_new} "
                 f"steps={int(steps)} "
+                f"prompt_max={int(prompt_lens.max())} "
                 f"prefill={t1 - t0:.2f}s decode={t2 - t1:.2f}s "
                 f"prefix={'hit' if prepped is not None else 'miss'} "
                 f"prefix_fallbacks={self.prefix_fallbacks}",
@@ -1401,6 +1409,56 @@ class JaxEngine(InferenceEngine):
             row = row[: end[0]] if end.size else row
             texts.append(self.tokenizer.decode(row.tolist()))
         return texts
+
+    def _provisioned_row_cap(self, parts, budgets: List[int]) -> Optional[int]:
+        """``hbm_utilization`` as an ACTUAL provisioner — the reference's
+        ``gpu_memory_utilization`` provisions the vLLM KV pool
+        (vllm_agent.py:129-136); round-2 VERDICT called our warn-only
+        guard "a bound in name only".  Estimates the batch's per-row
+        decode-cache bytes from the ACTUAL prompt lengths (bucketed the
+        way _decode_batch will bucket them) and caps the concurrently
+        decoded rows so cache + weights + live prefix entries fit the
+        budgeted fraction of device memory; oversized batches then chunk
+        through the max_num_seqs machinery.  Returns None when the
+        device limit is unknown (CPU tests) or the whole batch fits."""
+        if self._mem_limit is None:
+            return None
+        spec = self.spec
+        max_new = max(budgets)
+        decode_res = (
+            _ff_decode_slots(max_new) if self.fast_forward else max_new + 1
+        )
+        limit = self.max_model_len - min(budgets) - 1
+        longest = max(
+            len(self.tokenizer.encode(p + c + t)[-limit:]) for p, c, t in parts
+        )
+        L = next((b for b in _LEN_BUCKETS if b >= longest), limit)
+        S = min(L, limit) + decode_res
+        S += (-S) % self._kv_align
+        slot = spec.num_kv_heads * spec.head_dim * 2
+        slot *= 1 if self.kv_quantized else 2
+        if self.kv_quantized:
+            slot += spec.num_kv_heads * 2 * 4
+        per_row = S * slot * spec.num_layers / self._mesh_devices
+        # Reserve the full prefix-cache BUDGET (static per run), not the
+        # current fill: a volatile reserve would flip the derived cap
+        # between calls and re-chunk the same logical batch into fresh
+        # compiled shapes (tens of seconds each on a remote chip).
+        prefix_reserve = (
+            self._prefix_budget
+            if self.prefix_caching and self._prefix_safe
+            else 0
+        )
+        budget = (
+            self.config.hbm_utilization * self._mem_limit
+            - self._param_bytes / self._tp_devices
+            - prefix_reserve
+        )
+        cap = max(1, int(budget // per_row)) if per_row > 0 else None
+        if cap is None or cap >= _pad_batch(len(parts)):
+            return None
+        self.provision_chunk_events += 1
+        return cap
 
     def _check_kv_budget(self, B: int, budgets: List[int],
                          fast_forward: bool = False) -> None:
@@ -1526,6 +1584,9 @@ class JaxEngine(InferenceEngine):
         temps = _per_row(temperature, n, float)
         budgets = _per_row(max_tokens, n, int)
         cap = self.config.max_num_seqs
+        derived = self._provisioned_row_cap(parts, budgets)
+        if derived is not None:
+            cap = min(cap, derived) if cap else derived
         if cap and _pad_batch(n) > cap:
             step = _chunk_size(cap)
             out: List[str] = []

@@ -66,6 +66,59 @@ class TestMaxNumSeqs:
         engine.shutdown()
 
 
+class TestHbmProvisioner:
+    """hbm_utilization as an actual row provisioner (the reference's
+    gpu_memory_utilization provisions the vLLM KV pool)."""
+
+    def _engine(self):
+        return JaxEngine(EngineConfig(
+            backend="jax", model_name="bcg-tpu/tiny-test", max_model_len=512,
+        ))
+
+    def test_no_cap_when_limits_unknown_or_batch_fits(self):
+        engine = self._engine()
+        parts = [("sys ", "", f"user {i}") for i in range(4)]
+        # CPU: no device memory limit -> no derived cap.
+        engine._mem_limit = None
+        assert engine._provisioned_row_cap(parts, [24] * 4) is None
+        # Huge limit: batch fits -> no cap (and no chunk event).
+        engine._mem_limit = 1 << 40
+        assert engine._provisioned_row_cap(parts, [24] * 4) is None
+        assert engine.provision_chunk_events == 0
+        engine.shutdown()
+
+    def test_oversized_batch_chunks_under_tight_limit(self, monkeypatch):
+        engine = self._engine()
+        parts = [("sys ", "", f"user {i}") for i in range(4)]
+        # Tight limit: per-row cache bytes at these shapes are ~100 KB;
+        # allow roughly two rows' worth above the (tiny) weights.
+        per_row = 600 * engine.spec.num_kv_heads * engine.spec.head_dim \
+            * 4 * engine.spec.num_layers
+        engine._mem_limit = int(
+            (engine._param_bytes + 2.5 * per_row)
+            / engine.config.hbm_utilization
+        )
+        cap = engine._provisioned_row_cap(parts, [24] * 4)
+        assert cap is not None and 1 <= cap < 4
+        assert engine.provision_chunk_events == 1
+        # End to end: the oversized batch still answers every row.
+        calls = []
+        orig = engine._decode_batch
+
+        def spy(*a, **k):
+            calls.append(len(a[0]))
+            return orig(*a, **k)
+
+        monkeypatch.setattr(engine, "_decode_batch", spy)
+        prompts = [("sys ", f"user {i}", VOTE_SCHEMA) for i in range(4)]
+        out = engine.batch_generate_json(prompts, temperature=0.0, max_tokens=24)
+        assert len(out) == 4
+        assert all(o.get("decision") in ("stop", "continue") for o in out)
+        assert all(c <= cap for c in calls)
+        assert len(calls) >= 2
+        engine.shutdown()
+
+
 class TestChatTemplate:
     def test_qwen3_no_think(self):
         p = format_chat_prompt("Qwen/Qwen3-14B", "sys", "user")
