@@ -178,6 +178,23 @@ MODEL_SPECS: Dict[str, ModelSpec] = {
         num_heads=32, num_kv_heads=8, head_dim=128,
         intermediate_size=12288, qk_norm=True, max_position=8192,
     ),
+    # Qwen3-14B / 32B dims with random weights: the reference's larger
+    # presets (config.py:20-25) as hermetic multi-chip TP targets —
+    # int8 14B (~15 GB) needs tp>=2 on 16 GB chips, 32B tp>=4.  Shard
+    # layouts validated on the virtual CPU mesh (tests/test_parallel.py,
+    # __graft_entry__.dryrun_multichip).
+    "bcg-tpu/bench-14b": ModelSpec(
+        name="bcg-tpu/bench-14b",
+        vocab_size=151936, hidden_size=5120, num_layers=40,
+        num_heads=40, num_kv_heads=8, head_dim=128,
+        intermediate_size=17408, qk_norm=True, max_position=8192,
+    ),
+    "bcg-tpu/bench-32b": ModelSpec(
+        name="bcg-tpu/bench-32b",
+        vocab_size=151936, hidden_size=5120, num_layers=64,
+        num_heads=64, num_kv_heads=8, head_dim=128,
+        intermediate_size=25600, qk_norm=True, max_position=8192,
+    ),
 }
 
 

@@ -88,6 +88,26 @@ def test_real_model_specs_registered():
         assert spec.num_heads % spec.num_kv_heads == 0
 
 
+def test_param_count_size_classes():
+    """param_count drives the bench's size-class gates (kv dtype, scan):
+    it must land in the right ballpark for every preset family."""
+    billions = {
+        "bcg-tpu/bench-1b": (1, 2),
+        "bcg-tpu/bench-8b": (7, 10),
+        "bcg-tpu/bench-14b": (13, 16),
+        "bcg-tpu/bench-32b": (30, 36),
+        "Qwen/Qwen3-8B": (7, 10),
+        "meta-llama/Meta-Llama-3.1-8B-Instruct": (7, 10),
+        "mistralai/Mistral-Small-Instruct-2409": (20, 25),
+    }
+    for name, (lo, hi) in billions.items():
+        spec = spec_for_model(name)
+        count = spec.param_count
+        assert lo * 1e9 <= count <= hi * 1e9, (name, count)
+        # The per-layer matmul unit must agree with the total.
+        assert spec.num_layers * spec.matmul_params_per_layer <= count
+
+
 def test_attn_bias_models():
     """Qwen2-style projection biases: present in the pytree and actually
     applied (nonzero bias must change the logits)."""
