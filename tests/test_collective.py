@@ -253,6 +253,44 @@ class TestRealEngineIntegration:
                 raise o
         assert all("consensus_reached" in o["metrics"] for o in outs)
 
+    def test_merged_games_chunk_under_hbm_provisioner(self):
+        """G merged games under a tight device-memory limit must CHUNK
+        through the hbm_utilization provisioner instead of allocating the
+        full merged-batch KV (the round-1 G=3/G=4 single-chip OOM class).
+        Games still complete with coherent metrics."""
+        from bcg_tpu.api import run_simulation
+        from bcg_tpu.config import EngineConfig
+        from bcg_tpu.engine.jax_engine import JaxEngine
+
+        engine = JaxEngine(EngineConfig(
+            backend="jax", model_name="bcg-tpu/tiny-test", max_model_len=512,
+        ))
+        # Tight budget: roughly three rows' worth of worst-case cache
+        # above the (tiny) weights — a merged 2x3-agent batch must split.
+        per_row_worst = 900 * engine.spec.num_kv_heads * engine.spec.head_dim \
+            * 4 * engine.spec.num_layers
+        engine._mem_limit = int(
+            (engine._param_bytes + 3.2 * per_row_worst)
+            / engine.config.hbm_utilization
+        )
+
+        def make(r):
+            def go(coll):
+                return run_simulation(
+                    n_agents=3, byzantine_count=1, max_rounds=2,
+                    backend="jax", seed=r, engine=coll,
+                )
+            return go
+
+        outs = run_concurrent_simulations(engine, [make(r) for r in range(2)], 2)
+        events = engine.provision_chunk_events
+        engine.shutdown()
+        for o in outs:
+            if isinstance(o, BaseException):
+                raise o
+        assert all("consensus_reached" in o["metrics"] for o in outs)
+        assert events >= 1, "provisioner never engaged on the merged batch"
+
 
 class TestExperimentsConcurrency:
     def test_run_preset_concurrent(self):
