@@ -37,7 +37,11 @@ from bcg_tpu.engine.chat_template import (
 from bcg_tpu.engine.interface import InferenceEngine, per_row_settings as _per_row
 from bcg_tpu.engine.tokenizer import Tokenizer, tokenizer_for_model
 from bcg_tpu.guided.processor import GuidedBatch, compile_schema
-from bcg_tpu.models.configs import ModelSpec, spec_for_model
+from bcg_tpu.models.configs import (
+    LARGE_MODEL_PARAMS,
+    ModelSpec,
+    spec_for_model,
+)
 from bcg_tpu.models.transformer import (
     decode_chunk,
     decode_step,
@@ -226,7 +230,7 @@ class JaxEngine(InferenceEngine):
                 "than bfloat16",
                 stacklevel=2,
             )
-        elif self.kv_quantized and self.spec.param_count < 6_000_000_000:
+        elif self.kv_quantized and self.spec.param_count < LARGE_MODEL_PARAMS:
             import warnings
 
             # VERDICT round-2 weak #5: the losing configuration must not
@@ -255,6 +259,12 @@ class JaxEngine(InferenceEngine):
             self._kv_align = ALIGN_S
         else:
             self._kv_align = 1
+        # Bytes per (position, layer) cache slot — the unit shared by the
+        # perf accounting, the KV budget guard, and the provisioner.
+        self._kv_slot_bytes = self.spec.num_kv_heads * self.spec.head_dim * 2
+        self._kv_slot_bytes *= 1 if self.kv_quantized else 2
+        if self.kv_quantized:
+            self._kv_slot_bytes += self.spec.num_kv_heads * 2 * 4  # f32 scales
         self.max_model_len = config.max_model_len
         # Forced-chain fast-forward (guided/processor.py FF_CHUNK): each
         # decode step carries the sampled token plus its DFA-forced
@@ -1383,10 +1393,7 @@ class JaxEngine(InferenceEngine):
         # window every step (einsum and Pallas paths both read all S
         # slots, masked), plus one full weight pass per loop iteration.
         spec = self.spec
-        slot_bytes = spec.num_kv_heads * spec.head_dim * 2  # k+v
-        slot_bytes *= 1 if self.kv_quantized else 2
-        if self.kv_quantized:
-            slot_bytes += spec.num_kv_heads * 2 * 4  # f32 scales
+        slot_bytes = self._kv_slot_bytes
         self.prefill_tokens += B * (L if prepped is None else Ls)
         self.prefill_seconds += t1 - t0
         self.decode_seconds += t2 - t1
@@ -1429,10 +1436,7 @@ class JaxEngine(InferenceEngine):
             _ff_decode_slots(max_new) if self.fast_forward else max_new + 1
         )
         limit = self.max_model_len - min(budgets) - 1
-        slot = spec.num_kv_heads * spec.head_dim * 2
-        slot *= 1 if self.kv_quantized else 2
-        if self.kv_quantized:
-            slot += spec.num_kv_heads * 2 * 4
+        slot = self._kv_slot_bytes
         # Reserve the full prefix-cache BUDGET (static per run), not the
         # current fill: a volatile reserve would flip the derived cap
         # between calls and re-chunk the same logical batch into fresh
@@ -1488,9 +1492,7 @@ class JaxEngine(InferenceEngine):
         else:
             decode_res = max(budgets) + 1
         S = self.max_model_len - min(budgets) - 1 + decode_res
-        kv_bytes_per_slot = spec.num_kv_heads * spec.head_dim * 2  # k+v
-        kv_bytes_per_slot *= 1 if self.kv_quantized else 2
-        kv_total = B * S * kv_bytes_per_slot * spec.num_layers
+        kv_total = B * S * self._kv_slot_bytes * spec.num_layers
         per_device = (
             kv_total / self._mesh_devices + self._param_bytes / self._tp_devices
         )

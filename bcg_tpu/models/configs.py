@@ -14,6 +14,13 @@ from dataclasses import dataclass
 from typing import Dict, Optional
 
 
+# Parameter count at/above which single-chip serving needs the memory
+# levers (int8 KV + scan-over-layers): an 8B-class bf16 KV cache next to
+# int8 weights exceeds a 16 GB v5e.  Shared by the bench's config gates
+# and the engine's int8-KV speed warning.
+LARGE_MODEL_PARAMS = 6_000_000_000
+
+
 @dataclass(frozen=True)
 class RopeScaling:
     """Llama-3.1-style NTK-by-parts rope scaling (HF ``rope_type:

@@ -49,7 +49,7 @@ ALIGN_S = 1024
 def _pick_block(S: int, requested) -> int:
     if requested is not None:
         return requested
-    return 1024 if S % 1024 == 0 else BLOCK_S
+    return ALIGN_S if S % ALIGN_S == 0 else BLOCK_S
 
 
 def _decode_kernel(
