@@ -49,6 +49,7 @@ from bcg_tpu.models.transformer import (
     init_params,
     layers_stacked,
     prefill,
+    prefill_chunk_at,
     prefill_with_prefix,
     stack_layer_params,
 )
@@ -399,6 +400,10 @@ class JaxEngine(InferenceEngine):
         )
         self._prefill_suffix = jax.jit(
             partial(prefill_with_prefix, spec=self.spec, impl=self.attention_impl),
+            donate_argnames=("cache",),
+        )
+        self._prefill_chunk_at = jax.jit(
+            partial(prefill_chunk_at, spec=self.spec, impl=self.attention_impl),
             donate_argnames=("cache",),
         )
         self._decode_loops: Dict[Tuple, Any] = {}
@@ -1264,29 +1269,36 @@ class JaxEngine(InferenceEngine):
                 self.params, tokens=jnp.asarray(tokens),
                 valid=jnp.asarray(valid), cache=cache,
             )
-        if has_prefix:
-            base_lens = np.asarray(prefix_lens, dtype=np.int64)
+        # Single-shape chunk stepping (transformer.prefill_chunk_at): the
+        # history window is a FIXED [B, P + L - Ct] mask and the write
+        # slot a traced scalar, so every full-width chunk shares ONE
+        # compiled program regardless of offset (the previous
+        # growing-prefix form compiled L/C distinct programs — minutes of
+        # remote compiles per 8B boot).  A ragged tail chunk adds one
+        # more shape.
+        B = tokens.shape[0]
+        base_lens = (
+            np.asarray(prefix_lens, dtype=np.int64)
+            if has_prefix
+            else np.zeros(B, np.int64)
+        )
         first_logits = None
         for start in range(0, L, C):
-            tok_c = jnp.asarray(tokens[:, start:start + C])
-            val_c = jnp.asarray(valid[:, start:start + C])
-            if start == 0 and not has_prefix:
-                first_logits, cache = self._prefill(
-                    self.params, tokens=tok_c, valid=val_c, cache=cache
-                )
-                continue
+            Ct = min(C, L - start)
+            H = P + L - Ct
+            hist = np.zeros((B, H), dtype=bool)
             if has_prefix:
-                pv = np.concatenate(
-                    [prefix_valid, valid[:, :start]], axis=1
-                )
-                pl = base_lens + valid[:, :start].sum(axis=1)
-            else:
-                pv = valid[:, :start]
-                pl = valid[:, :start].sum(axis=1)
-            first_logits, cache = self._prefill_suffix(
-                self.params, tokens=tok_c, valid=val_c, cache=cache,
-                prefix_valid=jnp.asarray(pv),
-                prefix_lens=jnp.asarray(pl.astype(np.int32)),
+                hist[:, :P] = prefix_valid
+            hist[:, P:P + start] = valid[:, :start]
+            pos_off = base_lens + valid[:, :start].sum(axis=1)
+            first_logits, cache = self._prefill_chunk_at(
+                self.params,
+                tokens=jnp.asarray(tokens[:, start:start + Ct]),
+                valid=jnp.asarray(valid[:, start:start + Ct]),
+                cache=cache,
+                hist_valid=jnp.asarray(hist),
+                pos_offset=jnp.asarray(pos_off.astype(np.int32)),
+                write_pos=jnp.int32(P + start),
             )
         return first_logits, cache
 

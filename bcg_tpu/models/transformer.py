@@ -540,6 +540,48 @@ def prefill_with_prefix(
     return logits, new_cache
 
 
+def prefill_chunk_at(
+    params: TransformerParams,
+    spec: ModelSpec,
+    tokens: jax.Array,         # [B, C] one prefill chunk, left-aligned pads ok
+    valid: jax.Array,          # [B, C] bool
+    cache: Dict,               # slots [0, H) may hold prior context
+    hist_valid: jax.Array,     # [B, H] attendable prior slots (False past
+                               # the chunk's own write region)
+    pos_offset: jax.Array,     # [B] RoPE position of each row's first
+                               # valid chunk token
+    write_pos: jax.Array,      # scalar int32: cache slot of chunk col 0
+    impl: str = "xla",
+) -> Tuple[jax.Array, Dict]:
+    """One chunk of a chunked prefill with a DYNAMIC write position.
+
+    Unlike :func:`prefill_with_prefix` (whose history width — and hence
+    compiled shape — grows with every chunk offset), the history window
+    here is a fixed ``[B, H]`` mask and the chunk's cache slot arrives as
+    a traced scalar, so EVERY chunk of every offset shares one compiled
+    program per (B, C, H).  On a remote-compile environment that turns
+    an 8B boot's L/C prefill compiles into one.
+    """
+    B, C = tokens.shape
+    positions = pos_offset[:, None] + jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1
+    positions = jnp.maximum(positions, 0)
+    cos, sin = rope_table(positions, spec.head_dim, spec.rope_theta, spec.rope_scaling)
+
+    H = hist_valid.shape[1]
+    causal = jnp.tril(jnp.ones((C, C), bool))
+    chunk_mask = causal[None] & valid[:, None, :] & valid[:, :, None]   # [B, C, C]
+    hist_mask = hist_valid[:, None, :] & valid[:, :, None]              # [B, C, H]
+    attn_mask = jnp.concatenate([hist_mask, chunk_mask], axis=2)
+
+    x = params["embed"][tokens]
+    x, new_cache = _run_layers(
+        params, spec, x, cos, sin, write_pos, cache, attn_mask, impl,
+        hist_len=H,
+    )
+    logits = _logits(params, spec, x[:, -1:, :])[:, 0, :]
+    return logits, new_cache
+
+
 def decode_step(
     params: TransformerParams,
     spec: ModelSpec,

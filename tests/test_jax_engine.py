@@ -307,6 +307,27 @@ class TestChunkedPrefill:
         one.shutdown()
         chunked.shutdown()
 
+    def test_chunk_offsets_share_one_compiled_program(self):
+        """The single-shape chunk step (prefill_chunk_at) must serve every
+        full-width chunk offset from ONE traced program — per-offset
+        shapes cost minutes of remote compiles on an 8B boot."""
+        import dataclasses
+
+        from bcg_tpu.config import EngineConfig
+        from bcg_tpu.engine.jax_engine import JaxEngine
+
+        engine = JaxEngine(EngineConfig(
+            backend="jax", model_name="bcg-tpu/tiny-test",
+            max_model_len=1024, prefix_caching=False, prefill_chunk=64,
+        ))
+        # ~7 chunks of prompt; all full-width offsets must share a trace.
+        prompts = [("sys " * 60, "user prompt " * 25, self.VOTE_SCHEMA)]
+        out = engine.batch_generate_json(prompts, temperature=0.0, max_tokens=24)
+        assert "error" not in out[0]
+        traces = engine._prefill_chunk_at._cache_size()
+        assert traces <= 2, f"expected <=2 chunk-program traces, got {traces}"
+        engine.shutdown()
+
     def test_chunked_matches_single_pass(self):
         """prefill_chunk slices the full-prompt prefill through the
         prefix-suffix jit; greedy output must be identical to one-pass
