@@ -56,6 +56,14 @@ def _decode_kernel(
     q_ref, k_ref, v_ref, ks_ref, vs_ref, mask_ref, o_ref,
     m_scr, l_scr, acc_scr, *, scale, num_s_blocks, quantized,
 ):
+    """Per-(batch, kv-head) program over the bf16 cache layout.
+
+    Only the bf16 path still uses this grid (its (1, block_s, 1, Dh)
+    block does not lower on real TPUs for Hkv > 1 — it exists for
+    interpret-mode reference checks); the int8 serving path runs
+    :func:`_decode_kernel_allheads`.
+    """
+    del quantized  # signature kept stable for the shared in_specs
     s = pl.program_id(2)
 
     @pl.when(s == 0)
@@ -66,21 +74,10 @@ def _decode_kernel(
 
     q = q_ref[0, 0]                          # [rows, Dh]
     mask = mask_ref[0]                       # [M, Sblk] bool
+    del ks_ref, vs_ref                       # dummies on the bf16 path
 
-    if quantized:
-        # int8 cache layout [B, Hkv, S, Dh]: the block's last two dims
-        # are (Sblk, Dh) — Mosaic-native (32, 128) int8 tiles.  Scale
-        # blocks span ALL kv heads (a (1, Sblk) slice would violate the
-        # Mosaic sublane rule — block dims must be 8-multiples or whole);
-        # each program selects its head row.
-        h = pl.program_id(1)
-        k = k_ref[0, 0]                      # [Sblk, Dh] int8
-        v = v_ref[0, 0]
-        k = k.astype(jnp.float32) * ks_ref[0, h][:, None]
-        v = v.astype(jnp.float32) * vs_ref[0, h][:, None]
-    else:
-        k = k_ref[0, :, 0, :]                # [Sblk, Dh]
-        v = v_ref[0, :, 0, :]
+    k = k_ref[0, :, 0, :]                    # [Sblk, Dh]
+    v = v_ref[0, :, 0, :]
     k = k.astype(q.dtype)
     v = v.astype(q.dtype)
 
@@ -111,6 +108,100 @@ def _decode_kernel(
         o_ref[0, 0] = (acc_scr[...] / jnp.where(l == 0.0, 1.0, l)).astype(o_ref.dtype)
 
 
+def _decode_kernel_allheads(
+    q_ref, k_ref, v_ref, ks_ref, vs_ref, mask_ref, o_ref,
+    m_scr, l_scr, acc_scr, *, scale, num_s_blocks, hkv,
+):
+    """int8 variant processing ALL kv heads per program: grid (B, nS).
+
+    The per-head grid (B, Hkv, nS) paid a ~2 us fixed cost per program
+    invocation (v5e, measured in-loop round 3) — at decode block counts
+    that overhead, not HBM streaming, dominated the kernel.  Folding the
+    Hkv loop inside cuts program count 8x; K/V blocks stay (Sblk, Dh)
+    Mosaic-native int8 tiles, scratch is per-head-indexed on its leading
+    dim (static index — no sublane-offset slicing).
+    """
+    s = pl.program_id(1)
+
+    @pl.when(s == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    mask = mask_ref[0]                       # [M, Sblk]; M = 1 or rows
+    maskf = mask.astype(jnp.float32)
+    for h in range(hkv):
+        q = q_ref[0, h]                      # [rows, Dh]
+        k = k_ref[0, h].astype(jnp.float32) * ks_ref[0, h][:, None]
+        v = v_ref[0, h].astype(jnp.float32) * vs_ref[0, h][:, None]
+        k = k.astype(q.dtype)
+        v = v.astype(q.dtype)
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale                            # [rows, Sblk]
+        scores = jnp.where(mask, scores, _NEG_INF)
+        m_prev = m_scr[h]                    # [rows, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(scores - m_new) * maskf
+        m_scr[h] = m_new
+        l_scr[h] = alpha * l_scr[h] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[h] = alpha * acc_scr[h] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(s == num_s_blocks - 1)
+    def _finish():
+        for h in range(hkv):
+            l = l_scr[h]
+            o_ref[0, h] = (
+                acc_scr[h] / jnp.where(l == 0.0, 1.0, l)
+            ).astype(o_ref.dtype)
+
+
+def _quantized_attention(qg, kp, vp, ksp, vsp, mp, scale, block_s, interpret):
+    """Shared pallas_call for the int8 single-step and chunk paths.
+
+    qg [B, Hkv, rows, Dh]; kp/vp [B, Hkv, Sp, Dh] int8; scales
+    [B, Hkv, Sp]; mp [B, M, Sp] with M == 1 (broadcast) or rows.
+    Returns [B, Hkv, rows, Dh].
+    """
+    B, Hkv, rows, Dh = qg.shape
+    Sp = kp.shape[2]
+    M = mp.shape[1]
+    nS = Sp // block_s
+    kv_spec = pl.BlockSpec((1, Hkv, block_s, Dh), lambda b, s: (b, 0, s, 0))
+    scale_spec = pl.BlockSpec((1, Hkv, block_s), lambda b, s: (b, 0, s))
+    kernel = functools.partial(
+        _decode_kernel_allheads, scale=scale, num_s_blocks=nS, hkv=Hkv,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(B, nS),
+        in_specs=[
+            pl.BlockSpec((1, Hkv, rows, Dh), lambda b, s: (b, 0, 0, 0)),
+            kv_spec,
+            kv_spec,
+            scale_spec,
+            scale_spec,
+            pl.BlockSpec((1, M, block_s), lambda b, s: (b, 0, s)),
+        ],
+        out_specs=pl.BlockSpec((1, Hkv, rows, Dh), lambda b, s: (b, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, rows, Dh), qg.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((Hkv, rows, 1), jnp.float32),
+            pltpu.VMEM((Hkv, rows, 1), jnp.float32),
+            pltpu.VMEM((Hkv, rows, Dh), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(qg, kp, vp, ksp, vsp, mp)
+
+
 def _pad_s(x, block_s, axis=1, value=0):
     pad = (-x.shape[axis]) % block_s
     if pad == 0:
@@ -137,22 +228,26 @@ def decode_attention(
     quantized = k_scale is not None
     block_s = _pick_block(k.shape[2] if quantized else k.shape[1], block_s)
     if quantized:
-        Hkv, S = k.shape[1], k.shape[2]
-        kp = _pad_s(k, block_s, axis=2)
-        vp = _pad_s(v, block_s, axis=2)
-        kv_spec = pl.BlockSpec((1, 1, block_s, Dh), lambda b, h, s: (b, h, s, 0))
-        ksp = _pad_s(k_scale, block_s, axis=2)
-        vsp = _pad_s(v_scale, block_s, axis=2)
-        Sp = kp.shape[2]
-    else:
-        S, Hkv = k.shape[1], k.shape[2]
-        kp = _pad_s(k, block_s)
-        vp = _pad_s(v, block_s)
-        kv_spec = pl.BlockSpec((1, block_s, 1, Dh), lambda b, h, s: (b, s, h, 0))
-        Sp = kp.shape[1]
-        # dummy operands so the kernel signature is stable
-        ksp = jnp.ones((B, Hkv, Sp), jnp.float32)
-        vsp = ksp
+        Hkv = k.shape[1]
+        group = H // Hkv
+        out = _quantized_attention(
+            q.reshape(B, Hkv, group, Dh),
+            _pad_s(k, block_s, axis=2),
+            _pad_s(v, block_s, axis=2),
+            _pad_s(k_scale, block_s, axis=2),
+            _pad_s(v_scale, block_s, axis=2),
+            _pad_s(mask, block_s, axis=1)[:, None, :],
+            scale, block_s, interpret,
+        )
+        return out.reshape(B, H, Dh)
+    S, Hkv = k.shape[1], k.shape[2]
+    kp = _pad_s(k, block_s)
+    vp = _pad_s(v, block_s)
+    kv_spec = pl.BlockSpec((1, block_s, 1, Dh), lambda b, h, s: (b, s, h, 0))
+    Sp = kp.shape[1]
+    # dummy operands so the kernel signature is stable
+    ksp = jnp.ones((B, Hkv, Sp), jnp.float32)
+    vsp = ksp
     group = H // Hkv
     mp = _pad_s(mask, block_s, axis=1)[:, None, :]  # [B, 1, S]
     nS = Sp // block_s
@@ -160,7 +255,7 @@ def decode_attention(
     qg = q.reshape(B, Hkv, group, Dh)
 
     kernel = functools.partial(
-        _decode_kernel, scale=scale, num_s_blocks=nS, quantized=quantized,
+        _decode_kernel, scale=scale, num_s_blocks=nS, quantized=False,
     )
     out = pl.pallas_call(
         kernel,
@@ -209,30 +304,42 @@ def chunk_decode_attention(
     block_s = _pick_block(k.shape[2] if quantized else k.shape[1], block_s)
     if quantized:
         Hkv = k.shape[1]
-        kp = _pad_s(k, block_s, axis=2)
-        vp = _pad_s(v, block_s, axis=2)
-        kv_spec = pl.BlockSpec((1, 1, block_s, Dh), lambda b, h, s: (b, h, s, 0))
-        ksp = _pad_s(k_scale, block_s, axis=2)
-        vsp = _pad_s(v_scale, block_s, axis=2)
-        Sp = kp.shape[2]
-    else:
-        Hkv = k.shape[2]
-        kp = _pad_s(k, block_s)
-        vp = _pad_s(v, block_s)
-        kv_spec = pl.BlockSpec((1, block_s, 1, Dh), lambda b, h, s: (b, s, h, 0))
-        Sp = kp.shape[1]
-        ksp = jnp.ones((B, Hkv, Sp), jnp.float32)
-        vsp = ksp
+        group = H // Hkv
+        # Pre-repeat the mask per query row (position-major: row
+        # k*group+g = mask[k]) and lay q out [B, Hkv, K*group, Dh] to
+        # match — no in-kernel repeat (Mosaic lowering of repeats is not
+        # relied upon anywhere).
+        mp = jnp.repeat(_pad_s(mask, block_s, axis=2), group, axis=1)
+        qg = (
+            q.reshape(B, K, Hkv, group, Dh)
+            .transpose(0, 2, 1, 3, 4)
+            .reshape(B, Hkv, K * group, Dh)
+        )
+        out = _quantized_attention(
+            qg,
+            _pad_s(k, block_s, axis=2),
+            _pad_s(v, block_s, axis=2),
+            _pad_s(k_scale, block_s, axis=2),
+            _pad_s(v_scale, block_s, axis=2),
+            mp, scale, block_s, interpret,
+        )
+        return (
+            out.reshape(B, Hkv, K, group, Dh)
+            .transpose(0, 2, 1, 3, 4)
+            .reshape(B, K, H, Dh)
+        )
+    Hkv = k.shape[2]
+    kp = _pad_s(k, block_s)
+    vp = _pad_s(v, block_s)
+    kv_spec = pl.BlockSpec((1, block_s, 1, Dh), lambda b, h, s: (b, s, h, 0))
+    Sp = kp.shape[1]
+    ksp = jnp.ones((B, Hkv, Sp), jnp.float32)
+    vsp = ksp
     group = H // Hkv
     mp = _pad_s(mask, block_s, axis=2)              # [B, K, Sp]
-    # Pre-repeat per query row (position-major: row k*group+g = mask[k]),
-    # so the kernel indexes mask rows directly instead of repeating
-    # in-kernel (no reliance on Mosaic repeat lowering; see _decode_kernel).
     mp = jnp.repeat(mp, group, axis=1)              # [B, K*group, Sp]
     nS = Sp // block_s
 
-    # [B, K, Hkv, group, Dh] -> [B, Hkv, K*group, Dh]: position-major row
-    # layout, matching the kernel's per-position mask repeat.
     qg = (
         q.reshape(B, K, Hkv, group, Dh)
         .transpose(0, 2, 1, 3, 4)
@@ -240,7 +347,7 @@ def chunk_decode_attention(
     )
 
     kernel = functools.partial(
-        _decode_kernel, scale=scale, num_s_blocks=nS, quantized=quantized,
+        _decode_kernel, scale=scale, num_s_blocks=nS, quantized=False,
     )
     out = pl.pallas_call(
         kernel,
