@@ -47,8 +47,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-every-round", action="store_true", help="Write a resumable checkpoint after each round")
     p.add_argument("--resume", type=str, default=None, help="Resume from checkpoint file")
     p.add_argument("--tensor-parallel", type=int, default=None, help="TP mesh axis size")
-    p.add_argument("--quantization", type=str, default=None, choices=["int8"],
-                   help="Weight quantization: int8 = dynamic W8A8 (halves decode weight traffic)")
+    p.add_argument("--quantization", type=str, default=None, choices=["int8", "int4"],
+                   help="Weight quantization: int8 = dynamic W8A8 (halves decode "
+                        "weight traffic); int4 = grouped W4A16 (capacity: fits "
+                        "the 14B preset on one 16 GB chip)")
     p.add_argument("--kv-cache-dtype", type=str, default=None, choices=["bfloat16", "int8"],
                    help="KV cache storage dtype (int8 halves decode cache traffic)")
     p.add_argument("--no-prefix-caching", action="store_true",

@@ -200,9 +200,10 @@ class JaxEngine(InferenceEngine):
                 f"kv_cache_dtype={config.kv_cache_dtype!r}: expected "
                 "'bfloat16' or 'int8'"
             )
-        if config.quantization not in (None, "int8"):
+        if config.quantization not in (None, "int8", "int4"):
             raise ValueError(
-                f"quantization={config.quantization!r}: expected None or 'int8'"
+                f"quantization={config.quantization!r}: expected None, "
+                "'int8' or 'int4'"
             )
         self.kv_quantized = config.kv_cache_dtype == "int8"
         # Decode impl: the bf16 einsum path is a well-fused GEMV and the
@@ -295,7 +296,8 @@ class JaxEngine(InferenceEngine):
                 "or a positive token count"
             )
 
-        quantize = config.quantization == "int8"
+        quant_mode = config.quantization  # None | "int8" | "int4"
+        quantize = quant_mode is not None
         owns_params = params is None
         if params is not None:
             self.params = params
@@ -309,7 +311,7 @@ class JaxEngine(InferenceEngine):
 
             self.params = init_params(
                 self.spec, jax.random.PRNGKey(0),
-                leaf_transform=quantize_leaf_transform(self.spec) if quantize else None,
+                leaf_transform=quantize_leaf_transform(self.spec, quant_mode) if quantize else None,
             )
         else:
             from bcg_tpu.models.loader import load_checkpoint_params
@@ -319,7 +321,7 @@ class JaxEngine(InferenceEngine):
             # arrives so the bf16 model never exists whole on device.
             self.params = load_checkpoint_params(
                 self.spec, config.model_name, mesh=mesh,
-                leaf_transform=quantize_leaf_transform(self.spec) if quantize else None,
+                leaf_transform=quantize_leaf_transform(self.spec, quant_mode) if quantize else None,
             )
 
         if quantize and not layers_stacked(self.params):
@@ -327,16 +329,29 @@ class JaxEngine(InferenceEngine):
                 ensure_quantized_head, is_quantized, quantize_params,
             )
 
-            # Quantize BEFORE sharding so the int8 tensors (not the bf16
-            # originals) are what gets laid out over the mesh.  Constructor-
-            # supplied params may already be quantized (weight sharing
-            # between engines) — don't quantize twice, and only consume
-            # (free-as-we-go) a tree this engine created itself.
-            if not is_quantized(self.params["layers"][0]["wq"]):
+            # Quantize BEFORE sharding so the int8/int4 tensors (not the
+            # bf16 originals) are what gets laid out over the mesh.
+            # Constructor-supplied params may already be quantized (weight
+            # sharing between engines) — don't quantize twice, and only
+            # consume (free-as-we-go) a tree this engine created itself.
+            first_wq = self.params["layers"][0]["wq"]
+            if is_quantized(first_wq):
+                # Constructor-shared pre-quantized tree: its format must
+                # match this engine's configured mode — silently serving
+                # int8 weights under quantization="int4" would break the
+                # capacity math int4 exists for (and vice versa).
+                tree_mode = "int4" if "q4" in first_wq else "int8"
+                if tree_mode != quant_mode:
+                    raise ValueError(
+                        f"constructor params are {tree_mode}-quantized but "
+                        f"config.quantization={quant_mode!r}; share weights "
+                        "only between engines of the same mode"
+                    )
+            else:
                 self.params = quantize_params(
-                    self.params, self.spec, consume=owns_params
+                    self.params, self.spec, consume=owns_params, mode=quant_mode
                 )
-            ensure_quantized_head(self.params, self.spec)
+            ensure_quantized_head(self.params, self.spec, mode=quant_mode)
 
         self.scan_layers = bool(getattr(config, "scan_layers", False))
         if self.scan_layers:

@@ -20,6 +20,12 @@ from typing import Dict, Optional
 # and the engine's int8-KV speed warning.
 LARGE_MODEL_PARAMS = 6_000_000_000
 
+# At/above this, even int8 weights (>= 12 GB) crowd out the KV cache on
+# a 16 GB chip: single-chip serving needs the int4 weight path
+# (models/quantize.py quantize_weight_int4) — the reference's 14B preset
+# is the first to cross it.
+XL_MODEL_PARAMS = 12_000_000_000
+
 
 @dataclass(frozen=True)
 class RopeScaling:

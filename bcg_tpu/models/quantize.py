@@ -1,4 +1,4 @@
-"""int8 weight quantization (W8A8) for the bandwidth-bound decode path.
+"""int8 (W8A8) and int4 (grouped W4A16) weight quantization.
 
 Autoregressive decode reads every weight byte once per token, so on TPU it
 is HBM-bandwidth-bound; storing the dense weights as int8 with per-output-
@@ -18,10 +18,28 @@ lookups stay bf16 (gathers, not matmuls); for tied-embedding models a
 separate quantized head copy is materialized so the [D, V] projection —
 the single largest weight in small-vocab-heavy models — still benefits.
 Norm vectors stay bf16.
+
+int4 (``quantization="int4"``) exists for CAPACITY, not speed: grouped
+absmax int4 (group 128 along the contraction dim, two values packed per
+byte) halves weight memory again vs int8 — the difference between the
+reference's 14B preset (config.py:20-25; "24GB+ VRAM" per its README)
+fitting a single 16 GB v5e chip or needing tp>=2.  The matmul runs
+W4A16: nibbles are sign-extended and dequantized to bf16 (in VMEM by the
+Pallas kernel on TPU, ops/w4_matmul.py; materialized by XLA elsewhere)
+and the dot runs on the MXU in bf16.
+
+Packing layout (shared contract with the Pallas kernel): a [in, out]
+weight packs row ``i`` of the TOP half (rows [0, in/2)) into the low
+nibble and row ``i + in/2`` into the high nibble of byte ``[i, out]`` —
+contraction is a sum over rows, so splitting ``x`` into matching column
+halves needs no nibble interleave on the unpack path.  Group scales are
+``[in/group, out]`` bf16; ``in/2`` must divide by the group size so no
+group straddles the halves (group shrinks via gcd for tiny test dims).
 """
 
 from __future__ import annotations
 
+import math
 from functools import partial
 from typing import Dict, Union
 
@@ -30,11 +48,15 @@ import jax.numpy as jnp
 
 from bcg_tpu.models.configs import ModelSpec
 
-# A quantized dense weight is a dict {"q": int8 [in, out], "scale": f32 [out]}.
+# A quantized dense weight is a dict:
+#   int8: {"q": int8 [in, out], "scale": f32 [out]}
+#   int4: {"q4": int8 [in//2, out] (two nibbles/byte), "gscale": bf16 [in//group, out]}
 QuantizedDense = Dict[str, jax.Array]
 DenseWeight = Union[jax.Array, QuantizedDense]
 
 _QUANT_LEAVES = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+INT4_GROUP = 128
 
 
 def _quantize_impl(w: jax.Array) -> QuantizedDense:
@@ -64,8 +86,79 @@ def quantize_weight(w, consume: bool = False) -> QuantizedDense:
     return fn(jnp.asarray(w))
 
 
+def int4_group_for(in_dim: int, group: int = INT4_GROUP) -> int:
+    """Effective group size for a weight's contraction dim:
+    ``gcd(in_dim // 2, group)`` — a divisor of the packed half, shrunk
+    from the requested group when it cannot divide (tiny test models
+    have in-dims like 64; non-power-of-two dims shrink further than the
+    largest-divisor-below-group would)."""
+    if in_dim % 2:
+        raise ValueError(f"int4 packing needs an even in-dim, got {in_dim}")
+    return math.gcd(in_dim // 2, group)
+
+
+def _quantize4_impl(w: jax.Array, group: int) -> QuantizedDense:
+    w32 = w.astype(jnp.float32)
+    in_dim, out_dim = w32.shape
+    grouped = w32.reshape(in_dim // group, group, out_dim)
+    absmax = jnp.max(jnp.abs(grouped), axis=1)
+    scale = jnp.maximum(absmax, 1e-12) / 7.0                  # [in/group, out]
+    # Quantize against the bf16-ROUNDED scale (what dequant will read),
+    # so the half-step error bound holds exactly.
+    scale = scale.astype(jnp.bfloat16).astype(jnp.float32)
+    q = jnp.clip(jnp.round(grouped / scale[:, None, :]), -8, 7)
+    q = q.astype(jnp.int8).reshape(in_dim, out_dim)
+    half = in_dim // 2
+    packed = jnp.bitwise_or(
+        jnp.bitwise_and(q[:half], jnp.int8(0x0F)),
+        jnp.left_shift(q[half:], 4),
+    ).astype(jnp.int8)
+    return {"q4": packed, "gscale": scale.astype(jnp.bfloat16)}
+
+
+_quantize4_consuming = partial(jax.jit, static_argnums=1, donate_argnums=0)(_quantize4_impl)
+_quantize4_preserving = partial(jax.jit, static_argnums=1)(_quantize4_impl)
+
+
+def quantize_weight_int4(w, consume: bool = False, group: int = INT4_GROUP) -> QuantizedDense:
+    """[in, out] bf16/f32 -> packed int4 + per-(group, output) bf16 scale.
+
+    Same jit/donate discipline as :func:`quantize_weight` (eager absmax
+    would materialize a full f32 copy during a 14B load)."""
+    w = jnp.asarray(w)
+    g = int4_group_for(w.shape[0], group)
+    fn = _quantize4_consuming if consume else _quantize4_preserving
+    return fn(w, g)
+
+
+def unpack_int4(packed: jax.Array) -> jax.Array:
+    """Packed [in//2, out] int8 -> [in, out] int8 in [-8, 7].
+
+    Low nibbles are the top half's rows, high nibbles the bottom half's
+    (see module docstring); right_shift on int8 is arithmetic, which is
+    exactly the sign-extension the low nibble needs after the left
+    shift."""
+    low = jnp.right_shift(jnp.left_shift(packed, 4), 4)
+    high = jnp.right_shift(packed, 4)
+    return jnp.concatenate([low, high], axis=0)
+
+
+def dequantize_int4(w: QuantizedDense) -> jax.Array:
+    """Materialize the bf16 weight from an int4 dict (XLA fallback path
+    and test oracle; the Pallas kernel does this per-tile in VMEM)."""
+    q = unpack_int4(w["q4"]).astype(jnp.float32)              # [in, out]
+    gscale = w["gscale"].astype(jnp.float32)                  # [in/g, out]
+    group = q.shape[0] // gscale.shape[0]
+    scaled = q.reshape(gscale.shape[0], group, -1) * gscale[:, None, :]
+    return scaled.reshape(q.shape).astype(jnp.bfloat16)
+
+
 def is_quantized(w: DenseWeight) -> bool:
     return isinstance(w, dict)
+
+
+def is_int4(w: DenseWeight) -> bool:
+    return isinstance(w, dict) and "q4" in w
 
 
 def dense(x: jax.Array, w: DenseWeight, out_dtype=None) -> jax.Array:
@@ -80,6 +173,27 @@ def dense(x: jax.Array, w: DenseWeight, out_dtype=None) -> jax.Array:
         out_dtype = x.dtype
     if not is_quantized(w):
         return (x @ w).astype(out_dtype)
+    if is_int4(w):
+        # W4A16: dequantize to bf16, dot on the MXU.  Path choice is by
+        # row count: DECODE shapes (few rows) take the Pallas kernel —
+        # one [P, block_f] strip DMA per output tile, weights streamed
+        # once as packed int4, dequant in VMEM.  PREFILL shapes (many
+        # rows) take the XLA fallback: it materializes the bf16 weight
+        # in HBM once per call, which beats the kernel's per-M-block
+        # weight re-streaming when the materialization is amortized
+        # over thousands of rows (and prefill is compute-bound anyway).
+        rows = 1
+        for s in x.shape[:-1]:
+            rows *= s
+        # Kernel only on a SINGLE device: pallas_call has no SPMD
+        # partitioning rule, so under a tp/dp mesh GSPMD would have to
+        # replicate (all-gather) the packed weight per call — the XLA
+        # fallback partitions normally there.
+        if rows <= 256 and jax.default_backend() == "tpu" and jax.device_count() == 1:
+            from bcg_tpu.ops.w4_matmul import w4a16_matmul
+
+            return w4a16_matmul(x, w["q4"], w["gscale"]).astype(out_dtype)
+        return (x.astype(jnp.bfloat16) @ dequantize_int4(w)).astype(out_dtype)
     x32 = x.astype(jnp.float32)
     a_absmax = jnp.max(jnp.abs(x32), axis=-1, keepdims=True)
     a_scale = jnp.maximum(a_absmax, 1e-12) / 127.0
@@ -92,7 +206,17 @@ def dense(x: jax.Array, w: DenseWeight, out_dtype=None) -> jax.Array:
     return (acc.astype(jnp.float32) * a_scale * w["scale"]).astype(out_dtype)
 
 
-def quantize_params(params: Dict, spec: ModelSpec, consume: bool = False) -> Dict:
+def _quantizer(mode: str):
+    if mode == "int8":
+        return quantize_weight
+    if mode == "int4":
+        return quantize_weight_int4
+    raise ValueError(f"quantization mode {mode!r}: expected 'int8' or 'int4'")
+
+
+def quantize_params(
+    params: Dict, spec: ModelSpec, consume: bool = False, mode: str = "int8"
+) -> Dict:
     """Quantize every dense matmul weight of a transformer param pytree.
 
     Returns a new pytree with each of ``_QUANT_LEAVES`` (per layer) and the
@@ -103,11 +227,13 @@ def quantize_params(params: Dict, spec: ModelSpec, consume: bool = False) -> Dic
     present, keeping the tie semantically intact.
 
     ``consume=True`` drops each bf16 source leaf from ``params`` as it is
-    quantized, so peak device memory is the int8 model plus ONE bf16
-    weight instead of both full copies — the difference between a 14B
-    int8 model fitting a single v5e chip or not.  Only pass it for a tree
-    the caller owns exclusively.
+    quantized, so peak device memory is the quantized model plus ONE bf16
+    weight instead of both full copies — the difference between a large
+    model fitting a single v5e chip or not.  Only pass it for a tree
+    the caller owns exclusively.  ``mode`` selects int8 (W8A8) or int4
+    (grouped W4A16).
     """
+    quantize = _quantizer(mode)
     out = dict(params)
     out_layers = []
     for layer in params["layers"]:
@@ -115,7 +241,7 @@ def quantize_params(params: Dict, spec: ModelSpec, consume: bool = False) -> Dic
         for k in list(layer):
             v = layer[k]
             if k in _QUANT_LEAVES:
-                new_layer[k] = quantize_weight(v, consume=consume)
+                new_layer[k] = quantize(v, consume=consume)
                 if consume:
                     del layer[k]
                 del v  # drop the local bf16 reference immediately
@@ -124,32 +250,33 @@ def quantize_params(params: Dict, spec: ModelSpec, consume: bool = False) -> Dic
         out_layers.append(new_layer)
     out["layers"] = out_layers
     if "lm_head" in params:
-        out["lm_head"] = quantize_weight(params["lm_head"], consume=consume)
+        out["lm_head"] = quantize(params["lm_head"], consume=consume)
         if consume:
             del params["lm_head"]
     elif spec.tie_embeddings:
-        out["lm_head"] = quantize_weight(params["embed"].T, consume=True)
+        out["lm_head"] = quantize(params["embed"].T, consume=True)
     return out
 
 
-def quantize_leaf_transform(spec: ModelSpec):
+def quantize_leaf_transform(spec: ModelSpec, mode: str = "int8"):
     """Per-leaf hook for the checkpoint loader: quantize each dense weight
     AS IT LOADS, so the bf16 tensor is freed before the next one arrives
     (streamed quantized loading; see loader.load_checkpoint_params)."""
+    quantize = _quantizer(mode)
 
     def transform(logical: str, tensor):
         leaf = logical.split(".")[-1]
         if leaf in _QUANT_LEAVES or leaf == "lm_head":
-            return quantize_weight(tensor, consume=True)
+            return quantize(tensor, consume=True)
         return tensor
 
     return transform
 
 
-def ensure_quantized_head(params: Dict, spec: ModelSpec) -> Dict:
+def ensure_quantized_head(params: Dict, spec: ModelSpec, mode: str = "int8") -> Dict:
     """Give tied-embedding models their explicit quantized LM head when a
     leaf-transform load (which never sees an ``lm_head`` tensor) built the
     rest of the tree."""
     if "lm_head" not in params and spec.tie_embeddings:
-        params["lm_head"] = quantize_weight(params["embed"].T, consume=True)
+        params["lm_head"] = _quantizer(mode)(params["embed"].T, consume=True)
     return params

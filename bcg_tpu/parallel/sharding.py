@@ -65,7 +65,7 @@ def param_sharding(
     parts = logical_name.split(".")
     leaf = parts[-1]
     quant_kind = None
-    if leaf in ("q", "scale") and len(parts) >= 2 and parts[-2] in _SPECS:
+    if leaf in ("q", "scale", "q4", "gscale") and len(parts) >= 2 and parts[-2] in _SPECS:
         quant_kind = leaf
         leaf = parts[-2]
     pspec = _SPECS.get(leaf, P(None))
@@ -78,6 +78,14 @@ def param_sharding(
     if quant_kind == "scale":
         # Per-output-channel vector: keep the weight's OUTPUT-dim axis.
         pspec = P(pspec[-1] if len(pspec) > 0 else None)
+    elif quant_kind == "gscale":
+        # int4 group scales are [in/group, out]: the group dim always
+        # replicates and only the output dim follows the parent (sharded
+        # for column-parallel, replicated for row-parallel).  Replicated
+        # groups mean every shard has the scale rows for whatever slice
+        # of q4's packed rows GSPMD hands it — q4's nibble pairs (row i
+        # packs global rows i and i+P) never constrain the scale layout.
+        pspec = P(None, pspec[-1] if len(pspec) > 0 else None)
     if stacked:
         pspec = P(*((None,) + tuple(pspec)))
     return NamedSharding(mesh, pspec)

@@ -360,7 +360,9 @@ def main() -> None:
     concurrency = int(os.environ.get("BENCH_CONCURRENCY", "1"))
 
     from bcg_tpu.config import BCGConfig
-    from bcg_tpu.models.configs import LARGE_MODEL_PARAMS, spec_for_model
+    from bcg_tpu.models.configs import (
+        LARGE_MODEL_PARAMS, XL_MODEL_PARAMS, spec_for_model,
+    )
 
     # The remote-attached TPU can hang for many minutes when its tunnel is
     # unhealthy (observed: ~10 min stall then UNAVAILABLE).  Probe the
@@ -410,6 +412,11 @@ def main() -> None:
 
     spec = spec_for_model(model)
     large_model = spec is not None and spec.param_count >= LARGE_MODEL_PARAMS
+    xl_model = spec is not None and spec.param_count >= XL_MODEL_PARAMS
+    if xl_model and "BENCH_QUANTIZATION" not in os.environ:
+        # 14B-class: int8 weights alone are >= 12 GB — single-chip
+        # serving needs the int4 capacity path unless overridden.
+        quant_env = "int4"
     # int8 KV default for the large size class: the bf16 cache alone
     # pushes a 16 GB chip past capacity next to int8 weights (measured
     # compile-time OOM); smaller models default bf16 (int8 KV loses
@@ -436,13 +443,18 @@ def main() -> None:
             kv_cache_dtype=kv_dtype,
             decode_fast_forward=_env_flag("BENCH_FAST_FORWARD", True),
             guided_compact_json=_env_flag("BENCH_COMPACT_JSON", True),
-            # Off for models whose weights+KV leave no room for cached
-            # prefix KV (e.g. bench-8b on a 16 GB chip).
-            prefix_caching=_env_flag("BENCH_PREFIX_CACHING", True),
+            # Off by default for the large size class: weights + KV
+            # leave no room for cached prefix KV on a 16 GB chip — the
+            # round-3 plain bench-8b run OOMed at first decode with
+            # prefix entries resident.
+            prefix_caching=_env_flag("BENCH_PREFIX_CACHING", not large_model),
             # Chunked prefill slice (tokens; 0 = whole prompt in one
-            # pass).  Needed alongside BENCH_PREFIX_CACHING=0 for
-            # 8B-class models on one chip.
-            prefill_chunk=int(os.environ.get("BENCH_PREFILL_CHUNK", "0")),
+            # pass).  Default ON for the large size class: whole-prompt
+            # prefill activations alone exceed the HBM left after
+            # weights + KV cache there.
+            prefill_chunk=int(os.environ.get(
+                "BENCH_PREFILL_CHUNK", "512" if large_model else "0"
+            )),
             # Scan-over-layers: O(1)-in-depth program, required for
             # 8B-class compiles through the remote-compile helper
             # (default ON for the large size class, off elsewhere — the
