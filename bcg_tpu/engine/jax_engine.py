@@ -324,6 +324,31 @@ class JaxEngine(InferenceEngine):
                 leaf_transform=quantize_leaf_transform(self.spec, quant_mode) if quantize else None,
             )
 
+        if not owns_params:
+            # Constructor-shared tree (weight sharing between engines):
+            # a pre-quantized tree's format must match this engine's
+            # configured mode — silently serving int8 under
+            # quantization="int4", or quantized weights under
+            # quantization=None, would break the capacity math
+            # quantization exists for.  (A shared *bf16* unstacked tree
+            # under a quantized config is fine: it is quantized below
+            # like an owned one, without consuming the donor's copy.)
+            wq = (self.params["layers"]["wq"] if layers_stacked(self.params)
+                  else self.params["layers"][0]["wq"])
+            tree_mode = (
+                ("int4" if "q4" in wq else "int8")
+                if isinstance(wq, dict) else None
+            )
+            mismatch = tree_mode != quant_mode and not (
+                tree_mode is None and not layers_stacked(self.params)
+            )
+            if mismatch:
+                raise ValueError(
+                    f"constructor params are {tree_mode or 'bf16'}-format "
+                    f"but config.quantization={quant_mode!r}; share "
+                    "weights only between engines of the same mode"
+                )
+
         if quantize and not layers_stacked(self.params):
             from bcg_tpu.models.quantize import (
                 ensure_quantized_head, is_quantized, quantize_params,
@@ -332,29 +357,17 @@ class JaxEngine(InferenceEngine):
             # Quantize BEFORE sharding so the int8/int4 tensors (not the
             # bf16 originals) are what gets laid out over the mesh.
             # Constructor-supplied params may already be quantized (weight
-            # sharing between engines) — don't quantize twice, and only
-            # consume (free-as-we-go) a tree this engine created itself.
-            first_wq = self.params["layers"][0]["wq"]
-            if is_quantized(first_wq):
-                # Constructor-shared pre-quantized tree: its format must
-                # match this engine's configured mode — silently serving
-                # int8 weights under quantization="int4" would break the
-                # capacity math int4 exists for (and vice versa).
-                tree_mode = "int4" if "q4" in first_wq else "int8"
-                if tree_mode != quant_mode:
-                    raise ValueError(
-                        f"constructor params are {tree_mode}-quantized but "
-                        f"config.quantization={quant_mode!r}; share weights "
-                        "only between engines of the same mode"
-                    )
-            else:
+            # sharing between engines, mode-checked above) — don't
+            # quantize twice, and only consume (free-as-we-go) a tree
+            # this engine created itself.
+            if not is_quantized(self.params["layers"][0]["wq"]):
                 self.params = quantize_params(
                     self.params, self.spec, consume=owns_params, mode=quant_mode
                 )
             ensure_quantized_head(self.params, self.spec, mode=quant_mode)
 
         self.scan_layers = bool(getattr(config, "scan_layers", False))
-        if self.scan_layers:
+        if self.scan_layers and not layers_stacked(self.params):
             # Scan-over-layers: program size O(1) in depth (see
             # EngineConfig.scan_layers).  Stacking after quantization so
             # the int8 leaves (not bf16) are what stacks; consuming an
@@ -362,7 +375,8 @@ class JaxEngine(InferenceEngine):
             self.params = stack_layer_params(self.params, consume=owns_params)
         elif layers_stacked(self.params):
             # Constructor-supplied stacked params (weight sharing from a
-            # scan-mode engine) force scan mode here too.
+            # scan-mode engine, mode-checked above) force scan mode here
+            # too.
             self.scan_layers = True
 
         if mesh is not None:

@@ -69,11 +69,28 @@ def _w4_kernel(x_ref, q4_ref, gs_ref, o_ref, *, group, num_groups):
     o_ref[...] = jax.lax.fori_loop(0, num_groups, body, acc)
 
 
-def _pick_block_f(P: int, F: int) -> int:
-    # Keep the [P, block_f] strip + double buffering inside VMEM
-    # (~16 MB): 512 lanes up to P=8704 (w_down at 14B = 4.3 MB strips).
+def _row_block(M: int, block_m: int) -> int:
+    """Actual row-block size for an M-row call: the requested block, or
+    M rounded up to a sublane multiple when smaller.  Shared by
+    :func:`w4a16_supported` and :func:`w4a16_matmul` so the supported
+    check always budgets VMEM for the block size the call will use."""
+    return block_m if M >= block_m else max(8, ((M + 7) // 8) * 8)
+
+
+def _pick_block_f(P: int, F: int, block_m: int) -> int:
+    # Budget the WHOLE working set inside ~14 MB of VMEM, double
+    # buffering the streamed inputs: the packed [P, block_f] int8 strip,
+    # the [block_m, D=2P] bf16 x block, the f32 output tile, and the
+    # gscale sliver (negligible).  The x block is not free: at 14B
+    # w_down shapes (P=8704, D=17408) a block_m=128 x block is 4.5 MB —
+    # strip-only budgeting picked block_f=512 there and overflowed VMEM.
+    x_bytes = 2 * (block_m * 2 * P * 2)
     for cand in (512, 256, 128):
-        if F % cand == 0 and P * cand <= 6 * 1024 * 1024:
+        if F % cand:
+            continue
+        strip = 2 * (P * cand)
+        out_b = block_m * cand * 4
+        if x_bytes + strip + out_b <= 14 * 1024 * 1024:
             return cand
     return 0
 
@@ -84,7 +101,7 @@ def _w4a16_2d(x, q4, gscale, block_m: int, interpret: bool):
     P, F = q4.shape
     num_groups = gscale.shape[0] // 2
     group = P // num_groups
-    block_f = _pick_block_f(P, F)
+    block_f = _pick_block_f(P, F, block_m)
     Mp = ((M + block_m - 1) // block_m) * block_m
     if Mp != M:
         x = jnp.pad(x, ((0, Mp - M), (0, 0)))
@@ -103,9 +120,12 @@ def _w4a16_2d(x, q4, gscale, block_m: int, interpret: bool):
     return out[:M]
 
 
-def w4a16_supported(x_shape, q4_shape, gscale_shape) -> bool:
-    """Static shape check shared with the dense() dispatcher: the kernel
-    needs g | P, a lane-aligned F, and a column strip that fits VMEM."""
+def w4a16_supported(x_shape, q4_shape, gscale_shape, block_m: int = 128) -> bool:
+    """Static shape check used by :func:`w4a16_matmul` before invoking
+    the kernel (``dense()`` gates only on row count / backend / device
+    count and relies on this internal fallback): the kernel needs g | P,
+    a lane-aligned F, and a working set that fits VMEM at the row-block
+    size the call will actually use."""
     D = x_shape[-1]
     P, F = q4_shape
     if D != 2 * P or gscale_shape[0] % 2 or gscale_shape[1] != F:
@@ -116,7 +136,7 @@ def w4a16_supported(x_shape, q4_shape, gscale_shape) -> bool:
     group = P // num_groups
     if group % 128 and group != P:  # sublane-friendly groups
         return False
-    return _pick_block_f(P, F) != 0
+    return _pick_block_f(P, F, _row_block(x_shape[0], block_m)) != 0
 
 
 def w4a16_matmul(x, q4, gscale, block_m: int = 128, interpret: bool = False):
@@ -132,11 +152,10 @@ def w4a16_matmul(x, q4, gscale, block_m: int = 128, interpret: bool = False):
     for s in lead:
         M *= s
     x2 = x.reshape(M, x.shape[-1])
-    if not w4a16_supported(x2.shape, q4.shape, gscale.shape):
+    if not w4a16_supported(x2.shape, q4.shape, gscale.shape, block_m):
         from bcg_tpu.models.quantize import dequantize_int4
 
         w = dequantize_int4({"q4": q4, "gscale": gscale})
         return (x2.astype(jnp.bfloat16) @ w).astype(jnp.float32).reshape(*lead, -1)
-    bm = block_m if M >= block_m else max(8, ((M + 7) // 8) * 8)
-    out = _w4a16_2d(x2, q4, gscale, bm, interpret)
+    out = _w4a16_2d(x2, q4, gscale, _row_block(M, block_m), interpret)
     return out.reshape(*lead, q4.shape[1])

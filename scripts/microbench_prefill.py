@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""Microbenchmark prefill components at game shapes, IN-LOOP.
+
+Round-3 measured prefill at 15.8% MFU while decode sits at 88% of the
+HBM roof — prefill is now the larger half of round time, and the bench
+cannot say WHERE the other 84% goes (the axon tunnel's ~1-2 ms
+dispatch floor hides per-op costs).  Like
+``microbench_decode_attention.py``, every op here runs N times inside
+ONE jitted ``fori_loop`` with a serializing data dependency, so the
+per-iteration number is the in-loop cost.
+
+Measured components at bench-1b layer dims (B=10, L=2048, D=2048,
+H=16/Hkv=8/Dh=128, F=6144):
+
+- each projection matmul in bf16 vs int8 W8A8 (``quantize.dense``:
+  act-quant + int8 dot + rescale) vs int4 W4A16 (XLA dequant fallback —
+  the prefill path of ``dense``),
+- flash-attention prefill (Pallas) vs the blockwise-scan fallback,
+- rope rotation,
+- rmsnorm,
+- a FULL transformer layer via the same primitives chained.
+
+Prints per-op ms/iter, achieved TFLOP/s, and % of the v5e peak for the
+op's dtype (bf16 197 / int8 394 TFLOP/s) so the MFU gap decomposes.
+
+Usage (on the TPU):  python scripts/microbench_prefill.py
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bcg_tpu.models.quantize import dense, quantize_weight, quantize_weight_int4
+from bcg_tpu.ops.attention import blockwise_attention, flash_attention
+
+ITERS = int(os.environ.get("MB_ITERS", "30"))
+PEAK_BF16 = 197e12
+PEAK_INT8 = 394e12
+
+
+def loop_time(body, carry0, iters=ITERS):
+    @jax.jit
+    def run(carry):
+        return jax.lax.fori_loop(0, iters, body, carry)
+
+    out = run(carry0)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = run(carry0)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def feedback(x, out):
+    """Fold a scalar of ``out`` back into ``x`` to serialize iterations."""
+    s = out.astype(jnp.float32).mean() * 1e-20
+    return x + s.astype(x.dtype)
+
+
+def bench_matmul(name, x, w, flops, peak):
+    def body(i, carry):
+        xx, acc = carry
+        out = dense(xx, w)
+        return (feedback(xx, out), acc + out.astype(jnp.float32).mean())
+
+    dt = loop_time(body, (x, jnp.float32(0)))
+    print(f"  {name:<28s} {dt*1e3:7.2f} ms  {flops/dt/1e12:6.1f} TF/s"
+          f"  {100*flops/dt/peak:5.1f}% peak")
+    return dt
+
+
+def main():
+    B = int(os.environ.get("MB_B", "10"))
+    L = int(os.environ.get("MB_L", "2048"))
+    D, H, Hkv, Dh, F = 2048, 16, 8, 128, 6144
+    if os.environ.get("MB_TINY"):  # CPU smoke: shrink every dim
+        B, L, D, H, Hkv, Dh, F = 2, 64, 64, 2, 1, 32, 128
+    S = L  # self-attention over the fresh prompt
+    rng = np.random.default_rng(0)
+    print(f"prefill shapes: B={B} L={L} D={D} H={H} Hkv={Hkv} Dh={Dh} F={F}"
+          f"  ({ITERS} in-loop iterations; backend={jax.default_backend()})")
+
+    x = jnp.asarray(rng.standard_normal((B, L, D)) * 0.02, jnp.bfloat16)
+    BL = B * L
+
+    shapes = {
+        "qkv": (D, (H + 2 * Hkv) * Dh),
+        "o": (H * Dh, D),
+        "gate_up": (D, 2 * F),
+        "down": (F, D),
+    }
+    ws = {k: jnp.asarray(rng.standard_normal(s) * 0.02, jnp.bfloat16)
+          for k, s in shapes.items()}
+
+    total = {"bf16": 0.0, "int8": 0.0, "int4": 0.0}
+    mm_flops = 0
+    for k, (din, dout) in shapes.items():
+        xin = x if din == D else jnp.asarray(
+            rng.standard_normal((B, L, din)) * 0.02, jnp.bfloat16)
+        fl = 2 * BL * din * dout
+        mm_flops += fl
+        total["bf16"] += bench_matmul(f"{k} bf16", xin, ws[k], fl, PEAK_BF16)
+        total["int8"] += bench_matmul(
+            f"{k} int8 W8A8", xin, quantize_weight(ws[k]), fl, PEAK_INT8)
+        total["int4"] += bench_matmul(
+            f"{k} int4 W4A16", xin, quantize_weight_int4(ws[k]), fl, PEAK_BF16)
+
+    # Attention at prefill shapes, causal mask.
+    q = jnp.asarray(rng.standard_normal((B, L, H, Dh)) * 0.1, jnp.bfloat16)
+    k_ = jnp.asarray(rng.standard_normal((B, S, Hkv, Dh)) * 0.1, jnp.bfloat16)
+    v_ = jnp.asarray(rng.standard_normal((B, S, Hkv, Dh)) * 0.1, jnp.bfloat16)
+    causal = jnp.asarray(
+        np.tril(np.ones((L, S), bool))[None].repeat(B, 0))
+    scale = Dh ** -0.5
+    # ~half the score/AV work survives the causal mask.
+    attn_flops = 2 * 2 * B * H * L * S * Dh // 2
+
+    for name, fn in (("flash_attention (Pallas)", flash_attention),
+                     ("blockwise_attention (XLA)", blockwise_attention)):
+        def body(i, carry, fn=fn):
+            qq, acc = carry
+            out = fn(qq, k_, v_, causal, scale)
+            return (feedback(qq, out), acc + out.astype(jnp.float32).mean())
+
+        dt = loop_time(body, (q, jnp.float32(0)))
+        print(f"  {name:<28s} {dt*1e3:7.2f} ms  {attn_flops/dt/1e12:6.1f} TF/s"
+              f"  {100*attn_flops/dt/PEAK_BF16:5.1f}% peak")
+
+    # Rope + rmsnorm (bandwidth-bound elementwise; report ms + GB/s).
+    half = Dh // 2
+    inv = (1.0 / (10000 ** (np.arange(half) / half))).astype(np.float32)
+    pos = np.arange(L, dtype=np.float32)
+    cos = jnp.asarray(np.cos(pos[:, None] * inv[None]))[None, :, None, :]
+    sin = jnp.asarray(np.sin(pos[:, None] * inv[None]))[None, :, None, :]
+
+    def rope_body(i, carry):
+        qq, acc = carry
+        q1, q2 = jnp.split(qq.astype(jnp.float32), 2, axis=-1)
+        rot = jnp.concatenate(
+            [q1 * cos - q2 * sin, q2 * cos + q1 * sin], -1).astype(qq.dtype)
+        return (feedback(qq, rot), acc + rot.astype(jnp.float32).mean())
+
+    dt = loop_time(rope_body, (q, jnp.float32(0)))
+    gb = 2 * q.size * 2 / 1e9
+    print(f"  {'rope (q-side)':<28s} {dt*1e3:7.2f} ms  {gb/dt:6.1f} GB/s")
+
+    g = jnp.ones((D,), jnp.bfloat16)
+
+    def norm_body(i, carry):
+        xx, acc = carry
+        var = jnp.mean(jnp.square(xx.astype(jnp.float32)), -1, keepdims=True)
+        out = (xx.astype(jnp.float32) * jax.lax.rsqrt(var + 1e-6)).astype(
+            xx.dtype) * g
+        return (feedback(xx, out), acc + out.astype(jnp.float32).mean())
+
+    dt = loop_time(norm_body, (x, jnp.float32(0)))
+    gb = 2 * x.size * 2 / 1e9
+    print(f"  {'rmsnorm':<28s} {dt*1e3:7.2f} ms  {gb/dt:6.1f} GB/s")
+
+    layer_flops = mm_flops + attn_flops
+    for mode in ("bf16", "int8", "int4"):
+        dt = total[mode]
+        print(f"  matmuls/layer {mode:<14s} {dt*1e3:7.2f} ms "
+              f" (layer roofline incl attn: "
+              f"{layer_flops/PEAK_BF16*1e3:.2f} ms bf16)")
+
+
+if __name__ == "__main__":
+    main()

@@ -202,3 +202,137 @@ class TestInt4Sharding:
         cache = init_kv_cache(spec, 2, 9)
         logits, _ = prefill(sharded, spec, tokens, valid, cache)
         assert logits.shape == (2, spec.vocab_size)
+
+
+class TestVmemBudget:
+    """_pick_block_f must budget the x block and output tile, not just
+    the packed strip: at 14B w_down shapes a block_m=128 x block alone
+    is 4.5 MB, and strip-only budgeting picked a block_f that overflowed
+    VMEM at compile time on real hardware (round-3 review finding)."""
+
+    def test_14b_wdown_block_shrinks_with_row_block(self):
+        from bcg_tpu.ops.w4_matmul import _pick_block_f
+
+        P, F = 8704, 17408  # 14B w_down: D=17408 -> P=8704
+        # Decode rows (bm=16): the 512-lane strip fits alongside a
+        # small x block.
+        assert _pick_block_f(P, F, 16) == 512
+        # Full row block: 512 lanes + an 8.9 MB double-buffered x block
+        # would exceed VMEM; the picker must back off.
+        assert _pick_block_f(P, F, 128) == 256
+
+    def test_supported_accounts_for_rows(self):
+        P, F = 8704, 17408
+        D = 2 * P
+        gs_shape = (2 * (P // 128), F)
+        assert w4a16_supported((16, D), (P, F), gs_shape)
+        assert w4a16_supported((256, D), (P, F), gs_shape)
+
+    def test_total_budget_within_vmem(self):
+        from bcg_tpu.ops.w4_matmul import _pick_block_f
+
+        for P, F in [(1024, 6144), (2048, 12288), (8704, 17408), (6912, 13824)]:
+            for bm in (8, 16, 64, 128, 256):
+                bf = _pick_block_f(P, F, bm)
+                if bf == 0:
+                    continue
+                working = 2 * (bm * 2 * P * 2) + 2 * (P * bf) + bm * bf * 4
+                assert working <= 14 * 1024 * 1024
+
+
+class TestStackedModeGuard:
+    """Sharing a STACKED pre-quantized tree into an engine whose
+    configured quantization mode differs must raise, exactly like the
+    unstacked guard (round-3 review finding: the stacked branch silently
+    served int8 weights under quantization='int4')."""
+
+    def _stacked_engine(self, mode):
+        cfg = EngineConfig(
+            backend="jax", model_name="bcg-tpu/tiny-test",
+            max_model_len=512, quantization=mode, scan_layers=True,
+        )
+        return JaxEngine(cfg)
+
+    def test_mode_mismatch_raises(self):
+        import pytest
+
+        donor = self._stacked_engine("int8")
+        cfg = EngineConfig(
+            backend="jax", model_name="bcg-tpu/tiny-test",
+            max_model_len=512, quantization="int4",
+        )
+        with pytest.raises(ValueError, match="int8-format"):
+            JaxEngine(cfg, params=donor.params)
+        cfg_none = EngineConfig(
+            backend="jax", model_name="bcg-tpu/tiny-test",
+            max_model_len=512, quantization=None,
+        )
+        with pytest.raises(ValueError, match="int8-format"):
+            JaxEngine(cfg_none, params=donor.params)
+        donor.shutdown()
+
+    def test_mode_match_shares(self):
+        donor = self._stacked_engine("int4")
+        cfg = EngineConfig(
+            backend="jax", model_name="bcg-tpu/tiny-test",
+            max_model_len=512, quantization="int4",
+        )
+        eng = JaxEngine(cfg, params=donor.params)
+        assert eng.scan_layers
+        out = eng.generate("hi", max_tokens=4)
+        assert isinstance(out, str)
+        eng.shutdown()
+        donor.shutdown()
+
+    def test_mismatch_raises_with_scan_recipient(self):
+        """Recipient configs with scan_layers=True must hit the guard
+        too (review finding: the guard lived in a branch only reached
+        when config.scan_layers was False)."""
+        import pytest
+
+        donor = self._stacked_engine("int8")
+        cfg = EngineConfig(
+            backend="jax", model_name="bcg-tpu/tiny-test",
+            max_model_len=512, quantization="int4", scan_layers=True,
+        )
+        with pytest.raises(ValueError, match="int8-format"):
+            JaxEngine(cfg, params=donor.params)
+        donor.shutdown()
+
+    def test_unstacked_quantized_under_none_raises(self):
+        """An UNSTACKED pre-quantized shared tree under
+        quantization=None must raise like the stacked case (review
+        finding: guard coverage diverged purely on stacking layout)."""
+        import pytest
+
+        cfg8 = EngineConfig(
+            backend="jax", model_name="bcg-tpu/tiny-test",
+            max_model_len=512, quantization="int8",
+        )
+        donor = JaxEngine(cfg8)
+        assert not donor.scan_layers
+        cfg_none = EngineConfig(
+            backend="jax", model_name="bcg-tpu/tiny-test",
+            max_model_len=512, quantization=None,
+        )
+        with pytest.raises(ValueError, match="int8-format"):
+            JaxEngine(cfg_none, params=donor.params)
+        donor.shutdown()
+
+    def test_unstacked_bf16_share_into_quantized_ok(self):
+        """Sharing a bf16 unstacked tree into a quantized engine stays
+        supported: the recipient quantizes its own copy."""
+        cfg_none = EngineConfig(
+            backend="jax", model_name="bcg-tpu/tiny-test",
+            max_model_len=512, quantization=None,
+        )
+        donor = JaxEngine(cfg_none)
+        cfg8 = EngineConfig(
+            backend="jax", model_name="bcg-tpu/tiny-test",
+            max_model_len=512, quantization="int8",
+        )
+        eng = JaxEngine(cfg8, params=donor.params)
+        out = eng.generate("hi", max_tokens=4)
+        assert isinstance(out, str)
+        eng.shutdown()
+        donor.shutdown()
