@@ -62,7 +62,16 @@ from bcg_tpu.models.transformer import (
 _LEN_BUCKETS = (512, 1024, 2048, 4096, 6144, 8192)
 # With the system prompt served from the prefix cache, the remaining
 # per-call suffix (round prompt) is much shorter — give it a finer ladder.
-_SUFFIX_BUCKETS = (256, 512, 1024, 2048, 4096, 8192)
+# Decode streams every ALLOCATED slot each step, so pad in the suffix
+# bucket is decode wall-clock: the measured vote suffixes (~2000-2900
+# byte-tokenizer, ~1000-1500 trained-BPE) land just past a rung and pay
+# up to 40% pad traffic on the coarse ladder.  BCG_TPU_FINE_SUFFIX=1
+# adds 1536/3072 rungs — opt-in until the extra compile signatures are
+# A/B-measured on hardware against the pad-traffic saving.
+if os.environ.get("BCG_TPU_FINE_SUFFIX", "") not in ("", "0"):
+    _SUFFIX_BUCKETS = (256, 512, 1024, 1536, 2048, 3072, 4096, 8192)
+else:
+    _SUFFIX_BUCKETS = (256, 512, 1024, 2048, 4096, 8192)
 # Prefix entries are per-run static (one compile each), so an even finer
 # ladder is cheap — and a tight prefix bucket matters doubly, because pad
 # slots in [0, P) are streamed by EVERY subsequent decode step (the BCG
@@ -333,11 +342,13 @@ class JaxEngine(InferenceEngine):
             # quantization exists for.  (A shared *bf16* unstacked tree
             # under a quantized config is fine: it is quantized below
             # like an owned one, without consuming the donor's copy.)
+            from bcg_tpu.models.quantize import is_int4, is_quantized
+
             wq = (self.params["layers"]["wq"] if layers_stacked(self.params)
                   else self.params["layers"][0]["wq"])
             tree_mode = (
-                ("int4" if "q4" in wq else "int8")
-                if isinstance(wq, dict) else None
+                ("int4" if is_int4(wq) else "int8")
+                if is_quantized(wq) else None
             )
             mismatch = tree_mode != quant_mode and not (
                 tree_mode is None and not layers_stacked(self.params)

@@ -42,11 +42,31 @@ run_step() {
     log "DONE $name: $(tail -c 300 "$OUT/$name.json" | tr '\n' ' ')"
     return 0
   fi
-  # Availability failure (hang->timeout, attach error, tunnel death):
-  # leave un-stamped and signal the caller to go back to probing.
-  if [ $rc -eq 124 ] || grep -qiE "unavailable|attach|connection refused|response body closed" \
+  # Availability failure (attach error, tunnel death): leave un-stamped
+  # and signal the caller to go back to probing.
+  if grep -qiE "unavailable|attach|connection refused|response body closed" \
       "$OUT/$name.json" "$OUT/$name.log" 2>/dev/null; then
     log "UNAVAIL $name rc=$rc — back to probing"
+    return 2
+  fi
+  # A timeout can be a mid-step hang (chip died) OR a legitimately slow
+  # step on healthy hardware.  Disambiguate with an immediate re-probe:
+  # a dead chip means an outage timeout (retry forever, like UNAVAIL);
+  # a healthy probe means the step itself is too slow — bound those so
+  # one deterministically-slow step can't wedge the steps behind it.
+  if [ $rc -eq 124 ]; then
+    if ! probe; then
+      log "TIMEOUT $name during outage (probe fails) — back to probing"
+      return 2
+    fi
+    local tmos=$(( $(cat "$OUT/$name.tmo" 2>/dev/null || echo 0) + 1 ))
+    echo "$tmos" > "$OUT/$name.tmo"
+    log "TIMEOUT $name on healthy hardware attempt=$tmos"
+    if [ "$tmos" -ge 3 ]; then
+      touch "$OUT/$name.skip"
+      log "SKIP $name after $tmos healthy-hardware timeouts"
+      return 0  # settled (like .done): drain continues to the next step
+    fi
     return 2
   fi
   local fails=$(( $(cat "$OUT/$name.fails" 2>/dev/null || echo 0) + 1 ))
@@ -55,6 +75,7 @@ run_step() {
   if [ "$fails" -ge 2 ]; then
     touch "$OUT/$name.skip"
     log "SKIP $name after $fails failures"
+    return 0  # settled: drain continues to the next step
   fi
   return 1
 }
@@ -70,6 +91,8 @@ drain() {
     env BENCH_ROUNDS=3 BENCH_CONCURRENCY=2 python bench.py || return $?
   run_step bench_bf16w 1500 '"value"' \
     env BENCH_ROUNDS=3 BENCH_QUANTIZATION=none python bench.py || return $?
+  run_step bench_finesuffix 1500 '"value"' \
+    env BENCH_ROUNDS=3 BCG_TPU_FINE_SUFFIX=1 python bench.py || return $?
   run_step mb_prefill 2400 'rmsnorm' \
     env PYTHONPATH=/root/repo python scripts/microbench_prefill.py || return $?
   run_step mb_decode 2400 'in-loop' \
@@ -91,7 +114,7 @@ drain() {
 all_done() {
   local s
   for s in bench_default bench_int8kv bench_hf1b bench_conc2 bench_bf16w \
-           mb_prefill mb_decode bench_8b bench_14b \
+           bench_finesuffix mb_prefill mb_decode bench_8b bench_14b \
            parity_q1-baseline parity_q1-full parity_q2; do
     [ -e "$OUT/$s.done" ] || [ -e "$OUT/$s.skip" ] || return 1
   done

@@ -35,7 +35,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from bcg_tpu.models.configs import spec_for_model
 from bcg_tpu.models.quantize import dense, quantize_weight, quantize_weight_int4
+from bcg_tpu.models.transformer import apply_rope, rms_norm, rope_table
 from bcg_tpu.ops.attention import blockwise_attention, flash_attention
 
 ITERS = int(os.environ.get("MB_ITERS", "30"))
@@ -77,6 +79,7 @@ def bench_matmul(name, x, w, flops, peak):
 def main():
     B = int(os.environ.get("MB_B", "10"))
     L = int(os.environ.get("MB_L", "2048"))
+    spec = spec_for_model("bcg-tpu/bench-1b")
     D, H, Hkv, Dh, F = 2048, 16, 8, 128, 6144
     if os.environ.get("MB_TINY"):  # CPU smoke: shrink every dim
         B, L, D, H, Hkv, Dh, F = 2, 64, 64, 2, 1, 32, 128
@@ -96,6 +99,11 @@ def main():
     }
     ws = {k: jnp.asarray(rng.standard_normal(s) * 0.02, jnp.bfloat16)
           for k, s in shapes.items()}
+    mode_weights = {
+        "bf16": ws,
+        "int8": {k: quantize_weight(v) for k, v in ws.items()},
+        "int4": {k: quantize_weight_int4(v) for k, v in ws.items()},
+    }
 
     total = {"bf16": 0.0, "int8": 0.0, "int4": 0.0}
     mm_flops = 0
@@ -104,11 +112,12 @@ def main():
             rng.standard_normal((B, L, din)) * 0.02, jnp.bfloat16)
         fl = 2 * BL * din * dout
         mm_flops += fl
-        total["bf16"] += bench_matmul(f"{k} bf16", xin, ws[k], fl, PEAK_BF16)
+        total["bf16"] += bench_matmul(
+            f"{k} bf16", xin, mode_weights["bf16"][k], fl, PEAK_BF16)
         total["int8"] += bench_matmul(
-            f"{k} int8 W8A8", xin, quantize_weight(ws[k]), fl, PEAK_INT8)
+            f"{k} int8 W8A8", xin, mode_weights["int8"][k], fl, PEAK_INT8)
         total["int4"] += bench_matmul(
-            f"{k} int4 W4A16", xin, quantize_weight_int4(ws[k]), fl, PEAK_BF16)
+            f"{k} int4 W4A16", xin, mode_weights["int4"][k], fl, PEAK_BF16)
 
     # Attention at prefill shapes, causal mask.
     q = jnp.asarray(rng.standard_normal((B, L, H, Dh)) * 0.1, jnp.bfloat16)
@@ -131,18 +140,15 @@ def main():
         print(f"  {name:<28s} {dt*1e3:7.2f} ms  {attn_flops/dt/1e12:6.1f} TF/s"
               f"  {100*attn_flops/dt/PEAK_BF16:5.1f}% peak")
 
-    # Rope + rmsnorm (bandwidth-bound elementwise; report ms + GB/s).
-    half = Dh // 2
-    inv = (1.0 / (10000 ** (np.arange(half) / half))).astype(np.float32)
-    pos = np.arange(L, dtype=np.float32)
-    cos = jnp.asarray(np.cos(pos[:, None] * inv[None]))[None, :, None, :]
-    sin = jnp.asarray(np.sin(pos[:, None] * inv[None]))[None, :, None, :]
+    # Rope + rmsnorm via the PRODUCTION ops (transformer.py) at the
+    # spec's constants, so the microbench measures the real code path
+    # (bandwidth-bound elementwise; report ms + GB/s).
+    positions = jnp.broadcast_to(jnp.arange(L), (B, L))
+    cos, sin = rope_table(positions, Dh, spec.rope_theta)
 
     def rope_body(i, carry):
         qq, acc = carry
-        q1, q2 = jnp.split(qq.astype(jnp.float32), 2, axis=-1)
-        rot = jnp.concatenate(
-            [q1 * cos - q2 * sin, q2 * cos + q1 * sin], -1).astype(qq.dtype)
+        rot = apply_rope(qq, cos, sin)
         return (feedback(qq, rot), acc + rot.astype(jnp.float32).mean())
 
     dt = loop_time(rope_body, (q, jnp.float32(0)))
@@ -153,21 +159,51 @@ def main():
 
     def norm_body(i, carry):
         xx, acc = carry
-        var = jnp.mean(jnp.square(xx.astype(jnp.float32)), -1, keepdims=True)
-        out = (xx.astype(jnp.float32) * jax.lax.rsqrt(var + 1e-6)).astype(
-            xx.dtype) * g
+        out = rms_norm(xx, g, spec.rms_eps)
         return (feedback(xx, out), acc + out.astype(jnp.float32).mean())
 
     dt = loop_time(norm_body, (x, jnp.float32(0)))
     gb = 2 * x.size * 2 / 1e9
     print(f"  {'rmsnorm':<28s} {dt*1e3:7.2f} ms  {gb/dt:6.1f} GB/s")
 
+    # FULL layer chained from the same primitives: norm -> qkv -> rope
+    # -> flash attn -> o -> norm -> gate/up -> (silu*mul) -> down, with
+    # residual adds.  The chained number exposes fusion/dispatch gaps
+    # the per-op numbers hide.
+    def full_layer(xx, wmode):
+        w = mode_weights[wmode]
+        h = xx
+        hn = rms_norm(h, g, spec.rms_eps)
+        qkv = dense(hn, w["qkv"])
+        qh = qkv[..., :H * Dh].reshape(B, L, H, Dh)
+        kh = qkv[..., H * Dh:(H + Hkv) * Dh].reshape(B, L, Hkv, Dh)
+        vh = qkv[..., (H + Hkv) * Dh:].reshape(B, L, Hkv, Dh)
+        qh = apply_rope(qh, cos, sin)
+        kh = apply_rope(kh, cos, sin)
+        attn = flash_attention(qh, kh, vh, causal, scale)
+        h = h + dense(attn.reshape(B, L, H * Dh), w["o"])
+        hn = rms_norm(h, g, spec.rms_eps)
+        gu = dense(hn, w["gate_up"])
+        gate, up = jnp.split(gu, 2, axis=-1)
+        act = jax.nn.silu(gate.astype(jnp.float32)).astype(h.dtype) * up
+        return h + dense(act, w["down"])
+
     layer_flops = mm_flops + attn_flops
     for mode in ("bf16", "int8", "int4"):
-        dt = total[mode]
-        print(f"  matmuls/layer {mode:<14s} {dt*1e3:7.2f} ms "
-              f" (layer roofline incl attn: "
-              f"{layer_flops/PEAK_BF16*1e3:.2f} ms bf16)")
+        def body(i, carry, mode=mode):
+            xx, acc = carry
+            out = full_layer(xx, mode)
+            return (feedback(xx, out), acc + out.astype(jnp.float32).mean())
+
+        dt = loop_time(body, (x, jnp.float32(0)))
+        gap = dt - total[mode]
+        print(f"  full layer {mode:<17s} {dt*1e3:7.2f} ms "
+              f" {layer_flops/dt/1e12:6.1f} TF/s "
+              f" (vs sum-of-parts matmuls {total[mode]*1e3:.2f} ms; "
+              f"non-matmul+fusion gap {gap*1e3:.2f} ms)")
+    print(f"  layer matmul-only roofline: {mm_flops/PEAK_BF16*1e3:.2f} ms bf16"
+          f" / {mm_flops/PEAK_INT8*1e3:.2f} ms int8;"
+          f" attn roofline {attn_flops/PEAK_BF16*1e3:.2f} ms bf16")
 
 
 if __name__ == "__main__":

@@ -370,3 +370,32 @@ class TestChunkedPrefill:
                 EngineConfig(backend="jax", model_name="bcg-tpu/tiny-test"),
                 prefill_chunk=-64,
             ))
+
+
+def test_fine_suffix_ladder_env(tmp_path):
+    """BCG_TPU_FINE_SUFFIX=1 adds the 1536/3072 suffix rungs (opt-in:
+    decode streams allocated suffix slots every step, and measured vote
+    suffixes land just past the coarse rungs)."""
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import jax; jax.config.update('jax_platforms','cpu');"
+        "from bcg_tpu.engine import jax_engine as je;"
+        "print(je._SUFFIX_BUCKETS)"
+    )
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env_base = {"PYTHONPATH": repo_root, "PATH": "/usr/bin:/bin"}
+    out_fine = subprocess.run(
+        [sys.executable, "-c", code],
+        env={**env_base, "BCG_TPU_FINE_SUFFIX": "1", "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, check=True,
+    ).stdout
+    assert "1536" in out_fine and "3072" in out_fine
+    out_coarse = subprocess.run(
+        [sys.executable, "-c", code],
+        env={**env_base, "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, check=True,
+    ).stdout
+    assert "1536" not in out_coarse and "3072" not in out_coarse
