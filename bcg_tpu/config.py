@@ -124,6 +124,12 @@ class EngineConfig:
     # depth — required where compile infrastructure rejects 36-layer
     # unrolled 8B programs (this environment's remote-compile helper).
     scan_layers: bool = False
+    # Finer suffix-length buckets (adds 1536/3072 rungs): decode streams
+    # every allocated suffix slot per step, and measured vote suffixes
+    # land just past the coarse rungs (up to 40% pad traffic) — opt-in
+    # until the extra compile signatures are A/B-measured on hardware.
+    # Env BCG_TPU_FINE_SUFFIX=1 also enables it (bench/sweep override).
+    fine_suffix_buckets: bool = False
     attention_impl: str = "auto"  # auto | pallas | xla
     # Fake-backend determinism seed (ignored by the real engine).
     fake_seed: int = 0

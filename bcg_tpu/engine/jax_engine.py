@@ -65,13 +65,13 @@ _LEN_BUCKETS = (512, 1024, 2048, 4096, 6144, 8192)
 # Decode streams every ALLOCATED slot each step, so pad in the suffix
 # bucket is decode wall-clock: the measured vote suffixes (~2000-2900
 # byte-tokenizer, ~1000-1500 trained-BPE) land just past a rung and pay
-# up to 40% pad traffic on the coarse ladder.  BCG_TPU_FINE_SUFFIX=1
-# adds 1536/3072 rungs — opt-in until the extra compile signatures are
-# A/B-measured on hardware against the pad-traffic saving.
-if os.environ.get("BCG_TPU_FINE_SUFFIX", "") not in ("", "0"):
-    _SUFFIX_BUCKETS = (256, 512, 1024, 1536, 2048, 3072, 4096, 8192)
-else:
-    _SUFFIX_BUCKETS = (256, 512, 1024, 2048, 4096, 8192)
+# up to 40% pad traffic on the coarse ladder.  The FINE ladder adds the
+# 1536/3072 rungs — opt-in per engine (EngineConfig.fine_suffix_buckets,
+# or env BCG_TPU_FINE_SUFFIX=1 as the bench/sweep override) until the
+# extra compile signatures are A/B-measured on hardware against the
+# pad-traffic saving.
+_SUFFIX_BUCKETS = (256, 512, 1024, 2048, 4096, 8192)
+_SUFFIX_BUCKETS_FINE = (256, 512, 1024, 1536, 2048, 3072, 4096, 8192)
 # Prefix entries are per-run static (one compile each), so an even finer
 # ladder is cheap — and a tight prefix bucket matters doubly, because pad
 # slots in [0, P) are streamed by EVERY subsequent decode step (the BCG
@@ -323,15 +323,29 @@ class JaxEngine(InferenceEngine):
                 leaf_transform=quantize_leaf_transform(self.spec, quant_mode) if quantize else None,
             )
         else:
-            from bcg_tpu.models.loader import load_checkpoint_params
+            from bcg_tpu.models import artifact
+            from bcg_tpu.models.loader import (
+                find_checkpoint_dir, load_checkpoint_params,
+            )
             from bcg_tpu.models.quantize import quantize_leaf_transform
 
-            # Streamed quantized loading: each weight is quantized as it
-            # arrives so the bf16 model never exists whole on device.
-            self.params = load_checkpoint_params(
-                self.spec, config.model_name, mesh=mesh,
-                leaf_transform=quantize_leaf_transform(self.spec, quant_mode) if quantize else None,
-            )
+            ckpt_dir = find_checkpoint_dir(config.model_name)
+            if artifact.artifact_mode(ckpt_dir) is not None:
+                # Pre-quantized artifact (models/artifact.py): boot skips
+                # both the bf16 shard streaming and the quantization
+                # pass; the load raises on any mode/shape mismatch.
+                self.params = artifact.load_quantized_artifact(
+                    self.spec, ckpt_dir, quant_mode, mesh=mesh
+                )
+            else:
+                # Streamed quantized loading: each weight is quantized as
+                # it arrives so the bf16 model never exists whole on
+                # device.
+                self.params = load_checkpoint_params(
+                    self.spec, config.model_name, mesh=mesh,
+                    leaf_transform=quantize_leaf_transform(self.spec, quant_mode) if quantize else None,
+                    ckpt_dir=ckpt_dir,
+                )
 
         if not owns_params:
             # Constructor-shared tree (weight sharing between engines):
@@ -376,6 +390,16 @@ class JaxEngine(InferenceEngine):
                     self.params, self.spec, consume=owns_params, mode=quant_mode
                 )
             ensure_quantized_head(self.params, self.spec, mode=quant_mode)
+
+        # Per-engine suffix ladder (config field; env var as the
+        # bench/sweep override) — see _SUFFIX_BUCKETS_FINE.
+        env_fine = os.environ.get("BCG_TPU_FINE_SUFFIX", "").strip().lower()
+        self._suffix_buckets = (
+            _SUFFIX_BUCKETS_FINE
+            if (getattr(config, "fine_suffix_buckets", False)
+                or env_fine in ("1", "true", "yes", "on"))
+            else _SUFFIX_BUCKETS
+        )
 
         self.scan_layers = bool(getattr(config, "scan_layers", False))
         if self.scan_layers and not layers_stacked(self.params):
@@ -773,7 +797,7 @@ class JaxEngine(InferenceEngine):
         if not core_toks:
             return None
         Cb = next(
-            (b for b in _SUFFIX_BUCKETS if b >= len(core_toks)),
+            (b for b in self._suffix_buckets if b >= len(core_toks)),
             len(core_toks),
         )
         # Level 1: the system prefix at its own natural rung — bounded so
@@ -941,7 +965,7 @@ class JaxEngine(InferenceEngine):
             return None
 
         tokens, valid, Ls = self._encode_leftpad(
-            [t for _, _, t in rows], limits_s, _SUFFIX_BUCKETS
+            [t for _, _, t in rows], limits_s, self._suffix_buckets
         )
         B = len(rows)
 

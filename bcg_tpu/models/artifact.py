@@ -1,0 +1,287 @@
+"""Pre-quantized checkpoint artifacts: quantize once, serve many.
+
+The reference boots its engine from bf16/fp16 HF shards on every run
+(``vllm_agent.py:100-157``); a quantized deployment there re-quantizes
+at every boot.  This module saves an already-quantized weight tree
+(int8 W8A8 or grouped-int4 W4A16, ``models/quantize.py``) to disk as a
+safetensors artifact and loads it back directly — boot skips both the
+bf16 shard streaming and the quantization pass, and peak memory during
+load is the artifact size (int8: ~half the bf16 checkpoint; int4:
+~a quarter), which is exactly the capacity margin that lets 8B/14B
+models board a 16 GB chip.
+
+Artifact layout (``<dir>/``):
+
+* ``bcg_tpu_quantized.json`` — manifest: format version, quantization
+  mode, model/spec fingerprint, and a logical-dtype map (numpy has no
+  bf16, so bf16 tensors are stored as their uint16 bit patterns — the
+  same convention the HF loader already decodes, ``loader.py:_convert``).
+* ``top.safetensors`` — embed / final_norm / lm_head leaves.
+* ``layer_NNNN.safetensors`` — one file per decoder layer so a large
+  model streams layer-by-layer through host memory in both directions.
+
+Tensors are keyed by logical path ("embed", "layers.3.wq.q", ...) and
+stored in the engine's ``[in, out]`` layout — no transpose on load.
+
+Convert a local HF checkpoint from the command line (CPU works)::
+
+    python -m bcg_tpu.models.artifact --model <name-or-dir> \
+        --mode int8 --out /path/to/artifact
+
+With a ``mesh``, each leaf is placed under its ``param_sharding`` spec
+as it loads (like the HF loader) so tp-sharded large models never
+materialize unsharded on one device.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bcg_tpu.models.configs import ModelSpec, spec_for_model
+
+MANIFEST = "bcg_tpu_quantized.json"
+_FORMAT = "bcg-tpu-quantized-v1"
+
+
+def _to_numpy(x) -> Tuple[np.ndarray, str]:
+    """Device/host array -> (storage ndarray, logical dtype string).
+
+    bf16 is stored as uint16 bit patterns; everything else stores as its
+    own numpy dtype.
+    """
+    arr = np.asarray(jax.device_get(x))
+    if arr.dtype == jnp.bfloat16:
+        return arr.view(np.uint16), "bfloat16"
+    return arr, str(arr.dtype)
+
+
+def _flatten(prefix: str, leaf, out: Dict[str, np.ndarray], dtypes: Dict[str, str]):
+    if isinstance(leaf, dict):  # quantized {"q","scale"} / {"q4","gscale"}
+        for k, v in leaf.items():
+            arr, dt = _to_numpy(v)
+            out[f"{prefix}.{k}"] = arr
+            dtypes[f"{prefix}.{k}"] = dt
+    else:
+        arr, dt = _to_numpy(leaf)
+        out[prefix] = arr
+        dtypes[prefix] = dt
+
+
+def save_quantized_artifact(params: Dict, spec: ModelSpec, mode: str, out_dir: str) -> None:
+    """Write a quantized (unstacked) param tree as a serve-ready artifact.
+
+    ``params`` must be the post-quantization tree the engine serves
+    (``quantize_params`` / streamed ``quantize_leaf_transform`` output,
+    including the explicit ``lm_head`` for tied-embedding models).
+    Stacked (scan-mode) trees are refused — save before stacking; the
+    loading engine re-stacks under its own ``scan_layers`` config.
+    """
+    if mode not in ("int8", "int4"):
+        raise ValueError(f"artifact mode {mode!r}: expected 'int8' or 'int4'")
+    if isinstance(params.get("layers"), dict):
+        raise ValueError(
+            "save_quantized_artifact needs an unstacked tree (list-form "
+            "layers); save before stack_layer_params — the loading engine "
+            "re-stacks under its own scan_layers config"
+        )
+    from safetensors.numpy import save_file
+
+    os.makedirs(out_dir, exist_ok=True)
+    dtypes: Dict[str, str] = {}
+
+    top: Dict[str, np.ndarray] = {}
+    for name in ("embed", "final_norm", "lm_head"):
+        if name in params:
+            _flatten(name, params[name], top, dtypes)
+    save_file(top, os.path.join(out_dir, "top.safetensors"))
+
+    for i, layer in enumerate(params["layers"]):
+        flat: Dict[str, np.ndarray] = {}
+        for k, v in layer.items():
+            _flatten(f"layers.{i}.{k}", v, flat, dtypes)
+        save_file(flat, os.path.join(out_dir, f"layer_{i:04d}.safetensors"))
+
+    manifest = {
+        "format": _FORMAT,
+        "mode": mode,
+        "model": spec.name,
+        "num_layers": spec.num_layers,
+        "hidden_size": spec.hidden_size,
+        "vocab_size": spec.vocab_size,
+        "num_heads": spec.num_heads,
+        "num_kv_heads": spec.num_kv_heads,
+        "head_dim": spec.head_dim,
+        "intermediate_size": spec.intermediate_size,
+        "dtypes": dtypes,
+    }
+    with open(os.path.join(out_dir, MANIFEST), "w") as f:
+        json.dump(manifest, f, indent=1)
+
+
+def artifact_mode(ckpt_dir: Optional[str]) -> Optional[str]:
+    """The quantization mode of the artifact at ``ckpt_dir``, or None if
+    the directory is not a pre-quantized artifact (e.g. a plain HF
+    checkpoint)."""
+    if not ckpt_dir:
+        return None
+    path = os.path.join(ckpt_dir, MANIFEST)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f).get("mode")
+
+
+def load_quantized_artifact(
+    spec: ModelSpec, ckpt_dir: str, mode: str, mesh=None
+) -> Dict:
+    """Load a pre-quantized artifact into the engine's param tree.
+
+    Raises ``ValueError`` when the artifact's mode, model name, or any
+    model dimension doesn't match what the caller configured — a
+    silently mismatched artifact would serve the wrong weights at the
+    wrong capacity (and matching num_layers/hidden/vocab alone can hide
+    a wrong head or MLP split).
+
+    ``mesh``: place each leaf under its ``param_sharding`` spec AS IT
+    LOADS, like the HF loader's ``mesh=`` path — a tp-requiring model
+    (e.g. int8 14B on 16 GB chips) must never materialize unsharded on
+    one device.
+    """
+    with open(os.path.join(ckpt_dir, MANIFEST)) as f:
+        manifest = json.load(f)
+    if manifest.get("format") != _FORMAT:
+        raise ValueError(
+            f"unknown artifact format {manifest.get('format')!r} in {ckpt_dir}"
+        )
+    if manifest["mode"] != mode:
+        raise ValueError(
+            f"artifact at {ckpt_dir} is {manifest['mode']}-quantized but "
+            f"config.quantization={mode!r}; re-quantize or match the config"
+        )
+    if manifest.get("model") != spec.name:
+        raise ValueError(
+            f"artifact at {ckpt_dir} was saved for model "
+            f"{manifest.get('model')!r}, not {spec.name!r}"
+        )
+    for field in (
+        "num_layers", "hidden_size", "vocab_size",
+        "num_heads", "num_kv_heads", "head_dim", "intermediate_size",
+    ):
+        if field in manifest and manifest[field] != getattr(spec, field):
+            raise ValueError(
+                f"artifact {field}={manifest[field]} does not match "
+                f"spec {spec.name!r} ({getattr(spec, field)})"
+            )
+    from safetensors import safe_open
+
+    sharding_for = None
+    if mesh is not None:
+        from bcg_tpu.parallel.sharding import param_sharding
+
+        sharding_for = lambda logical: param_sharding(logical, spec, mesh)  # noqa: E731
+
+    dtypes = manifest["dtypes"]
+
+    def restore(name: str, arr: np.ndarray):
+        if dtypes.get(name) == "bfloat16":
+            t = jax.lax.bitcast_convert_type(
+                jnp.asarray(arr.view(np.uint16)), jnp.bfloat16
+            )
+        else:
+            t = jnp.asarray(arr)
+        if sharding_for is not None:
+            t = jax.device_put(t, sharding_for(name))
+        return t
+
+    def read_file(path: str) -> Dict[str, jax.Array]:
+        flat: Dict[str, jax.Array] = {}
+        with safe_open(path, framework="numpy") as f:
+            for name in f.keys():
+                flat[name] = restore(name, f.get_tensor(name))
+        return flat
+
+    def unflatten(flat: Dict[str, jax.Array], strip: str) -> Dict:
+        """Group "wq.q"-style names back into {"wq": {"q": ...}}."""
+        out: Dict = {}
+        for name, v in flat.items():
+            rel = name[len(strip):] if strip and name.startswith(strip) else name
+            parts = rel.split(".")
+            if len(parts) == 1:
+                out[parts[0]] = v
+            else:
+                out.setdefault(parts[0], {})[parts[1]] = v
+        return out
+
+    params: Dict = unflatten(read_file(os.path.join(ckpt_dir, "top.safetensors")), "")
+    layers = []
+    for i in range(spec.num_layers):
+        path = os.path.join(ckpt_dir, f"layer_{i:04d}.safetensors")
+        layers.append(unflatten(read_file(path), f"layers.{i}."))
+    params["layers"] = layers
+    return params
+
+
+# Non-weight files a serve-ready artifact must carry along (the engine
+# boots the tokenizer/template from the same directory, exactly like
+# real pre-quantized hub repos ship tokenizer + config beside weights).
+_SIDECAR_FILES = (
+    "config.json",
+    "generation_config.json",
+    "tokenizer.json",
+    "tokenizer_config.json",
+    "special_tokens_map.json",
+    "vocab.json",
+    "merges.txt",
+    "tokenizer.model",
+)
+
+
+def convert_checkpoint(model: str, mode: str, out_dir: str) -> None:
+    """HF safetensors checkpoint -> pre-quantized artifact (streamed:
+    each weight is quantized as it loads, so the bf16 tree never exists
+    whole — the same discipline as engine boot).  Tokenizer and config
+    sidecar files are copied so the artifact directory is a complete,
+    bootable checkpoint."""
+    import shutil
+
+    from bcg_tpu.models.loader import find_checkpoint_dir, load_checkpoint_params
+    from bcg_tpu.models.quantize import (
+        ensure_quantized_head, quantize_leaf_transform,
+    )
+
+    spec = spec_for_model(model)
+    src_dir = find_checkpoint_dir(model)
+    params = load_checkpoint_params(
+        spec, model, leaf_transform=quantize_leaf_transform(spec, mode)
+    )
+    ensure_quantized_head(params, spec, mode=mode)
+    save_quantized_artifact(params, spec, mode, out_dir)
+    if src_dir:
+        for fname in _SIDECAR_FILES:
+            src = os.path.join(src_dir, fname)
+            if os.path.exists(src):
+                shutil.copy2(src, os.path.join(out_dir, fname))
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(
+        description="Convert a local HF checkpoint to a pre-quantized "
+        "bcg-tpu artifact (quantize once, serve many)"
+    )
+    p.add_argument("--model", required=True, help="model name or checkpoint dir")
+    p.add_argument("--mode", default="int8", choices=["int8", "int4"])
+    p.add_argument("--out", required=True, help="artifact output directory")
+    args = p.parse_args(argv)
+    convert_checkpoint(args.model, args.mode, args.out)
+    print(f"saved {args.mode} artifact for {args.model} at {args.out}")
+
+
+if __name__ == "__main__":
+    main()

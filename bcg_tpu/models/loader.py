@@ -79,6 +79,7 @@ def load_checkpoint_params(
     mesh=None,
     dtype=jnp.bfloat16,
     leaf_transform=None,
+    ckpt_dir: Optional[str] = None,
 ) -> Dict:
     """Load and (optionally) shard all parameters for ``spec``.
 
@@ -86,8 +87,11 @@ def load_checkpoint_params(
     tensor right after device placement — e.g. streamed int8 quantization
     (models/quantize.py:quantize_leaf_transform), which keeps peak device
     memory at the final model size instead of bf16 + quantized copies.
+    ``ckpt_dir``: a pre-resolved checkpoint directory (skips the
+    candidate walk a caller already did via :func:`find_checkpoint_dir`).
     """
-    ckpt_dir = find_checkpoint_dir(model_name)
+    if ckpt_dir is None:
+        ckpt_dir = find_checkpoint_dir(model_name)
     if ckpt_dir is None:
         raise FileNotFoundError(
             f"No local safetensors checkpoint found for {model_name!r} "

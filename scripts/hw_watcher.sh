@@ -12,6 +12,7 @@
 set -u
 cd /root/repo
 OUT=results/hw_r3b
+declare -A TMO
 LOG=$OUT/watcher.log
 mkdir -p "$OUT"
 
@@ -59,8 +60,12 @@ run_step() {
       log "TIMEOUT $name during outage (probe fails) — back to probing"
       return 2
     fi
-    local tmos=$(( $(cat "$OUT/$name.tmo" 2>/dev/null || echo 0) + 1 ))
-    echo "$tmos" > "$OUT/$name.tmo"
+    # In-memory counter (not a stamp file): an outage that ends just
+    # before the re-probe would be misattributed as a healthy-hardware
+    # timeout, and persisting that across watcher restarts could
+    # permanently skip a healthy step after a few flappy windows.
+    TMO[$name]=$(( ${TMO[$name]:-0} + 1 ))
+    local tmos=${TMO[$name]}
     log "TIMEOUT $name on healthy hardware attempt=$tmos"
     if [ "$tmos" -ge 3 ]; then
       touch "$OUT/$name.skip"
@@ -132,6 +137,10 @@ while true; do
     log "drain interrupted rc=$rc"
   else
     log "probe failed (tpu not ready)"
+    # An observed outage invalidates the healthy-timeout attribution:
+    # any step timeout counted during a flappy window may have been the
+    # outage's fault, so start the 3-strike count over.
+    TMO=()
   fi
   sleep 300
 done

@@ -1,0 +1,188 @@
+"""Pre-quantized checkpoint artifacts (models/artifact.py).
+
+The reference re-quantizes (or re-loads bf16) at every engine boot
+(vllm_agent.py:100-157); the artifact path saves the quantized tree
+once and boots straight from it.  Properties pinned here:
+
+* convert -> load round-trips the exact quantized tree (int8 and int4:
+  quantized payloads and scales bit-identical, bf16 leaves bit-identical);
+* the engine boots from an artifact directory and serves schema-valid
+  JSON, with logits identical to a streamed-quantization boot;
+* mode/shape mismatches raise instead of silently serving the wrong
+  weights;
+* stacked (scan-mode) trees are refused at save time.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bcg_tpu.config import EngineConfig
+from bcg_tpu.engine.jax_engine import JaxEngine
+from bcg_tpu.models.artifact import (
+    MANIFEST,
+    artifact_mode,
+    convert_checkpoint,
+    load_quantized_artifact,
+    save_quantized_artifact,
+)
+from bcg_tpu.models.configs import spec_for_model
+from bcg_tpu.models.hf_fixture import build_checkpoint
+from bcg_tpu.models.loader import load_checkpoint_params
+from bcg_tpu.models.quantize import (
+    ensure_quantized_head,
+    quantize_leaf_transform,
+)
+
+TINY = "bcg-hf/tiny"
+
+
+@pytest.fixture(scope="module")
+def hf_checkpoint(tmp_path_factory):
+    root = tmp_path_factory.mktemp("artifact_src")
+    return build_checkpoint(TINY, out_dir=str(root / "bcg-hf--tiny"))
+
+
+def _streamed_tree(mode):
+    spec = spec_for_model(TINY)
+    params = load_checkpoint_params(
+        spec, TINY, leaf_transform=quantize_leaf_transform(spec, mode)
+    )
+    return ensure_quantized_head(params, spec, mode=mode), spec
+
+
+def _assert_leaf_equal(a, b, name):
+    if isinstance(a, dict):
+        assert isinstance(b, dict), name
+        assert set(a) == set(b), name
+        for k in a:
+            _assert_leaf_equal(a[k], b[k], f"{name}.{k}")
+        return
+    an, bn = np.asarray(a), np.asarray(b)
+    assert an.dtype == bn.dtype, f"{name}: {an.dtype} != {bn.dtype}"
+    assert an.shape == bn.shape, f"{name}: {an.shape} != {bn.shape}"
+    np.testing.assert_array_equal(an, bn, err_msg=name)
+
+
+@pytest.mark.parametrize("mode", ["int8", "int4"])
+def test_round_trip_exact(hf_checkpoint, monkeypatch, tmp_path, mode):
+    monkeypatch.setenv("BCG_TPU_CHECKPOINT_DIR", os.path.dirname(hf_checkpoint))
+    original, spec = _streamed_tree(mode)
+    out = str(tmp_path / f"artifact-{mode}")
+    save_quantized_artifact(original, spec, mode, out)
+    assert artifact_mode(out) == mode
+
+    loaded = load_quantized_artifact(spec, out, mode)
+    assert set(loaded) == set(original)
+    for name in original:
+        if name == "layers":
+            continue
+        _assert_leaf_equal(original[name], loaded[name], name)
+    assert len(loaded["layers"]) == len(original["layers"])
+    for i, (la, lb) in enumerate(zip(original["layers"], loaded["layers"])):
+        assert set(la) == set(lb)
+        for k in la:
+            _assert_leaf_equal(la[k], lb[k], f"layers.{i}.{k}")
+
+
+def test_convert_cli_and_engine_boot(hf_checkpoint, monkeypatch, tmp_path):
+    monkeypatch.setenv("BCG_TPU_CHECKPOINT_DIR", os.path.dirname(hf_checkpoint))
+    art = str(tmp_path / "art")
+    convert_checkpoint(TINY, "int8", art)
+
+    cfg = EngineConfig(
+        backend="jax", model_name=TINY, max_model_len=512, quantization="int8",
+    )
+    ref_engine = JaxEngine(cfg)
+    ref_params = ref_engine.params
+
+    # Point discovery at the artifact instead of the HF checkpoint.
+    parent = str(tmp_path / "artroot")
+    os.makedirs(parent, exist_ok=True)
+    os.rename(art, os.path.join(parent, "bcg-hf--tiny"))
+    monkeypatch.setenv("BCG_TPU_CHECKPOINT_DIR", parent)
+
+    eng = JaxEngine(cfg)
+    # Identical weights -> identical serving behavior.
+    for i, layer in enumerate(ref_params["layers"]):
+        for k in layer:
+            _assert_leaf_equal(layer[k], eng.params["layers"][i][k], f"layers.{i}.{k}")
+    schema = {
+        "type": "object",
+        "properties": {"value": {"type": "integer", "minimum": 0, "maximum": 9}},
+        "required": ["value"],
+        "additionalProperties": False,
+    }
+    out = eng.generate_json("pick", schema, temperature=0.5, max_tokens=16)
+    assert isinstance(out.get("value"), int)
+    eng.shutdown()
+    ref_engine.shutdown()
+
+
+def test_mode_mismatch_raises(hf_checkpoint, monkeypatch, tmp_path):
+    monkeypatch.setenv("BCG_TPU_CHECKPOINT_DIR", os.path.dirname(hf_checkpoint))
+    original, spec = _streamed_tree("int8")
+    out = str(tmp_path / "a8")
+    save_quantized_artifact(original, spec, "int8", out)
+    with pytest.raises(ValueError, match="int8-quantized"):
+        load_quantized_artifact(spec, out, "int4")
+    with pytest.raises(ValueError, match="int8-quantized"):
+        load_quantized_artifact(spec, out, None)
+
+
+def test_engine_mode_mismatch_raises(hf_checkpoint, monkeypatch, tmp_path):
+    monkeypatch.setenv("BCG_TPU_CHECKPOINT_DIR", os.path.dirname(hf_checkpoint))
+    parent = str(tmp_path / "root")
+    convert_checkpoint(TINY, "int8", os.path.join(parent, "bcg-hf--tiny"))
+    monkeypatch.setenv("BCG_TPU_CHECKPOINT_DIR", parent)
+    cfg = EngineConfig(
+        backend="jax", model_name=TINY, max_model_len=512, quantization="int4",
+    )
+    with pytest.raises(ValueError, match="int8-quantized"):
+        JaxEngine(cfg)
+
+
+def test_shape_mismatch_raises(hf_checkpoint, monkeypatch, tmp_path):
+    monkeypatch.setenv("BCG_TPU_CHECKPOINT_DIR", os.path.dirname(hf_checkpoint))
+    original, spec = _streamed_tree("int8")
+    out = str(tmp_path / "a8")
+    save_quantized_artifact(original, spec, "int8", out)
+    other = spec_for_model("bcg-hf/bench-1b")
+    with pytest.raises(ValueError, match="was saved for model"):
+        load_quantized_artifact(other, out, "int8")
+    # Same name, different dims (e.g. a stale artifact after a spec
+    # edit) must hit the dimension check.
+    import dataclasses
+
+    drifted = dataclasses.replace(spec, intermediate_size=spec.intermediate_size * 2)
+    with pytest.raises(ValueError, match="does not match"):
+        load_quantized_artifact(drifted, out, "int8")
+
+
+def test_stacked_tree_refused(hf_checkpoint, monkeypatch, tmp_path):
+    from bcg_tpu.models.transformer import stack_layer_params
+
+    monkeypatch.setenv("BCG_TPU_CHECKPOINT_DIR", os.path.dirname(hf_checkpoint))
+    original, spec = _streamed_tree("int8")
+    stacked = stack_layer_params(original)
+    with pytest.raises(ValueError, match="unstacked"):
+        save_quantized_artifact(stacked, spec, "int8", str(tmp_path / "x"))
+
+
+def test_manifest_contents(hf_checkpoint, monkeypatch, tmp_path):
+    monkeypatch.setenv("BCG_TPU_CHECKPOINT_DIR", os.path.dirname(hf_checkpoint))
+    original, spec = _streamed_tree("int4")
+    out = str(tmp_path / "a4")
+    save_quantized_artifact(original, spec, "int4", out)
+    with open(os.path.join(out, MANIFEST)) as f:
+        m = json.load(f)
+    assert m["mode"] == "int4"
+    assert m["num_layers"] == spec.num_layers
+    # int4 leaves record packed int8 payloads + bf16 group scales.
+    assert m["dtypes"]["layers.0.wq.q4"] == "int8"
+    assert m["dtypes"]["layers.0.wq.gscale"] == "bfloat16"
+    assert m["dtypes"]["embed"] == "bfloat16"

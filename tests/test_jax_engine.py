@@ -372,30 +372,32 @@ class TestChunkedPrefill:
             ))
 
 
-def test_fine_suffix_ladder_env(tmp_path):
-    """BCG_TPU_FINE_SUFFIX=1 adds the 1536/3072 suffix rungs (opt-in:
-    decode streams allocated suffix slots every step, and measured vote
-    suffixes land just past the coarse rungs)."""
-    import os
-    import subprocess
-    import sys
+def test_fine_suffix_ladder_config(monkeypatch):
+    """EngineConfig.fine_suffix_buckets selects the 1536/3072-rung
+    ladder PER ENGINE (opt-in: decode streams allocated suffix slots
+    every step, and measured vote suffixes land just past the coarse
+    rungs); env BCG_TPU_FINE_SUFFIX=1 is the bench/sweep override."""
+    import dataclasses
 
-    code = (
-        "import jax; jax.config.update('jax_platforms','cpu');"
-        "from bcg_tpu.engine import jax_engine as je;"
-        "print(je._SUFFIX_BUCKETS)"
+    from bcg_tpu.config import EngineConfig
+    from bcg_tpu.engine.jax_engine import JaxEngine
+
+    monkeypatch.delenv("BCG_TPU_FINE_SUFFIX", raising=False)
+    base = EngineConfig(
+        backend="jax", model_name="bcg-tpu/tiny-test", max_model_len=512,
     )
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env_base = {"PYTHONPATH": repo_root, "PATH": "/usr/bin:/bin"}
-    out_fine = subprocess.run(
-        [sys.executable, "-c", code],
-        env={**env_base, "BCG_TPU_FINE_SUFFIX": "1", "JAX_PLATFORMS": "cpu"},
-        capture_output=True, text=True, check=True,
-    ).stdout
-    assert "1536" in out_fine and "3072" in out_fine
-    out_coarse = subprocess.run(
-        [sys.executable, "-c", code],
-        env={**env_base, "JAX_PLATFORMS": "cpu"},
-        capture_output=True, text=True, check=True,
-    ).stdout
-    assert "1536" not in out_coarse and "3072" not in out_coarse
+    coarse = JaxEngine(base)
+    fine = JaxEngine(
+        dataclasses.replace(base, fine_suffix_buckets=True),
+        params=coarse.params,
+    )
+    assert 1536 not in coarse._suffix_buckets
+    assert 3072 not in coarse._suffix_buckets
+    assert 1536 in fine._suffix_buckets and 3072 in fine._suffix_buckets
+
+    monkeypatch.setenv("BCG_TPU_FINE_SUFFIX", "1")
+    via_env = JaxEngine(base, params=coarse.params)
+    assert 1536 in via_env._suffix_buckets
+    via_env.shutdown()
+    fine.shutdown()
+    coarse.shutdown()
