@@ -259,7 +259,8 @@ def convert_checkpoint(model: str, mode: str, out_dir: str) -> None:
     spec = spec_for_model(model)
     src_dir = find_checkpoint_dir(model)
     params = load_checkpoint_params(
-        spec, model, leaf_transform=quantize_leaf_transform(spec, mode)
+        spec, model, leaf_transform=quantize_leaf_transform(spec, mode),
+        ckpt_dir=src_dir,
     )
     ensure_quantized_head(params, spec, mode=mode)
     save_quantized_artifact(params, spec, mode, out_dir)

@@ -106,6 +106,8 @@ def _run_attempt(cfg, model: str, backend: str, concurrency: int,
     engine/runtime failure — the caller decides whether to retry."""
     from bcg_tpu.runtime.orchestrator import BCGSimulation
 
+    t_boot0 = time.perf_counter()
+    first_round_s = None  # boot + compile + first full round (cold cost)
     sim = BCGSimulation(config=cfg)
     n_agents = cfg.game.num_honest + cfg.game.num_byzantine
     engine = sim.engine  # reuse across games: compiled loops persist
@@ -180,6 +182,8 @@ def _run_attempt(cfg, model: str, backend: str, concurrency: int,
         warmed, saw_round2 = 0, False
         while warmed < warmup_rounds or not saw_round2:
             run_wave(sims)
+            if first_round_s is None:
+                first_round_s = time.perf_counter() - t_boot0
             warmed += 1
             saw_round2 = saw_round2 or any(
                 len(s.game.rounds) >= 2 for s in sims
@@ -212,6 +216,8 @@ def _run_attempt(cfg, model: str, backend: str, concurrency: int,
                 sim = fresh_sim(warm_seed)
                 warm_seed += 1
             sim.run_round()
+            if first_round_s is None:
+                first_round_s = time.perf_counter() - t_boot0
             warmed += 1
             saw_round2 = saw_round2 or len(sim.game.rounds) >= 2
             if warmed >= warmup_rounds + 6:  # pathological termination streak
@@ -336,6 +342,11 @@ def _run_attempt(cfg, model: str, backend: str, concurrency: int,
             "shared_core_votes": cfg.agent.shared_core_votes,
             "platform": platform,
             "elapsed_sec": round(elapsed, 2),
+            # Cold cost: engine build + weight init/load + first-round
+            # compiles + the first full round (time-to-first-decision).
+            "boot_plus_first_round_s": (
+                round(first_round_s, 2) if first_round_s is not None else None
+            ),
             "window_decode_steps": window_steps,
             "window_failed_row_fraction": round(failed_fraction, 4),
             "baseline_note": "denominator is an ESTIMATED reference rate "

@@ -135,6 +135,10 @@ while true; do
     rc=$?
     [ $rc -eq 0 ] && continue
     log "drain interrupted rc=$rc"
+    # rc=2 means an outage was observed mid-drain (UNAVAIL or a
+    # timeout whose re-probe failed): same invalidation as a failed
+    # top-level probe — healthy-timeout attribution starts over.
+    [ $rc -eq 2 ] && TMO=()
   else
     log "probe failed (tpu not ready)"
     # An observed outage invalidates the healthy-timeout attribution:

@@ -186,3 +186,25 @@ def test_manifest_contents(hf_checkpoint, monkeypatch, tmp_path):
     assert m["dtypes"]["layers.0.wq.q4"] == "int8"
     assert m["dtypes"]["layers.0.wq.gscale"] == "bfloat16"
     assert m["dtypes"]["embed"] == "bfloat16"
+
+
+def test_mesh_sharded_artifact_load(hf_checkpoint, monkeypatch, tmp_path):
+    """With a mesh, artifact leaves land under their param_sharding
+    placement AS THEY LOAD (a tp-requiring model must never
+    materialize unsharded on one device)."""
+    from bcg_tpu.parallel.mesh import build_mesh
+    from bcg_tpu.parallel.sharding import param_sharding
+
+    monkeypatch.setenv("BCG_TPU_CHECKPOINT_DIR", os.path.dirname(hf_checkpoint))
+    original, spec = _streamed_tree("int8")
+    out = str(tmp_path / "a8")
+    save_quantized_artifact(original, spec, "int8", out)
+
+    mesh = build_mesh(tp=2, dp=1, sp=1)
+    loaded = load_quantized_artifact(spec, out, "int8", mesh=mesh)
+    wq = loaded["layers"][0]["wq"]
+    assert wq["q"].sharding == param_sharding("layers.0.wq.q", spec, mesh)
+    assert wq["scale"].sharding == param_sharding("layers.0.wq.scale", spec, mesh)
+    assert loaded["embed"].sharding == param_sharding("embed", spec, mesh)
+    # Values unchanged by placement.
+    _assert_leaf_equal(original["layers"][0]["wq"], wq, "layers.0.wq")
