@@ -40,6 +40,7 @@ group straddles the halves (group shrinks via gcd for tiny test dims).
 from __future__ import annotations
 
 import math
+import os
 from functools import partial
 from typing import Dict, Union
 
@@ -161,6 +162,19 @@ def is_int4(w: DenseWeight) -> bool:
     return isinstance(w, dict) and "q4" in w
 
 
+def _w8a16_prefill_rows() -> int:
+    """Row threshold for the experimental W8A16 prefill path (0 = off).
+
+    Read from the environment at TRACE time (first call per shape
+    signature), not import time, so tests can monkeypatch it; it is a
+    bench A/B knob, not a per-engine config field — if the hardware A/B
+    wins it becomes an unconditional shape dispatch like int4's."""
+    try:
+        return int(os.environ.get("BCG_TPU_W8A16_PREFILL", "0"))
+    except ValueError:
+        return 0
+
+
 def dense(x: jax.Array, w: DenseWeight, out_dtype=None) -> jax.Array:
     """``x @ w`` where ``w`` is bf16 or a quantized dict.
 
@@ -173,6 +187,9 @@ def dense(x: jax.Array, w: DenseWeight, out_dtype=None) -> jax.Array:
         out_dtype = x.dtype
     if not is_quantized(w):
         return (x @ w).astype(out_dtype)
+    rows = 1
+    for s in x.shape[:-1]:
+        rows *= s
     if is_int4(w):
         # W4A16: dequantize to bf16, dot on the MXU.  Path choice is by
         # row count: DECODE shapes (few rows) take the Pallas kernel —
@@ -182,9 +199,6 @@ def dense(x: jax.Array, w: DenseWeight, out_dtype=None) -> jax.Array:
         # in HBM once per call, which beats the kernel's per-M-block
         # weight re-streaming when the materialization is amortized
         # over thousands of rows (and prefill is compute-bound anyway).
-        rows = 1
-        for s in x.shape[:-1]:
-            rows *= s
         # Kernel only on a SINGLE device: pallas_call has no SPMD
         # partitioning rule, so under a tp/dp mesh GSPMD would have to
         # replicate (all-gather) the packed weight per call — the XLA
@@ -194,6 +208,18 @@ def dense(x: jax.Array, w: DenseWeight, out_dtype=None) -> jax.Array:
 
             return w4a16_matmul(x, w["q4"], w["gscale"]).astype(out_dtype)
         return (x.astype(jnp.bfloat16) @ dequantize_int4(w)).astype(out_dtype)
+    # EXPERIMENTAL A/B knob (BCG_TPU_W8A16_PREFILL=<row threshold>):
+    # at/above the threshold, skip the dynamic activation quantization
+    # and run dequantized int8 -> bf16 x bf16 on the MXU instead
+    # (W8A16).  Rationale: prefill-shaped matmuls (thousands of rows)
+    # measured only ~16% MFU under W8A8 — if the per-row act-quant +
+    # f32 rescale chain (VPU-bound elementwise over the full activation)
+    # is the tax, W8A16 trades 2x MXU rate for its removal while keeping
+    # the int8 weight memory.  0 (default) = off; promote to a plain
+    # shape dispatch (like int4's) if hardware A/B wins.
+    if 0 < _w8a16_prefill_rows() <= rows:
+        w_bf = (w["q"].astype(jnp.float32) * w["scale"]).astype(jnp.bfloat16)
+        return (x.astype(jnp.bfloat16) @ w_bf).astype(out_dtype)
     x32 = x.astype(jnp.float32)
     a_absmax = jnp.max(jnp.abs(x32), axis=-1, keepdims=True)
     a_scale = jnp.maximum(a_absmax, 1e-12) / 127.0

@@ -72,7 +72,7 @@ run_step() {
       log "SKIP $name after $tmos healthy-hardware timeouts"
       return 0  # settled (like .done): drain continues to the next step
     fi
-    return 2
+    return 3  # healthy-hardware timeout: re-probe, but DON'T reset TMO
   fi
   local fails=$(( $(cat "$OUT/$name.fails" 2>/dev/null || echo 0) + 1 ))
   echo "$fails" > "$OUT/$name.fails"
@@ -98,6 +98,8 @@ drain() {
     env BENCH_ROUNDS=3 BENCH_QUANTIZATION=none python bench.py || return $?
   run_step bench_finesuffix 1500 '"value"' \
     env BENCH_ROUNDS=3 BCG_TPU_FINE_SUFFIX=1 python bench.py || return $?
+  run_step bench_w8a16 1500 '"value"' \
+    env BENCH_ROUNDS=3 BCG_TPU_W8A16_PREFILL=512 python bench.py || return $?
   run_step mb_prefill 2400 'rmsnorm' \
     env PYTHONPATH=/root/repo python scripts/microbench_prefill.py || return $?
   run_step mb_decode 2400 'in-loop' \
@@ -119,7 +121,8 @@ drain() {
 all_done() {
   local s
   for s in bench_default bench_int8kv bench_hf1b bench_conc2 bench_bf16w \
-           bench_finesuffix mb_prefill mb_decode bench_8b bench_14b \
+           bench_finesuffix bench_w8a16 mb_prefill mb_decode \
+           bench_8b bench_14b \
            parity_q1-baseline parity_q1-full parity_q2; do
     [ -e "$OUT/$s.done" ] || [ -e "$OUT/$s.skip" ] || return 1
   done
@@ -138,6 +141,8 @@ while true; do
     # rc=2 means an outage was observed mid-drain (UNAVAIL or a
     # timeout whose re-probe failed): same invalidation as a failed
     # top-level probe — healthy-timeout attribution starts over.
+    # rc=3 (healthy-hardware timeout) keeps its count: wiping it here
+    # would make the 3-strike skip unreachable.
     [ $rc -eq 2 ] && TMO=()
   else
     log "probe failed (tpu not ready)"

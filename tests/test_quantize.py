@@ -125,3 +125,39 @@ class TestQuantizedSharding:
         cache = init_kv_cache(spec, 2, 9)
         logits, _ = prefill(sharded, spec, tokens, valid, cache)
         assert logits.shape == (2, spec.vocab_size)
+
+
+class TestW8A16Prefill:
+    """Experimental BCG_TPU_W8A16_PREFILL row-threshold dispatch:
+    at/above the threshold dense() skips activation quantization and
+    multiplies the dequantized bf16 weight directly (W8A16)."""
+
+    def test_matches_explicit_dequant(self, monkeypatch):
+        import numpy as np
+
+        from bcg_tpu.models.quantize import dense, quantize_weight
+
+        monkeypatch.setenv("BCG_TPU_W8A16_PREFILL", "4")
+        rng = np.random.default_rng(0)
+        w = jnp.asarray(rng.standard_normal((32, 16)) * 0.1, jnp.bfloat16)
+        qw = quantize_weight(w)
+        x = jnp.asarray(rng.standard_normal((8, 32)) * 0.5, jnp.bfloat16)
+        got = dense(x, qw)
+        w_bf = (qw["q"].astype(jnp.float32) * qw["scale"]).astype(jnp.bfloat16)
+        want = (x.astype(jnp.bfloat16) @ w_bf).astype(x.dtype)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_below_threshold_keeps_w8a8(self, monkeypatch):
+        import numpy as np
+
+        from bcg_tpu.models.quantize import dense, quantize_weight
+
+        rng = np.random.default_rng(1)
+        w = jnp.asarray(rng.standard_normal((32, 16)) * 0.1, jnp.bfloat16)
+        qw = quantize_weight(w)
+        x = jnp.asarray(rng.standard_normal((2, 32)) * 0.5, jnp.bfloat16)
+        monkeypatch.delenv("BCG_TPU_W8A16_PREFILL", raising=False)
+        base = np.asarray(dense(x, qw))
+        monkeypatch.setenv("BCG_TPU_W8A16_PREFILL", "1000")
+        below = np.asarray(dense(x, qw))
+        np.testing.assert_array_equal(base, below)
