@@ -55,6 +55,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="KV cache storage dtype (int8 halves decode cache traffic)")
     p.add_argument("--no-prefix-caching", action="store_true",
                    help="Disable system-prompt KV prefix caching")
+    p.add_argument("--fine-suffix-buckets", action="store_true",
+                   help="Finer suffix-length buckets (1536/3072 rungs): less pad "
+                        "traffic in the decode window, more compile signatures")
     p.add_argument("--scan-layers", action="store_true",
                    help="Run the layer stack as one lax.scan (O(1)-in-depth program; "
                         "needed for 8B-class compiles)")
@@ -121,6 +124,8 @@ def config_from_args(args) -> BCGConfig:
         engine = dataclasses.replace(engine, prefix_caching=False)
     if args.scan_layers:
         engine = dataclasses.replace(engine, scan_layers=True)
+    if args.fine_suffix_buckets:
+        engine = dataclasses.replace(engine, fine_suffix_buckets=True)
     if args.fast_forward:
         engine = dataclasses.replace(engine, decode_fast_forward=True)
     if args.compact_json:

@@ -94,6 +94,22 @@ drain() {
     env BENCH_ROUNDS=3 BENCH_MODEL=bcg-hf/bench-1b python bench.py || return $?
   run_step bench_conc2 1800 '"value"' \
     env BENCH_ROUNDS=3 BENCH_CONCURRENCY=2 python bench.py || return $?
+  run_step art_convert 1200 'saved int8 artifact' \
+    env PYTHONPATH=/root/repo python -m bcg_tpu.models.artifact \
+      --model bcg-hf/bench-1b --mode int8 \
+      --out checkpoints_q/bcg-hf--bench-1b || return $?
+  # Gated on the artifact actually existing: without it the env dir is
+  # skipped by checkpoint discovery and the bench would silently
+  # re-measure the plain HF boot path and stamp a bogus .done.
+  if [ -e "$OUT/art_convert.done" ] \
+      && [ -f checkpoints_q/bcg-hf--bench-1b/bcg_tpu_quantized.json ]; then
+    run_step bench_artifact 1800 '"value"' \
+      env BENCH_ROUNDS=3 BENCH_MODEL=bcg-hf/bench-1b \
+        BCG_TPU_CHECKPOINT_DIR=checkpoints_q python bench.py || return $?
+  elif [ -e "$OUT/art_convert.skip" ] && [ ! -e "$OUT/bench_artifact.skip" ]; then
+    touch "$OUT/bench_artifact.skip"
+    log "SKIP bench_artifact: artifact conversion was skipped"
+  fi
   run_step bench_bf16w 1500 '"value"' \
     env BENCH_ROUNDS=3 BENCH_QUANTIZATION=none python bench.py || return $?
   run_step bench_finesuffix 1500 '"value"' \
@@ -120,7 +136,8 @@ drain() {
 
 all_done() {
   local s
-  for s in bench_default bench_int8kv bench_hf1b bench_conc2 bench_bf16w \
+  for s in bench_default bench_int8kv bench_hf1b bench_conc2 \
+           art_convert bench_artifact bench_bf16w \
            bench_finesuffix bench_w8a16 mb_prefill mb_decode \
            bench_8b bench_14b \
            parity_q1-baseline parity_q1-full parity_q2; do
