@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Render the hardware-watcher queue results into a markdown table.
+
+Reads ``results/hw_r3b/*.json`` (each the single-line bench JSON, or an
+experiments-aggregate JSON for parity_* steps) and prints a
+BENCH_NOTES-ready summary: one row per completed bench step with dec/s,
+round rate, cold-boot seconds and the headline perf keys, plus a
+parity-aggregate block.  Steps not yet stamped .done are listed as
+pending so a partial drain still reports cleanly.
+
+Usage:  python scripts/hw_queue_report.py [results_dir]
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+
+def _load(path: str):
+    try:
+        with open(path) as f:
+            text = f.read().strip()
+        if not text:
+            return None
+        # bench.py prints exactly one JSON line; experiments print a
+        # pretty-printed object. Either way: last JSON value in the file.
+        return json.loads(text.splitlines()[-1]) if text[0] != "{" else json.loads(text)
+    except (json.JSONDecodeError, OSError):
+        return None
+
+
+def main() -> None:
+    out_dir = sys.argv[1] if len(sys.argv) > 1 else "results/hw_r3b"
+    names = sorted(
+        os.path.basename(p)[:-5]
+        for p in glob.glob(os.path.join(out_dir, "*.json"))
+    )
+    bench_rows, parity_blocks, pending, skipped = [], [], [], []
+    for name in names:
+        done = os.path.exists(os.path.join(out_dir, f"{name}.done"))
+        skip = os.path.exists(os.path.join(out_dir, f"{name}.skip"))
+        data = _load(os.path.join(out_dir, f"{name}.json"))
+        if skip:
+            skipped.append(name)
+            continue
+        if not done or data is None:
+            pending.append(name)
+            continue
+        if "aggregate" in data:
+            parity_blocks.append((name, data))
+            continue
+        extra = data.get("extra", {})
+        bench_rows.append({
+            "step": name,
+            "dec/s": data.get("value"),
+            "rounds/s": extra.get("rounds_per_sec"),
+            "boot+r1 s": extra.get("boot_plus_first_round_s"),
+            "prefill_mfu": extra.get("prefill_mfu"),
+            "decode_gbps": extra.get("decode_gbps"),
+            "ckpt": extra.get("checkpoint"),
+            "kv": extra.get("kv_cache_dtype"),
+            "quant": extra.get("quantization"),
+        })
+
+    if bench_rows:
+        cols = list(bench_rows[0])
+        print("| " + " | ".join(cols) + " |")
+        print("|" + "---|" * len(cols))
+        for r in bench_rows:
+            print("| " + " | ".join(
+                "-" if r[c] is None else str(r[c]) for c in cols) + " |")
+    for name, data in parity_blocks:
+        agg = data["aggregate"]
+        print(f"\n### {name}")
+        for k in ("runs", "consensus_rate", "mean_rounds",
+                  "mean_quality_score", "outcomes"):
+            if k in agg:
+                print(f"- {k}: {agg[k]}")
+    if pending:
+        print("\npending:", ", ".join(pending))
+    if skipped:
+        print("skipped:", ", ".join(skipped))
+
+
+if __name__ == "__main__":
+    main()

@@ -7,14 +7,22 @@
 # parity sweeps one at a time, stamping <name>.done in $OUT so a
 # restarted watcher resumes where it left off.  A step whose output
 # looks like an availability failure is retried on the next healthy
-# window; a step that fails twice for any other reason is stamped
-# <name>.skip and reported in the log instead of wedging the queue.
+# window; a step that fails twice for any other reason (or times out
+# 3x on provably-healthy hardware) is stamped <name>.skip and reported
+# in the log instead of wedging the queue.
 set -u
 cd /root/repo
 OUT=results/hw_r3b
 declare -A TMO
 LOG=$OUT/watcher.log
 mkdir -p "$OUT"
+
+# Single source of truth for the queue: drain() runs these in order and
+# all_done() checks the same list, so the two can never drift.
+STEPS="bench_default bench_int8kv bench_hf1b bench_conc2 \
+art_convert bench_artifact bench_bf16w bench_finesuffix bench_w8a16 \
+mb_prefill mb_decode bench_8b bench_14b \
+parity_q1-baseline parity_q1-full parity_q2"
 
 log() { echo "$(date -u +%H:%M:%S) $*" >> "$LOG"; }
 
@@ -29,15 +37,80 @@ x.block_until_ready()
 EOF
 }
 
-# run_step <name> <timeout_s> <success_grep> <cmd...>
+# step_spec <name>: sets TMOS (timeout s), PAT (success grep), CMD (argv).
+step_spec() {
+  case $1 in
+    bench_default)
+      TMOS=1500; PAT='"value"'
+      CMD=(env BENCH_ROUNDS=3 python bench.py);;
+    bench_int8kv)
+      TMOS=1500; PAT='"value"'
+      CMD=(env BENCH_ROUNDS=3 BENCH_KV_DTYPE=int8 python bench.py);;
+    bench_hf1b)
+      TMOS=1800; PAT='"value"'
+      CMD=(env BENCH_ROUNDS=3 BENCH_MODEL=bcg-hf/bench-1b python bench.py);;
+    bench_conc2)
+      TMOS=1800; PAT='"value"'
+      CMD=(env BENCH_ROUNDS=3 BENCH_CONCURRENCY=2 python bench.py);;
+    art_convert)
+      TMOS=1200; PAT='saved int8 artifact'
+      CMD=(env PYTHONPATH=/root/repo python -m bcg_tpu.models.artifact
+           --model bcg-hf/bench-1b --mode int8
+           --out checkpoints_q/bcg-hf--bench-1b);;
+    bench_artifact)
+      TMOS=1800; PAT='"value"'
+      CMD=(env BENCH_ROUNDS=3 BENCH_MODEL=bcg-hf/bench-1b
+           BCG_TPU_CHECKPOINT_DIR=checkpoints_q python bench.py);;
+    bench_bf16w)
+      TMOS=1500; PAT='"value"'
+      CMD=(env BENCH_ROUNDS=3 BENCH_QUANTIZATION=none python bench.py);;
+    bench_finesuffix)
+      TMOS=1500; PAT='"value"'
+      CMD=(env BENCH_ROUNDS=3 BCG_TPU_FINE_SUFFIX=1 python bench.py);;
+    bench_w8a16)
+      TMOS=1500; PAT='"value"'
+      CMD=(env BENCH_ROUNDS=3 BCG_TPU_W8A16_PREFILL=512 python bench.py);;
+    mb_prefill)
+      TMOS=2400; PAT='rmsnorm'
+      CMD=(env PYTHONPATH=/root/repo python scripts/microbench_prefill.py);;
+    mb_decode)
+      TMOS=2400; PAT='in-loop'
+      CMD=(env PYTHONPATH=/root/repo python scripts/microbench_decode_attention.py);;
+    bench_8b)
+      TMOS=3600; PAT='"value"'
+      CMD=(env BENCH_ROUNDS=3 BENCH_MODEL=bcg-tpu/bench-8b python bench.py);;
+    bench_14b)
+      TMOS=5400; PAT='"value"'
+      CMD=(env BENCH_ROUNDS=2 BENCH_MODEL=bcg-tpu/bench-14b python bench.py);;
+    parity_*)
+      TMOS=5400; PAT='"aggregate"'
+      CMD=(python -m bcg_tpu.experiments "${1#parity_}" --backend jax
+           --model bcg-tpu/bench-1b --runs 10 --rounds 8
+           --concurrency 2 --seed 100);;
+    *) return 1;;
+  esac
+}
+
+# run_step <name>: execute the step's spec with stamping + triage.
 run_step() {
-  local name=$1 tmo=$2 ok_pat=$3; shift 3
+  local name=$1
   [ -e "$OUT/$name.done" ] && return 0
   [ -e "$OUT/$name.skip" ] && return 0
+  # bench_artifact is meaningful only with the artifact actually on
+  # disk: without it, checkpoint discovery silently falls back to the
+  # plain HF fixture and the step would re-measure bench_hf1b.
+  if [ "$name" = bench_artifact ]; then
+    if [ ! -f checkpoints_q/bcg-hf--bench-1b/bcg_tpu_quantized.json ]; then
+      touch "$OUT/$name.skip"
+      log "SKIP $name: no quantized artifact on disk (art_convert skipped or wiped)"
+      return 0
+    fi
+  fi
+  step_spec "$name" || { log "BUG: no spec for step $name"; touch "$OUT/$name.skip"; return 0; }
   log "START $name"
-  timeout "$tmo" "$@" > "$OUT/$name.json" 2> "$OUT/$name.log"
+  timeout "$TMOS" "${CMD[@]}" > "$OUT/$name.json" 2> "$OUT/$name.log"
   local rc=$?
-  if [ $rc -eq 0 ] && grep -q "$ok_pat" "$OUT/$name.json" \
+  if [ $rc -eq 0 ] && grep -q "$PAT" "$OUT/$name.json" \
       && ! grep -qi '"error"' "$OUT/$name.json"; then
     touch "$OUT/$name.done"
     log "DONE $name: $(tail -c 300 "$OUT/$name.json" | tr '\n' ' ')"
@@ -86,61 +159,16 @@ run_step() {
 }
 
 drain() {
-  run_step bench_default 1500 '"value"' \
-    env BENCH_ROUNDS=3 python bench.py || return $?
-  run_step bench_int8kv 1500 '"value"' \
-    env BENCH_ROUNDS=3 BENCH_KV_DTYPE=int8 python bench.py || return $?
-  run_step bench_hf1b 1800 '"value"' \
-    env BENCH_ROUNDS=3 BENCH_MODEL=bcg-hf/bench-1b python bench.py || return $?
-  run_step bench_conc2 1800 '"value"' \
-    env BENCH_ROUNDS=3 BENCH_CONCURRENCY=2 python bench.py || return $?
-  run_step art_convert 1200 'saved int8 artifact' \
-    env PYTHONPATH=/root/repo python -m bcg_tpu.models.artifact \
-      --model bcg-hf/bench-1b --mode int8 \
-      --out checkpoints_q/bcg-hf--bench-1b || return $?
-  # Gated on the artifact actually existing: without it the env dir is
-  # skipped by checkpoint discovery and the bench would silently
-  # re-measure the plain HF boot path and stamp a bogus .done.
-  if [ -e "$OUT/art_convert.done" ] \
-      && [ -f checkpoints_q/bcg-hf--bench-1b/bcg_tpu_quantized.json ]; then
-    run_step bench_artifact 1800 '"value"' \
-      env BENCH_ROUNDS=3 BENCH_MODEL=bcg-hf/bench-1b \
-        BCG_TPU_CHECKPOINT_DIR=checkpoints_q python bench.py || return $?
-  elif [ -e "$OUT/art_convert.skip" ] && [ ! -e "$OUT/bench_artifact.skip" ]; then
-    touch "$OUT/bench_artifact.skip"
-    log "SKIP bench_artifact: artifact conversion was skipped"
-  fi
-  run_step bench_bf16w 1500 '"value"' \
-    env BENCH_ROUNDS=3 BENCH_QUANTIZATION=none python bench.py || return $?
-  run_step bench_finesuffix 1500 '"value"' \
-    env BENCH_ROUNDS=3 BCG_TPU_FINE_SUFFIX=1 python bench.py || return $?
-  run_step bench_w8a16 1500 '"value"' \
-    env BENCH_ROUNDS=3 BCG_TPU_W8A16_PREFILL=512 python bench.py || return $?
-  run_step mb_prefill 2400 'rmsnorm' \
-    env PYTHONPATH=/root/repo python scripts/microbench_prefill.py || return $?
-  run_step mb_decode 2400 'in-loop' \
-    env PYTHONPATH=/root/repo python scripts/microbench_decode_attention.py || return $?
-  run_step bench_8b 3600 '"value"' \
-    env BENCH_ROUNDS=3 BENCH_MODEL=bcg-tpu/bench-8b python bench.py || return $?
-  run_step bench_14b 5400 '"value"' \
-    env BENCH_ROUNDS=2 BENCH_MODEL=bcg-tpu/bench-14b python bench.py || return $?
-  local p
-  for p in q1-baseline q1-full q2; do
-    run_step "parity_$p" 5400 '"aggregate"' \
-      python -m bcg_tpu.experiments "$p" --backend jax \
-        --model bcg-tpu/bench-1b --runs 10 --rounds 8 \
-        --concurrency 2 --seed 100 || return $?
+  local s
+  for s in $STEPS; do
+    run_step "$s" || return $?
   done
   return 0
 }
 
 all_done() {
   local s
-  for s in bench_default bench_int8kv bench_hf1b bench_conc2 \
-           art_convert bench_artifact bench_bf16w \
-           bench_finesuffix bench_w8a16 mb_prefill mb_decode \
-           bench_8b bench_14b \
-           parity_q1-baseline parity_q1-full parity_q2; do
+  for s in $STEPS; do
     [ -e "$OUT/$s.done" ] || [ -e "$OUT/$s.skip" ] || return 1
   done
   return 0
@@ -153,14 +181,20 @@ while true; do
     log "probe OK — draining queue"
     drain
     rc=$?
-    [ $rc -eq 0 ] && continue
-    log "drain interrupted rc=$rc"
-    # rc=2 means an outage was observed mid-drain (UNAVAIL or a
-    # timeout whose re-probe failed): same invalidation as a failed
-    # top-level probe — healthy-timeout attribution starts over.
-    # rc=3 (healthy-hardware timeout) keeps its count: wiping it here
-    # would make the 3-strike skip unreachable.
-    [ $rc -eq 2 ] && TMO=()
+    if [ $rc -eq 0 ]; then
+      # A full pass with nothing left raises all_done next iteration; a
+      # pass that settled everything reachable still sleeps so a probe
+      # loop can never spin hot against the chip.
+      all_done && continue
+    else
+      log "drain interrupted rc=$rc"
+      # rc=2 means an outage was observed mid-drain (UNAVAIL or a
+      # timeout whose re-probe failed): same invalidation as a failed
+      # top-level probe — healthy-timeout attribution starts over.
+      # rc=3 (healthy-hardware timeout) keeps its count: wiping it here
+      # would make the 3-strike skip unreachable.
+      [ $rc -eq 2 ] && TMO=()
+    fi
   else
     log "probe failed (tpu not ready)"
     # An observed outage invalidates the healthy-timeout attribution:
