@@ -101,3 +101,37 @@ class TestHybridMesh:
         info = process_info()
         assert info["process_count"] == 1
         assert info["global_device_count"] == jax.device_count()
+
+
+class TestScale64:
+    """BASELINE scale-sweep sizes (16/32/64 agents): the game, comm, and
+    metrics layers must handle the O(N^2) message fan-out and the
+    statistics payload at the largest configured sweep size."""
+
+    def test_64_agent_game_end_to_end(self):
+        from bcg_tpu.api import run_simulation
+
+        out = run_simulation(
+            n_agents=64, max_rounds=4, byzantine_count=16,
+            backend="fake", seed=9,
+        )
+        m = out["metrics"]
+        assert m["num_honest"] == 48
+        assert m["num_byzantine"] == 16
+        assert m["total_agents"] == 64
+        assert 1 <= m["total_rounds"] <= 4
+        # Per-round record splits all 64 agents' values by role.
+        r0 = m["rounds_data"][0]
+        assert len(r0["honest_values"]) == 48
+        # The fake Byzantine policy proposes (does not abstain), so
+        # every Byzantine agent's value must be recorded.
+        assert len(r0["byzantine_values"]) == 16
+
+    def test_scale_sweep_multiple_sizes(self):
+        outs = run_scale_sweep(
+            [16, 32, 64], byzantine_fraction=0.25, runs=1,
+            backend="fake", max_rounds=3,
+        )
+        assert len(outs) == 3
+        for o in outs:
+            assert o["aggregate"]["runs"] == 1
