@@ -203,7 +203,15 @@ def dense(x: jax.Array, w: DenseWeight, out_dtype=None) -> jax.Array:
         # partitioning rule, so under a tp/dp mesh GSPMD would have to
         # replicate (all-gather) the packed weight per call — the XLA
         # fallback partitions normally there.
-        if rows <= 256 and jax.default_backend() == "tpu" and jax.device_count() == 1:
+        # BCG_TPU_DISABLE_W4_KERNEL=1 is the operational kill-switch
+        # (read at trace time): if the kernel fails hardware lowering
+        # (scripts/probe_w4_kernel.py), large-model serving degrades to
+        # the XLA dequant path instead of crashing.
+        kernel_off = os.environ.get(
+            "BCG_TPU_DISABLE_W4_KERNEL", ""
+        ).strip().lower() in ("1", "true", "yes", "on")
+        if (rows <= 256 and not kernel_off
+                and jax.default_backend() == "tpu" and jax.device_count() == 1):
             from bcg_tpu.ops.w4_matmul import w4a16_matmul
 
             return w4a16_matmul(x, w["q4"], w["gscale"]).astype(out_dtype)

@@ -152,7 +152,12 @@ def w4a16_matmul(x, q4, gscale, block_m: int = 128, interpret: bool = False):
     for s in lead:
         M *= s
     x2 = x.reshape(M, x.shape[-1])
-    if not w4a16_supported(x2.shape, q4.shape, gscale.shape, block_m):
+    # Fallback for unsupported shapes AND for non-TPU backends: the
+    # kernel only lowers on TPU (or in interpret mode), so a direct call
+    # off-TPU must degrade to the XLA dequant path, not crash.
+    if not w4a16_supported(x2.shape, q4.shape, gscale.shape, block_m) or (
+        not interpret and jax.default_backend() != "tpu"
+    ):
         from bcg_tpu.models.quantize import dequantize_int4
 
         w = dequantize_int4({"q4": q4, "gscale": gscale})

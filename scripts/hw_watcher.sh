@@ -21,7 +21,7 @@ mkdir -p "$OUT"
 # all_done() checks the same list, so the two can never drift.
 STEPS="bench_default bench_int8kv bench_hf1b bench_conc2 \
 art_convert bench_artifact bench_bf16w bench_finesuffix bench_w8a16 \
-mb_prefill mb_decode bench_8b bench_14b \
+mb_prefill mb_decode bench_8b w4_probe bench_14b \
 parity_q1-baseline parity_q1-full parity_q2"
 
 log() { echo "$(date -u +%H:%M:%S) $*" >> "$LOG"; }
@@ -79,9 +79,19 @@ step_spec() {
     bench_8b)
       TMOS=3600; PAT='"value"'
       CMD=(env BENCH_ROUNDS=3 BENCH_MODEL=bcg-tpu/bench-8b python bench.py);;
+    w4_probe)
+      TMOS=1200; PAT='w4-kernel-probe OK'
+      CMD=(env PYTHONPATH=/root/repo python scripts/probe_w4_kernel.py);;
     bench_14b)
       TMOS=5400; PAT='"value"'
-      CMD=(env BENCH_ROUNDS=2 BENCH_MODEL=bcg-tpu/bench-14b python bench.py);;
+      if [ -e "$OUT/w4_probe.skip" ]; then
+        # Kernel failed its hardware probe: serve 14B through the XLA
+        # dequant fallback instead of crashing on the same lowering bug.
+        CMD=(env BENCH_ROUNDS=2 BENCH_MODEL=bcg-tpu/bench-14b
+             BCG_TPU_DISABLE_W4_KERNEL=1 python bench.py)
+      else
+        CMD=(env BENCH_ROUNDS=2 BENCH_MODEL=bcg-tpu/bench-14b python bench.py)
+      fi;;
     parity_*)
       TMOS=5400; PAT='"aggregate"'
       CMD=(python -m bcg_tpu.experiments "${1#parity_}" --backend jax
