@@ -11,8 +11,20 @@ defaults and knobs.
 from __future__ import annotations
 
 import dataclasses
+import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
+
+
+def env_flag(name: str, default: bool = False) -> bool:
+    """Boolean environment flag, ONE parse for the whole package:
+    unset or empty -> ``default``; otherwise the falsy strings
+    ("0", "false", "no", "off", case/whitespace-insensitive) -> False
+    and anything else -> True."""
+    raw = os.environ.get(name)
+    if raw is None or raw.strip() == "":
+        return default
+    return raw.strip().lower() not in ("0", "false", "no", "off")
 
 # Model presets used in the reference experiments (config.py:20-25).
 MODEL_PRESETS: Dict[str, str] = {

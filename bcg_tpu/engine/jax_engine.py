@@ -37,6 +37,7 @@ from bcg_tpu.engine.chat_template import (
 from bcg_tpu.engine.interface import InferenceEngine, per_row_settings as _per_row
 from bcg_tpu.engine.tokenizer import Tokenizer, tokenizer_for_model
 from bcg_tpu.guided.processor import GuidedBatch, compile_schema
+from bcg_tpu.config import env_flag
 from bcg_tpu.models.configs import (
     LARGE_MODEL_PARAMS,
     ModelSpec,
@@ -225,7 +226,11 @@ class JaxEngine(InferenceEngine):
         on_tpu_aligned = (
             jax.default_backend() == "tpu" and self.spec.head_dim % 128 == 0
         )
-        if self.kv_quantized and on_tpu_aligned:
+        # Operational kill-switch (scripts/probe_int8_decode.py): if the
+        # int8 kernels fail hardware lowering, serve through the dequant
+        # fallback (slower, warned below) instead of crashing.
+        int8_kernel_off = env_flag("BCG_TPU_DISABLE_INT8_DECODE_KERNEL")
+        if self.kv_quantized and on_tpu_aligned and not int8_kernel_off:
             self.decode_attention_impl = "pallas"
         else:
             self.decode_attention_impl = (
@@ -235,10 +240,12 @@ class JaxEngine(InferenceEngine):
             import warnings
 
             warnings.warn(
-                "int8 KV cache without the Pallas decode kernel (non-TPU "
-                "backend or head_dim not a multiple of 128): the fallback "
-                "dequantizes the whole cache per step, which is SLOWER "
-                "than bfloat16",
+                "int8 KV cache without the Pallas decode kernel ("
+                + ("BCG_TPU_DISABLE_INT8_DECODE_KERNEL is set"
+                   if int8_kernel_off
+                   else "non-TPU backend or head_dim not a multiple of 128")
+                + "): the fallback dequantizes the whole cache per step, "
+                "which is SLOWER than bfloat16",
                 stacklevel=2,
             )
         elif self.kv_quantized and self.spec.param_count < LARGE_MODEL_PARAMS:
@@ -393,11 +400,10 @@ class JaxEngine(InferenceEngine):
 
         # Per-engine suffix ladder (config field; env var as the
         # bench/sweep override) — see _SUFFIX_BUCKETS_FINE.
-        env_fine = os.environ.get("BCG_TPU_FINE_SUFFIX", "").strip().lower()
         self._suffix_buckets = (
             _SUFFIX_BUCKETS_FINE
             if (getattr(config, "fine_suffix_buckets", False)
-                or env_fine in ("1", "true", "yes", "on"))
+                or env_flag("BCG_TPU_FINE_SUFFIX"))
             else _SUFFIX_BUCKETS
         )
 

@@ -175,6 +175,15 @@ MODEL_SPECS: Dict[str, ModelSpec] = {
         num_heads=4, num_kv_heads=2, head_dim=16,
         intermediate_size=128, qk_norm=True, max_position=2048,
     ),
+    # Tiny spec with a LANE-ALIGNED head dim (128): exercises the
+    # TPU-kernel selection branches (Pallas decode/flash gating keys on
+    # head_dim % 128) at test sizes where tiny-test's Dh=16 cannot.
+    "bcg-tpu/tiny-dh128": ModelSpec(
+        name="bcg-tpu/tiny-dh128",
+        vocab_size=512, hidden_size=256, num_layers=2,
+        num_heads=2, num_kv_heads=1, head_dim=128,
+        intermediate_size=512, qk_norm=True, max_position=2048,
+    ),
     # Mid-size random-weight spec for single-chip benchmarking.
     "bcg-tpu/bench-1b": ModelSpec(
         name="bcg-tpu/bench-1b",

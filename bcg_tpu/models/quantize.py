@@ -207,9 +207,9 @@ def dense(x: jax.Array, w: DenseWeight, out_dtype=None) -> jax.Array:
         # (read at trace time): if the kernel fails hardware lowering
         # (scripts/probe_w4_kernel.py), large-model serving degrades to
         # the XLA dequant path instead of crashing.
-        kernel_off = os.environ.get(
-            "BCG_TPU_DISABLE_W4_KERNEL", ""
-        ).strip().lower() in ("1", "true", "yes", "on")
+        from bcg_tpu.config import env_flag
+
+        kernel_off = env_flag("BCG_TPU_DISABLE_W4_KERNEL")
         if (rows <= 256 and not kernel_off
                 and jax.default_backend() == "tpu" and jax.device_count() == 1):
             from bcg_tpu.ops.w4_matmul import w4a16_matmul

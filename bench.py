@@ -74,10 +74,9 @@ _TRANSIENT_MARKERS = (
 
 
 def _env_flag(name: str, default: bool) -> bool:
-    raw = os.environ.get(name)
-    if raw is None or raw == "":
-        return default
-    return raw.strip().lower() not in ("0", "false", "no", "off")
+    from bcg_tpu.config import env_flag
+
+    return env_flag(name, default)
 
 
 def _is_transient(exc: BaseException) -> bool:

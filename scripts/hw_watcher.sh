@@ -19,7 +19,7 @@ mkdir -p "$OUT"
 
 # Single source of truth for the queue: drain() runs these in order and
 # all_done() checks the same list, so the two can never drift.
-STEPS="bench_default bench_int8kv bench_hf1b bench_conc2 \
+STEPS="bench_default int8_probe bench_int8kv bench_hf1b bench_conc2 \
 art_convert bench_artifact bench_bf16w bench_finesuffix bench_w8a16 \
 mb_prefill mb_decode bench_8b w4_probe bench_14b \
 parity_q1-baseline parity_q1-full parity_q2"
@@ -39,13 +39,24 @@ EOF
 
 # step_spec <name>: sets TMOS (timeout s), PAT (success grep), CMD (argv).
 step_spec() {
+  # If the int8 decode kernels failed their hardware probe, every
+  # int8-KV bench (bench_int8kv, bench_8b, bench_14b) degrades to the
+  # dequant fallback instead of crashing on the same lowering bug.
+  INT8_FALLBACK=()
+  if [ -e "$OUT/int8_probe.skip" ]; then
+    INT8_FALLBACK=(BCG_TPU_DISABLE_INT8_DECODE_KERNEL=1)
+  fi
   case $1 in
     bench_default)
       TMOS=1500; PAT='"value"'
       CMD=(env BENCH_ROUNDS=3 python bench.py);;
+    int8_probe)
+      TMOS=1200; PAT='int8-decode-probe OK'
+      CMD=(env PYTHONPATH=/root/repo python scripts/probe_int8_decode.py);;
     bench_int8kv)
       TMOS=1500; PAT='"value"'
-      CMD=(env BENCH_ROUNDS=3 BENCH_KV_DTYPE=int8 python bench.py);;
+      CMD=(env BENCH_ROUNDS=3 BENCH_KV_DTYPE=int8
+           ${INT8_FALLBACK[@]+"${INT8_FALLBACK[@]}"} python bench.py);;
     bench_hf1b)
       TMOS=1800; PAT='"value"'
       CMD=(env BENCH_ROUNDS=3 BENCH_MODEL=bcg-hf/bench-1b python bench.py);;
@@ -78,20 +89,22 @@ step_spec() {
       CMD=(env PYTHONPATH=/root/repo python scripts/microbench_decode_attention.py);;
     bench_8b)
       TMOS=3600; PAT='"value"'
-      CMD=(env BENCH_ROUNDS=3 BENCH_MODEL=bcg-tpu/bench-8b python bench.py);;
+      CMD=(env BENCH_ROUNDS=3 BENCH_MODEL=bcg-tpu/bench-8b
+           ${INT8_FALLBACK[@]+"${INT8_FALLBACK[@]}"} python bench.py);;
     w4_probe)
       TMOS=1200; PAT='w4-kernel-probe OK'
       CMD=(env PYTHONPATH=/root/repo python scripts/probe_w4_kernel.py);;
     bench_14b)
       TMOS=5400; PAT='"value"'
+      W4_FALLBACK=()
       if [ -e "$OUT/w4_probe.skip" ]; then
         # Kernel failed its hardware probe: serve 14B through the XLA
         # dequant fallback instead of crashing on the same lowering bug.
-        CMD=(env BENCH_ROUNDS=2 BENCH_MODEL=bcg-tpu/bench-14b
-             BCG_TPU_DISABLE_W4_KERNEL=1 python bench.py)
-      else
-        CMD=(env BENCH_ROUNDS=2 BENCH_MODEL=bcg-tpu/bench-14b python bench.py)
-      fi;;
+        W4_FALLBACK=(BCG_TPU_DISABLE_W4_KERNEL=1)
+      fi
+      CMD=(env BENCH_ROUNDS=2 BENCH_MODEL=bcg-tpu/bench-14b
+           ${W4_FALLBACK[@]+"${W4_FALLBACK[@]}"}
+           ${INT8_FALLBACK[@]+"${INT8_FALLBACK[@]}"} python bench.py);;
     parity_*)
       TMOS=5400; PAT='"aggregate"'
       CMD=(python -m bcg_tpu.experiments "${1#parity_}" --backend jax

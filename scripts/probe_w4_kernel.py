@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from bcg_tpu.models.quantize import dequantize_int4, quantize_weight_int4
-from bcg_tpu.ops.w4_matmul import w4a16_matmul
+from bcg_tpu.ops.w4_matmul import w4a16_matmul, w4a16_supported
 
 
 CASES = [
@@ -39,8 +39,12 @@ def main() -> None:
     if backend != "tpu":
         # Off-TPU the kernel falls back to the very XLA path used as the
         # reference below — "OK" would be vacuous and would stamp the
-        # watcher step without ever lowering the kernel.
-        print("w4-kernel-probe FAILED: backend is not tpu (nothing validated)")
+        # watcher step without ever lowering the kernel.  "unavailable"
+        # keeps the watcher's availability triage retrying (a tunnel can
+        # die between the watcher's probe and this step, silently
+        # falling JAX back to CPU) instead of burning failure strikes.
+        print("w4-kernel-probe FAILED: accelerator unavailable "
+              "(backend is not tpu; nothing validated)")
         raise SystemExit(1)
     rng = np.random.default_rng(0)
     ok = True
@@ -48,6 +52,16 @@ def main() -> None:
         w = jnp.asarray(rng.standard_normal((din, dout)) * 0.02, jnp.bfloat16)
         qw = quantize_weight_int4(w)
         x = jnp.asarray(rng.standard_normal((m, din)) * 0.5, jnp.bfloat16)
+        # The kernel silently falls back to the XLA dequant path (the
+        # very reference below) for unsupported shapes — "OK" would
+        # then be vacuous, so unsupported cases are hard failures here.
+        if not w4a16_supported(
+            (m, din), qw["q4"].shape, qw["gscale"].shape
+        ):
+            ok = False
+            print(f"  {name:<22s} UNSUPPORTED shape (kernel would fall "
+                  f"back; probe would compare XLA to XLA)")
+            continue
         try:
             got = np.asarray(w4a16_matmul(x, qw["q4"], qw["gscale"]))
             want = np.asarray(

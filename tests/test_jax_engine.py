@@ -401,3 +401,38 @@ def test_fine_suffix_ladder_config(monkeypatch):
     via_env.shutdown()
     fine.shutdown()
     coarse.shutdown()
+
+
+def test_int8_decode_kernel_kill_switch(monkeypatch):
+    """BCG_TPU_DISABLE_INT8_DECODE_KERNEL=1 routes int8-KV decode to the
+    dequant fallback (operational escape for a kernel lowering failure;
+    scripts/probe_int8_decode.py)."""
+    import warnings
+
+    import jax as _jax
+
+    from bcg_tpu.config import EngineConfig
+    from bcg_tpu.engine.jax_engine import JaxEngine
+
+    # tiny-dh128 has the lane-aligned head dim the Pallas gate requires;
+    # the monkeypatched backend makes the selection logic believe it is
+    # on TPU (construction only — nothing is generated).
+    monkeypatch.setattr(_jax, "default_backend", lambda: "tpu")
+    cfg = EngineConfig(
+        backend="jax", model_name="bcg-tpu/tiny-dh128",
+        max_model_len=512, kv_cache_dtype="int8",
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        eng_default = JaxEngine(cfg)
+    assert eng_default.decode_attention_impl == "pallas"
+
+    monkeypatch.setenv("BCG_TPU_DISABLE_INT8_DECODE_KERNEL", "1")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        # Weight sharing is valid here: shutdown() nulls .params, so the
+        # donor must stay alive until the recipient is constructed.
+        eng = JaxEngine(cfg, params=eng_default.params)
+    assert eng.decode_attention_impl != "pallas"
+    eng.shutdown()
+    eng_default.shutdown()
