@@ -69,6 +69,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Serve the vote phase's shared proposals/history block from a "
                         "cached KV prefix (restructures vote prompts; opt-in because "
                         "the text diverges from the reference's format)")
+    p.add_argument("--fake-policy", type=str, default=None,
+                   help="Fake-backend scripted policy: consensus|schema_min|"
+                        "stubborn|median|disrupt|oscillate|mimic|silent, or "
+                        "mixed:<honest>:<byzantine> for a role-aware mix")
     p.add_argument("--fault-rate", type=float, default=None,
                    help="Corrupt this fraction of LLM responses (resilience experiments)")
     p.add_argument("--fault-seed", type=int, default=None,
@@ -132,6 +136,8 @@ def config_from_args(args) -> BCGConfig:
         engine = dataclasses.replace(engine, guided_compact_json=True)
     if args.fault_rate is not None:
         engine = dataclasses.replace(engine, fault_rate=args.fault_rate)
+    if args.fake_policy is not None:
+        engine = dataclasses.replace(engine, fake_policy=args.fake_policy)
     if args.fault_seed is not None:
         engine = dataclasses.replace(engine, fault_seed=args.fault_seed)
     network = base.network

@@ -145,6 +145,11 @@ class EngineConfig:
     attention_impl: str = "auto"  # auto | pallas | xla
     # Fake-backend determinism seed (ignored by the real engine).
     fake_seed: int = 0
+    # Fake-backend scripted policy (engine/fake.py): a single policy
+    # name, or "mixed:<honest>:<byzantine>" for a role-aware adversary
+    # mix — a seeded, LLM-free fault-model axis the reference (whose
+    # only fault model is the LLM itself) has no equivalent of.
+    fake_policy: str = "consensus"
     # Fault injection (engine/fault.py): corrupt this seeded fraction of
     # guided responses to exercise the retry/degradation ladder as a
     # controlled experimental axis.  0 = off.

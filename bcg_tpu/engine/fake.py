@@ -16,6 +16,25 @@ Policies
 * ``schema_min``: emits the minimal schema-conforming object.
 * ``disrupt``: for Byzantine-shaped schemas (value accepts "abstain"),
   proposes values far from the observed mode and votes "continue".
+* ``stubborn``: never follows — keeps the agent's current value forever
+  (drives the no-consensus / timeout paths deterministically).
+* ``median``: proposes the median of the observed values (a slower,
+  order-statistic convergence dynamic than the mode-attractor).
+* ``oscillate``: alternates between the schema's extremes by round
+  parity and votes "continue" (a value-flipping adversary).
+* ``mimic``: joins the observed mode but always votes "stop" — the
+  infiltration adversary that tries to freeze consensus early on a
+  value it helped pick.
+* ``silent``: abstains wherever the schema allows (decision and vote).
+
+ROLE-AWARE MIXES: ``"mixed:<honest_policy>:<byzantine_policy>"`` applies
+different policies by ROW, detecting Byzantine rows from their schema
+shape (decision ``value`` carries the ``anyOf[int, "abstain"]`` form;
+vote enums include ``"abstain"`` — agents/byzantine.py).  This turns the
+fake backend into a scripted fault-model lab: adversary strategies
+become a seeded, LLM-free experimental axis (e.g.
+``--fake-policy mixed:consensus:oscillate``), something the reference —
+whose only fault model is the LLM itself — cannot do hermetically.
 
 Failure injection: ``fail_first_n_calls`` makes the first N ``*_json``
 calls return invalid results, exercising the orchestrator's batch-retry →
@@ -35,6 +54,13 @@ from bcg_tpu.engine.interface import InferenceEngine
 # not the agent's own "Your current value: N" line.
 _VALUE_RE = re.compile(r"agent_\w+ value: (-?\d+)")
 _CURRENT_RE = re.compile(r"[Yy]our current value: (-?\d+)")
+# Case-insensitive: the real decision prompts use an uppercase
+# "=== ROUND N ===" header while history lines say "Round N: ..." —
+# callers take the MAX match (the current round never trails history).
+_ROUND_RE = re.compile(r"round (\d+)", re.IGNORECASE)
+
+HONEST_POLICIES = ("consensus", "schema_min", "stubborn", "median")
+BYZANTINE_POLICIES = ("disrupt", "oscillate", "mimic", "silent")
 
 
 def _schema_bounds(schema: Dict[str, Any]) -> Tuple[int, int]:
@@ -64,6 +90,24 @@ class FakeEngine(InferenceEngine):
         policy: str = "consensus",
         fail_first_n_calls: int = 0,
     ):
+        # Validate at CONSTRUCTION: a typo'd policy name would otherwise
+        # silently fall through to the consensus branch, recording
+        # honest-baseline numbers as adversary results.
+        known = set(HONEST_POLICIES) | set(BYZANTINE_POLICIES)
+        if policy.startswith("mixed:"):
+            parts = policy.split(":")
+            if (len(parts) != 3 or parts[1] not in HONEST_POLICIES
+                    or parts[2] not in BYZANTINE_POLICIES):
+                raise ValueError(
+                    f"fake policy {policy!r}: expected "
+                    f"'mixed:<honest>:<byzantine>' with honest in "
+                    f"{HONEST_POLICIES} and byzantine in {BYZANTINE_POLICIES}"
+                )
+        elif policy not in known:
+            raise ValueError(
+                f"unknown fake policy {policy!r}: expected one of "
+                f"{sorted(known)} or 'mixed:<honest>:<byzantine>'"
+            )
         self.rng = random.Random(seed)
         self.policy = policy
         self.fail_first_n_calls = fail_first_n_calls
@@ -105,23 +149,53 @@ class FakeEngine(InferenceEngine):
 
     # ---------------------------------------------------------------- policy
 
-    def _respond(self, system_prompt: str, user_prompt: str, schema: Dict) -> Dict:
+    def _policy_for(self, schema: Dict) -> str:
+        """Row policy: a plain policy applies to every row; a
+        ``mixed:<honest>:<byz>`` policy dispatches on the schema's role
+        shape (Byzantine decision schemas carry anyOf[int, "abstain"];
+        Byzantine vote enums include "abstain" — agents/byzantine.py)."""
+        if not self.policy.startswith("mixed:"):
+            return self.policy
+        parts = self.policy.split(":")
+        if len(parts) != 3:
+            raise ValueError(
+                f"fake policy {self.policy!r}: expected 'mixed:<honest>:<byzantine>'"
+            )
+        _, honest_p, byz_p = parts
         if _is_vote_schema(schema):
-            return self._vote(user_prompt, schema)
-        return self._decide(user_prompt, schema)
+            is_byz = "abstain" in _vote_options(schema)
+        else:
+            is_byz = "anyOf" in schema.get("properties", {}).get("value", {})
+        return byz_p if is_byz else honest_p
 
-    def _decide(self, prompt: str, schema: Dict) -> Dict:
+    def _respond(self, system_prompt: str, user_prompt: str, schema: Dict) -> Dict:
+        policy = self._policy_for(schema)
+        if _is_vote_schema(schema):
+            return self._vote(user_prompt, schema, policy)
+        return self._decide(user_prompt, schema, policy)
+
+    def _decide(self, prompt: str, schema: Dict, policy: str) -> Dict:
         lo, hi = _schema_bounds(schema)
         observed = [int(v) for v in _VALUE_RE.findall(prompt)]
         current = _CURRENT_RE.search(prompt)
         current_value = int(current.group(1)) if current else None
+        allows_abstain = "anyOf" in schema.get("properties", {}).get("value", {})
 
-        if self.policy == "schema_min":
+        if policy == "schema_min":
             value: Any = lo
-        elif self.policy == "disrupt":
+        elif policy == "stubborn":
+            # Never follows: the deterministic no-consensus dynamic.
+            value = current_value if current_value is not None else (lo + hi) // 2
+        elif policy == "median":
+            if observed:
+                ordered = sorted(observed)
+                value = ordered[len(ordered) // 2]
+            else:
+                value = current_value if current_value is not None else (lo + hi) // 2
+            value = max(lo, min(hi, value))
+        elif policy == "disrupt":
             # Push away from the observed mode; occasionally abstain when
             # the schema allows it.
-            allows_abstain = "anyOf" in schema.get("properties", {}).get("value", {})
             if allows_abstain and self.rng.random() < 0.2:
                 value = "abstain"
             elif observed:
@@ -129,6 +203,24 @@ class FakeEngine(InferenceEngine):
                 value = hi if mode <= (lo + hi) // 2 else lo
             else:
                 value = self.rng.randint(lo, hi)
+        elif policy == "oscillate":
+            # Value-flipping adversary: alternates extremes by round
+            # parity (stateless — the round number is in the prompt;
+            # max() because history lines mention earlier rounds too).
+            rounds_seen = [int(x) for x in _ROUND_RE.findall(prompt)]
+            rnd = max(rounds_seen) if rounds_seen else 0
+            value = hi if rnd % 2 == 0 else lo
+        elif policy == "mimic":
+            # Infiltration adversary: joins the mode (looks honest)...
+            if observed:
+                counts = Counter(observed)
+                best = max(counts.values())
+                value = min(v for v, c in counts.items() if c == best)
+            else:
+                value = (lo + hi) // 2
+            value = max(lo, min(hi, value))
+        elif policy == "silent":
+            value = "abstain" if allows_abstain else lo
         else:  # consensus
             if observed:
                 # most common, smallest on ties -> deterministic attractor
@@ -142,15 +234,21 @@ class FakeEngine(InferenceEngine):
             value = max(lo, min(hi, value))
 
         return {
-            "internal_strategy": f"fake[{self.policy}] tracking {len(observed)} proposals",
+            "internal_strategy": f"fake[{policy}] tracking {len(observed)} proposals",
             "value": value,
             "public_reasoning": f"Proposing {value} based on the visible round history.",
         }
 
-    def _vote(self, prompt: str, schema: Dict) -> Dict:
+    def _vote(self, prompt: str, schema: Dict, policy: str) -> Dict:
         options = _vote_options(schema)
-        if self.policy == "disrupt" and "continue" in options:
+        if policy in ("disrupt", "oscillate") and "continue" in options:
             return {"decision": "continue"}
+        if policy == "silent" and "abstain" in options:
+            return {"decision": "abstain"}
+        if policy == "mimic" and "stop" in options:
+            # ...and votes to freeze the game early on the value it
+            # helped pick (the infiltration metric's target behaviour).
+            return {"decision": "stop"}
         # Look only at the current-round section if present.
         section = prompt.split("PREVIOUS ROUNDS")[0]
         observed = [int(v) for v in re.findall(r": (-?\d+)", section)]

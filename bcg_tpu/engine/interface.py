@@ -147,7 +147,10 @@ def create_engine(engine_config, llm_config=None) -> InferenceEngine:
     if engine_config.backend == "fake":
         from bcg_tpu.engine.fake import FakeEngine
 
-        engine = FakeEngine(seed=engine_config.fake_seed)
+        engine = FakeEngine(
+            seed=engine_config.fake_seed,
+            policy=getattr(engine_config, "fake_policy", "consensus"),
+        )
     elif engine_config.backend == "jax":
         from bcg_tpu.engine.jax_engine import JaxEngine
 

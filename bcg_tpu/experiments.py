@@ -95,6 +95,7 @@ def run_preset(
     concurrency: int = 1,
     fault_rate: float = 0.0,
     drop_prob: float = 0.0,
+    fake_policy: Optional[str] = None,
 ) -> Dict:
     """Run a preset ``runs`` times and aggregate.
 
@@ -121,6 +122,8 @@ def run_preset(
     engine_cfg = dataclasses.replace(
         resolve_engine_config(model_name, backend), fault_rate=fault_rate
     )
+    if fake_policy is not None:
+        engine_cfg = dataclasses.replace(engine_cfg, fake_policy=fake_policy)
     base_cfg = dataclasses.replace(BCGConfig(), engine=engine_cfg)
     if drop_prob:
         # Fail BEFORE any engine boot (same invariant as fault_rate,
@@ -225,12 +228,16 @@ def main(argv: Optional[List[str]] = None) -> None:
                    help="Route games over the lossy channel with this "
                         "per-message drop probability "
                         "(resilience-vs-loss sweeps)")
+    p.add_argument("--fake-policy", type=str, default=None,
+                   help="Fake-backend scripted policy, e.g. "
+                        "mixed:consensus:oscillate (adversary-strategy "
+                        "sweeps without any LLM; engine/fake.py)")
     args = p.parse_args(argv)
 
     common = dict(runs=args.runs, model_name=args.model, backend=args.backend,
                   max_rounds=args.rounds, seed=args.seed,
                   concurrency=args.concurrency, fault_rate=args.fault_rate,
-                  drop_prob=args.drop_prob)
+                  drop_prob=args.drop_prob, fake_policy=args.fake_policy)
     if args.preset == "scale-sweep":
         out = run_scale_sweep(
             [int(x) for x in args.agents.split(",")],

@@ -1,0 +1,148 @@
+"""Scripted fake-engine policies (engine/fake.py).
+
+The fake backend's policy set is a seeded, LLM-free fault-model axis:
+role-aware mixes ("mixed:<honest>:<byzantine>") script the adversary
+while honest agents play a convergence dynamic — the reference's only
+fault model is the LLM itself, so none of this is reproducible there.
+"""
+
+import pytest
+
+from bcg_tpu.api import run_simulation
+from bcg_tpu.config import BCGConfig, EngineConfig
+from bcg_tpu.engine.fake import FakeEngine
+
+HONEST_DECISION = {
+    "type": "object",
+    "properties": {
+        "internal_strategy": {"type": "string"},
+        "value": {"type": "integer", "minimum": 0, "maximum": 50},
+        "public_reasoning": {"type": "string"},
+    },
+    "required": ["internal_strategy", "value", "public_reasoning"],
+    "additionalProperties": False,
+}
+BYZ_DECISION = {
+    "type": "object",
+    "properties": {
+        "internal_strategy": {"type": "string"},
+        "value": {"anyOf": [{"type": "integer", "minimum": 0, "maximum": 50},
+                            {"const": "abstain"}]},
+        "public_reasoning": {"type": "string"},
+    },
+    "required": ["internal_strategy", "value", "public_reasoning"],
+    "additionalProperties": False,
+}
+HONEST_VOTE = {
+    "type": "object",
+    "properties": {"decision": {"type": "string", "enum": ["stop", "continue"]}},
+    "required": ["decision"], "additionalProperties": False,
+}
+BYZ_VOTE = {
+    "type": "object",
+    "properties": {"decision": {"type": "string",
+                                "enum": ["stop", "continue", "abstain"]}},
+    "required": ["decision"], "additionalProperties": False,
+}
+
+PROMPT = ("Round 2 of 10.\nYour current value: 30\n"
+          "agent_0 value: 10\nagent_1 value: 10\nagent_2 value: 40\n")
+
+
+class TestPolicyUnits:
+    def test_mixed_dispatch_by_schema_shape(self):
+        eng = FakeEngine(policy="mixed:stubborn:silent")
+        assert eng._policy_for(HONEST_DECISION) == "stubborn"
+        assert eng._policy_for(BYZ_DECISION) == "silent"
+        assert eng._policy_for(HONEST_VOTE) == "stubborn"
+        assert eng._policy_for(BYZ_VOTE) == "silent"
+
+    def test_malformed_or_typo_policy_raises_at_construction(self):
+        """A typo'd policy must fail at config time, not silently run
+        the consensus branch (review finding)."""
+        with pytest.raises(ValueError, match="mixed:"):
+            FakeEngine(policy="mixed:only_one")
+        with pytest.raises(ValueError, match="unknown fake policy"):
+            FakeEngine(policy="oscilate")  # the one-letter typo
+        with pytest.raises(ValueError, match="mixed:"):
+            FakeEngine(policy="mixed:consensus:oscilate")
+
+    def test_oscillate_uses_current_round_header(self):
+        """Real prompts carry an uppercase '=== ROUND N ===' header and
+        LOWER-case history lines for earlier rounds; parity must come
+        from the current round (the max), not stale history."""
+        eng = FakeEngine(policy="oscillate")
+        real_shape = ("=== ROUND 2 ===\nYour current value: 30\n"
+                      "PREVIOUS ROUNDS:\nRound 1: agent_0 value: 10\n")
+        assert eng.generate_json(real_shape, BYZ_DECISION)["value"] == 50
+        real_shape3 = real_shape.replace("ROUND 2", "ROUND 3")
+        assert eng.generate_json(real_shape3, BYZ_DECISION)["value"] == 0
+
+    def test_stubborn_keeps_current_value(self):
+        eng = FakeEngine(policy="stubborn")
+        out = eng.generate_json(PROMPT, HONEST_DECISION)
+        assert out["value"] == 30
+
+    def test_median_proposes_order_statistic(self):
+        eng = FakeEngine(policy="median")
+        out = eng.generate_json(PROMPT, HONEST_DECISION)
+        assert out["value"] == 10  # sorted [10, 10, 40] -> middle
+
+    def test_oscillate_alternates_by_round_parity(self):
+        eng = FakeEngine(policy="oscillate")
+        even = eng.generate_json(PROMPT, BYZ_DECISION)  # Round 2
+        odd = eng.generate_json(PROMPT.replace("Round 2", "Round 3"), BYZ_DECISION)
+        assert {even["value"], odd["value"]} == {0, 50}
+        assert eng.generate_json(PROMPT, BYZ_VOTE)["decision"] == "continue"
+
+    def test_mimic_joins_mode_and_votes_stop(self):
+        eng = FakeEngine(policy="mimic")
+        out = eng.generate_json(PROMPT, BYZ_DECISION)
+        assert out["value"] == 10  # the observed mode
+        assert eng.generate_json(PROMPT, BYZ_VOTE)["decision"] == "stop"
+
+    def test_silent_abstains_everywhere_allowed(self):
+        eng = FakeEngine(policy="silent")
+        assert eng.generate_json(PROMPT, BYZ_DECISION)["value"] == "abstain"
+        assert eng.generate_json(PROMPT, BYZ_VOTE)["decision"] == "abstain"
+        # Honest-shaped schemas cannot abstain: degrade to the bound.
+        assert eng.generate_json(PROMPT, HONEST_DECISION)["value"] == 0
+
+
+class TestPolicyGames:
+    def _run(self, policy, honest=4, byz=0, rounds=6, seed=0):
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            BCGConfig(), engine=EngineConfig(backend="fake", fake_policy=policy),
+        )
+        return run_simulation(
+            n_agents=honest + byz, byzantine_count=byz, max_rounds=rounds,
+            backend="fake", seed=seed, config=cfg,
+        )["metrics"]
+
+    def test_stubborn_honest_never_converge(self):
+        m = self._run("stubborn")
+        assert not m["consensus_reached"]
+        assert m["termination_reason"] in ("max_rounds", "vote_without_consensus")
+
+    def test_consensus_still_converges(self):
+        m = self._run("consensus")
+        assert m["consensus_reached"]
+
+    def test_mixed_silent_byzantine_never_infiltrates(self):
+        m = self._run("mixed:consensus:silent", honest=6, byz=2)
+        assert all(v is None for v in m["byzantine_final_values"])
+        assert (m["byzantine_infiltration"] or 0) == 0
+
+    def test_mixed_oscillate_byzantine_proposes_extremes(self):
+        m = self._run("mixed:consensus:oscillate", honest=6, byz=2, rounds=4)
+        observed = {v for v in m["byzantine_final_values"] if v is not None}
+        assert observed <= {0, 50}
+
+    def test_mixed_mimic_joins_consensus_value(self):
+        m = self._run("mixed:consensus:mimic", honest=6, byz=2)
+        if m["consensus_reached"]:
+            assert all(
+                v == m["consensus_value"] for v in m["byzantine_final_values"]
+            )

@@ -418,6 +418,9 @@ def test_int8_decode_kernel_kill_switch(monkeypatch):
     # the monkeypatched backend makes the selection logic believe it is
     # on TPU (construction only — nothing is generated).
     monkeypatch.setattr(_jax, "default_backend", lambda: "tpu")
+    # A pre-set ambient kill-switch (the escape hatch's own use case)
+    # must not poison the default-path assertion.
+    monkeypatch.delenv("BCG_TPU_DISABLE_INT8_DECODE_KERNEL", raising=False)
     cfg = EngineConfig(
         backend="jax", model_name="bcg-tpu/tiny-dh128",
         max_model_len=512, kv_cache_dtype="int8",
