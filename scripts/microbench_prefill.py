@@ -143,6 +143,36 @@ def main():
         print(f"  {name:<28s} {dt*1e3:7.2f} ms  {attn_flops/dt/1e12:6.1f} TF/s"
               f"  {100*attn_flops/dt/PEAK_BF16:5.1f}% peak")
 
+    # A/B against the OFFICIAL jax pallas TPU flash kernel (no GQA: KV
+    # repeated to H heads, so it carries group x the KV bytes — prefill
+    # at these shapes is compute-dominated, so the comparison is still
+    # apples-to-apples on the score/AV pipeline).
+    try:
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention as jax_flash,
+        )
+
+        group = H // Hkv
+        kr = jnp.repeat(k_, group, axis=2).transpose(0, 2, 1, 3)  # [B,H,S,Dh]
+        vr = jnp.repeat(v_, group, axis=2).transpose(0, 2, 1, 3)
+
+        def jf_body(i, carry):
+            qq, acc = carry
+            out = jax_flash(
+                qq.transpose(0, 2, 1, 3), kr, vr,
+                causal=True, sm_scale=scale,
+            )
+            out = out.transpose(0, 2, 1, 3)
+            return (feedback(qq, out), acc + out.astype(jnp.float32).mean())
+
+        dt = loop_time(jf_body, (q, jnp.float32(0)))
+        print(f"  {'official jax tpu flash':<28s} {dt*1e3:7.2f} ms  "
+              f"{attn_flops/dt/1e12:6.1f} TF/s"
+              f"  {100*attn_flops/dt/PEAK_BF16:5.1f}% peak")
+    except Exception as exc:  # noqa: BLE001 — comparison point, not critical
+        print(f"  official jax tpu flash: unavailable ({type(exc).__name__}: "
+              f"{str(exc)[:120]})")
+
     # Rope + rmsnorm via the PRODUCTION ops (transformer.py) at the
     # spec's constants, so the microbench measures the real code path
     # (bandwidth-bound elementwise; report ms + GB/s).
