@@ -182,6 +182,23 @@ def schema_to_ast(schema: Dict[str, Any], ws: Optional[Node] = None) -> Node:
     if t == "object":
         return _object_ast(schema, ws)
     if t == "string":
+        if "pattern" in schema:
+            # pattern strings (reference parity: vLLM's outlines-style
+            # guided decoding accepts them).  Enforcing pattern AND
+            # length bounds simultaneously needs automaton intersection
+            # — reject loudly instead of silently dropping one.
+            if schema.get("minLength") or schema.get("maxLength") is not None:
+                raise ValueError(
+                    "string schema with BOTH pattern and "
+                    "minLength/maxLength is not supported; encode the "
+                    "length bound in the pattern itself"
+                )
+            from bcg_tpu.guided.regex_parser import (
+                json_escape_transform, parse_pattern,
+            )
+
+            value_ast = json_escape_transform(parse_pattern(schema["pattern"]))
+            return seq(char('"'), value_ast, char('"'))
         return string_ast(
             min_len=schema.get("minLength", 0),
             max_len=schema.get("maxLength"),

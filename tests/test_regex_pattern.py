@@ -1,0 +1,163 @@
+"""JSON-schema ``pattern`` support (guided/regex_parser.py).
+
+The reference's guided decoding (vLLM outlines-style) accepts
+``pattern`` on string schemas; these tests pin the TPU pipeline's
+parser, its JSON-escape transform, byte-DFA acceptance, and end-to-end
+guided generation through the real engine.
+"""
+
+import json
+
+import pytest
+
+from bcg_tpu.guided.dfa import ast_to_dfa
+from bcg_tpu.guided.regex_parser import (
+    PatternError,
+    json_escape_transform,
+    parse_pattern,
+)
+from bcg_tpu.guided.schema_compiler import schema_to_ast
+
+
+def matches(pattern: str, value: str) -> bool:
+    dfa = ast_to_dfa(parse_pattern(pattern))
+    return dfa.matches(value.encode())
+
+
+class TestParser:
+    @pytest.mark.parametrize("pattern,yes,no", [
+        ("abc", ["abc"], ["ab", "abcd", ""]),
+        ("a|bc", ["a", "bc"], ["b", "abc"]),
+        ("a*", ["", "a", "aaaa"], ["b"]),
+        ("a+b?", ["a", "ab", "aaab"], ["", "b", "abb"]),
+        ("[a-c]x", ["ax", "bx", "cx"], ["dx", "x"]),
+        ("[^a-y]", ["z", "0", "!"], ["a", "m"]),
+        (r"\d{3}", ["123", "000"], ["12", "1234", "abc"]),
+        (r"\d{2,}", ["12", "123456"], ["1"]),
+        (r"\d{1,3}", ["1", "12", "123"], ["", "1234"]),
+        (r"\w+@\w+", ["a@b", "user_1@host9"], ["@b", "a@"]),
+        (r"a\.b", ["a.b"], ["axb"]),
+        (r"(ab)+", ["ab", "abab"], ["a", "aba"]),
+        (r"(?:x|y)z", ["xz", "yz"], ["z", "xyz"]),
+        ("^AB-[0-9]{2}$", ["AB-07"], ["AB-7", "ab-07"]),
+        (r"a\sb", ["a b", "a\tb"], ["ab"]),
+        (r"\S+", ["abc!"], ["a b", ""]),
+        (".+", ["anything at all"], [""]),
+    ])
+    def test_match_semantics(self, pattern, yes, no):
+        for v in yes:
+            assert matches(pattern, v), (pattern, v)
+        for v in no:
+            assert not matches(pattern, v), (pattern, v)
+
+    @pytest.mark.parametrize("bad", [
+        "a{2,1}", "a{x}", "(ab", "[a", "[]", "a**b$x", "mid^dle",
+        "a$b", r"\q", "(?=look)",
+    ])
+    def test_malformed_or_unsupported_raises(self, bad):
+        with pytest.raises((PatternError, ValueError)):
+            parse_pattern(bad)
+
+    def test_anchors_are_whole_string(self):
+        # Anchored and unanchored parse to the SAME automaton (documented
+        # outlines-convention divergence from JSON-Schema search
+        # semantics).
+        assert matches("^abc$", "abc")
+        assert not matches("abc", "xabcy")
+
+
+class TestJsonEscapeTransform:
+    def test_quote_and_backslash_become_escapes(self):
+        ast = json_escape_transform(parse_pattern(r'.+'))
+        dfa = ast_to_dfa(ast)
+        # A raw '"' in the VALUE must be emitted as the two bytes \" .
+        assert dfa.matches(b'a\\"b')
+        assert not dfa.matches(b'a"b')
+        assert dfa.matches(b"a\\\\b")
+
+    def test_newline_class_emits_escape(self):
+        ast = json_escape_transform(parse_pattern(r"a\nb"))
+        dfa = ast_to_dfa(ast)
+        assert dfa.matches(b"a\\nb")
+        assert not dfa.matches(b"a\nb")
+
+
+class TestSchemaIntegration:
+    def test_pattern_schema_accepts_only_matching_json(self):
+        schema = {
+            "type": "object",
+            "properties": {"code": {"type": "string",
+                                    "pattern": "^[A-Z]{2}-[0-9]{3}$"}},
+            "required": ["code"],
+            "additionalProperties": False,
+        }
+        dfa = ast_to_dfa(schema_to_ast(schema))
+        assert dfa.matches(json.dumps({"code": "AB-123"}).encode())
+        assert not dfa.matches(json.dumps({"code": "ab-123"}).encode())
+        assert not dfa.matches(json.dumps({"code": "AB-12"}).encode())
+
+    def test_pattern_with_length_bounds_rejected(self):
+        schema = {"type": "string", "pattern": "a+", "minLength": 2}
+        with pytest.raises(ValueError, match="pattern and"):
+            schema_to_ast(schema)
+
+    def test_engine_generates_matching_string(self):
+        import re
+
+        from bcg_tpu.config import EngineConfig
+        from bcg_tpu.engine.jax_engine import JaxEngine
+
+        engine = JaxEngine(EngineConfig(
+            backend="jax", model_name="bcg-tpu/tiny-test", max_model_len=512,
+        ))
+        schema = {
+            "type": "object",
+            "properties": {"tag": {"type": "string",
+                                   "pattern": "^[a-c]{2}[0-9]$"}},
+            "required": ["tag"],
+            "additionalProperties": False,
+        }
+        out = engine.generate_json("name a tag", schema,
+                                   temperature=0.9, max_tokens=24)
+        assert re.fullmatch(r"[a-c]{2}[0-9]", out.get("tag", "")), out
+        engine.shutdown()
+
+
+class TestNonAscii:
+    """Non-ASCII input must fail loudly (review findings: ord(c) byte
+    classes outside the alphabet either force broken UTF-8 or silently
+    dead-end generation)."""
+
+    @pytest.mark.parametrize("bad", ["é", "a→b", "[aé]", "x[α-ω]"])
+    def test_non_ascii_raises(self, bad):
+        with pytest.raises(PatternError, match="non-ASCII"):
+            parse_pattern(bad)
+
+
+class TestQuantifierAndRangeEdges:
+    """Review findings: stacked/lazy quantifiers and escaped-char ranges
+    must behave like ECMA or fail loudly — never silently diverge."""
+
+    @pytest.mark.parametrize("bad", ["a+?", "a**", "a{2,3}?", "a?+"])
+    def test_stacked_or_lazy_quantifiers_raise(self, bad):
+        with pytest.raises(PatternError, match="quantifier"):
+            parse_pattern(bad)
+
+    def test_escaped_range_start(self):
+        # [\t-\n] is the range 0x09-0x0A, not {tab, '-', newline}.
+        dfa = ast_to_dfa(parse_pattern(r"[\t-\n]"))
+        assert dfa.matches(b"\x09")
+        assert dfa.matches(b"\x0a")
+        assert not dfa.matches(b"-")
+
+    def test_range_spanning_alphabet_hole_raises(self):
+        # [\t-\r] includes VT/FF (0x0B/0x0C), which a JSON string in
+        # this pipeline's ASCII alphabet cannot emit — loud rejection
+        # beats silently narrowing the author's range.
+        with pytest.raises(PatternError, match="outside the ASCII"):
+            parse_pattern(r"[\t-\r]")
+
+    def test_named_class_cannot_start_range(self):
+        # \d is multi-char: '-' after it is a literal member.
+        dfa = ast_to_dfa(parse_pattern(r"[\d-]"))
+        assert dfa.matches(b"5") and dfa.matches(b"-")
