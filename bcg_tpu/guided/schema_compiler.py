@@ -51,6 +51,17 @@ from bcg_tpu.guided.regex_ast import (
 _WS_CHAR = char_set(" \n\t")
 WS = seq(opt(_WS_CHAR), opt(_WS_CHAR), opt(_WS_CHAR))
 
+
+def _json_value_literal(v) -> "Node":
+    """One JSON scalar as an exact-serialization literal (enum/const)."""
+    if isinstance(v, str):
+        return json_string_literal(v)
+    if isinstance(v, bool):
+        return literal("true" if v else "false")
+    if v is None:
+        return literal("null")
+    return literal(json.dumps(v))
+
 # String content byte: printable ASCII except '"' and '\'.
 _CONTENT = CharClass(
     frozenset(b for b in range(0x20, 0x7F) if b not in (0x22, 0x5C))
@@ -163,20 +174,20 @@ def schema_to_ast(schema: Dict[str, Any], ws: Optional[Node] = None) -> Node:
     if ws is None:
         ws = WS
     if "enum" in schema:
-        options = []
-        for v in schema["enum"]:
-            if isinstance(v, str):
-                options.append(json_string_literal(v))
-            elif isinstance(v, bool):
-                options.append(literal("true" if v else "false"))
-            elif v is None:
-                options.append(literal("null"))
-            else:
-                options.append(literal(str(v)))
-        return alt(*options)
+        return alt(*(_json_value_literal(v) for v in schema["enum"]))
+
+    if "const" in schema:  # const == a one-value enum
+        return _json_value_literal(schema["const"])
 
     if "anyOf" in schema:
         return alt(*(schema_to_ast(s, ws) for s in schema["anyOf"]))
+
+    if "oneOf" in schema:
+        # For GENERATION, oneOf's at-most-one-branch exclusivity cannot
+        # be enforced by an alternation automaton; like outlines, treat
+        # it as anyOf (a value matching several branches is still a
+        # value the author's schema accepts under any sane branch set).
+        return alt(*(schema_to_ast(s, ws) for s in schema["oneOf"]))
 
     t = schema.get("type")
     if t == "object":

@@ -350,3 +350,39 @@ class TestCompletionPaths:
         assert 0 < td.dist[td.start] < 80
         reachable = td.transitions.max(axis=1) >= 0
         assert (td.dist[reachable] < 1000).all()
+
+
+class TestConstOneOf:
+    """const (a one-value enum) and oneOf (generation-side anyOf) —
+    accepted by the reference's outlines-style guided backend."""
+
+    def test_const_string_and_int(self):
+        from bcg_tpu.guided.dfa import ast_to_dfa
+        from bcg_tpu.guided.schema_compiler import schema_to_ast
+
+        d = ast_to_dfa(schema_to_ast({"const": "abstain"}))
+        assert d.matches(b'"abstain"') and not d.matches(b'"abstain2"')
+        d = ast_to_dfa(schema_to_ast({"const": 7}))
+        assert d.matches(b"7") and not d.matches(b"8")
+        d = ast_to_dfa(schema_to_ast({"const": None}))
+        assert d.matches(b"null")
+
+    def test_oneof_alternates(self):
+        import json as _json
+
+        from bcg_tpu.guided.dfa import ast_to_dfa
+        from bcg_tpu.guided.schema_compiler import schema_to_ast
+
+        schema = {
+            "type": "object",
+            "properties": {"value": {"oneOf": [
+                {"type": "integer", "minimum": 0, "maximum": 9},
+                {"const": "abstain"},
+            ]}},
+            "required": ["value"],
+            "additionalProperties": False,
+        }
+        d = ast_to_dfa(schema_to_ast(schema))
+        assert d.matches(_json.dumps({"value": 5}).encode())
+        assert d.matches(_json.dumps({"value": "abstain"}).encode())
+        assert not d.matches(_json.dumps({"value": 77}).encode())
