@@ -53,14 +53,17 @@ WS = seq(opt(_WS_CHAR), opt(_WS_CHAR), opt(_WS_CHAR))
 
 
 def _json_value_literal(v) -> "Node":
-    """One JSON scalar as an exact-serialization literal (enum/const)."""
-    if isinstance(v, str):
-        return json_string_literal(v)
-    if isinstance(v, bool):
-        return literal("true" if v else "false")
-    if v is None:
-        return literal("null")
-    return literal(json.dumps(v))
+    """One JSON SCALAR as an exact-serialization literal (enum/const).
+
+    Containers are rejected: their single json.dumps serialization
+    (", "-separated) would conflict with the grammar's own whitespace
+    policy and silently fail compact-mode validation.
+    """
+    if not isinstance(v, (str, int, float, bool)) and v is not None:
+        raise ValueError(
+            f"Unsupported enum/const value {v!r}: only JSON scalars"
+        )
+    return literal(json.dumps(v, ensure_ascii=True))
 
 # String content byte: printable ASCII except '"' and '\'.
 _CONTENT = CharClass(
@@ -173,6 +176,13 @@ def schema_to_ast(schema: Dict[str, Any], ws: Optional[Node] = None) -> Node:
     unaffected; emitted JSON is always valid either way)."""
     if ws is None:
         ws = WS
+    for alt_key in ("enum", "anyOf", "oneOf"):
+        if alt_key in schema and not schema[alt_key]:
+            # An empty alternation compiles to a match-NOTHING automaton
+            # whose first generation step dead-masks every token — fail
+            # here, at the root cause, instead.
+            raise ValueError(f"Unsupported schema: empty {alt_key}")
+
     if "enum" in schema:
         return alt(*(_json_value_literal(v) for v in schema["enum"]))
 

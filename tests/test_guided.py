@@ -386,3 +386,16 @@ class TestConstOneOf:
         assert d.matches(_json.dumps({"value": 5}).encode())
         assert d.matches(_json.dumps({"value": "abstain"}).encode())
         assert not d.matches(_json.dumps({"value": 77}).encode())
+
+    def test_container_const_and_empty_alternations_raise(self):
+        import pytest as _pytest
+
+        from bcg_tpu.guided.schema_compiler import schema_to_ast
+
+        with _pytest.raises(ValueError, match="only JSON scalars"):
+            schema_to_ast({"const": [1, 2]})
+        with _pytest.raises(ValueError, match="only JSON scalars"):
+            schema_to_ast({"enum": [{"a": 1}]})
+        for key in ("enum", "anyOf", "oneOf"):
+            with _pytest.raises(ValueError, match=f"empty {key}"):
+                schema_to_ast({key: []})
