@@ -225,7 +225,29 @@ def schema_to_ast(schema: Dict[str, Any], ws: Optional[Node] = None) -> Node:
             max_len=schema.get("maxLength"),
         )
     if t == "integer":
-        return int_range_ast(schema.get("minimum"), schema.get("maximum"))
+        import math
+
+        lo = schema.get("minimum")
+        hi = schema.get("maximum")
+        # Exclusive bounds, draft-06+ NUMERIC form only (the draft-04
+        # boolean form would silently mis-compile via int(True)).
+        # floor/ceil handle non-integral bounds: the smallest integer
+        # strictly above ex is floor(ex)+1, the largest strictly below
+        # is ceil(ex)-1 — int() truncation is off by one for them.
+        ex_lo = schema.get("exclusiveMinimum")
+        ex_hi = schema.get("exclusiveMaximum")
+        if isinstance(ex_lo, bool) or isinstance(ex_hi, bool):
+            raise ValueError(
+                "boolean exclusiveMinimum/exclusiveMaximum (draft-04 "
+                "form) is not supported; use the numeric draft-06+ form"
+            )
+        if ex_lo is not None:
+            ex = math.floor(ex_lo) + 1
+            lo = ex if lo is None else max(int(lo), ex)
+        if ex_hi is not None:
+            ex = math.ceil(ex_hi) - 1
+            hi = ex if hi is None else min(int(hi), ex)
+        return int_range_ast(lo, hi)
     if t == "number":
         return number_ast()
     if t == "boolean":
@@ -235,8 +257,26 @@ def schema_to_ast(schema: Dict[str, Any], ws: Optional[Node] = None) -> Node:
     if t == "array":
         item = schema.get("items", {"type": "string"})
         inner = schema_to_ast(item, ws)
-        items = opt(seq(inner, star(seq(ws, char(","), ws, inner))))
-        return seq(char("["), ws, items, ws, char("]"))
+        min_items = int(schema.get("minItems", 0))
+        max_items = schema.get("maxItems")
+        if min_items < 0 or (max_items is not None and int(max_items) < min_items):
+            raise ValueError(
+                f"invalid array bounds minItems={min_items} maxItems={max_items}"
+            )
+        follow = seq(ws, char(","), ws, inner)
+        if max_items is not None:
+            max_i = int(max_items)
+            if max_i == 0:
+                body = EPS
+            elif min_items >= 1:
+                body = seq(inner, bounded(follow, min_items - 1, max_i - 1))
+            else:
+                body = opt(seq(inner, bounded(follow, 0, max_i - 1)))
+        elif min_items >= 1:
+            body = seq(inner, *([follow] * (min_items - 1)), star(follow))
+        else:
+            body = opt(seq(inner, star(follow)))
+        return seq(char("["), ws, body, ws, char("]"))
     raise ValueError(f"Unsupported schema: {schema!r}")
 
 

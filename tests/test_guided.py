@@ -399,3 +399,66 @@ class TestConstOneOf:
         for key in ("enum", "anyOf", "oneOf"):
             with _pytest.raises(ValueError, match=f"empty {key}"):
                 schema_to_ast({key: []})
+
+
+class TestArrayBoundsAndExclusive:
+    """minItems/maxItems and exclusiveMinimum/Maximum (accepted by the
+    reference's guided backend; previously ignored/unsupported here)."""
+
+    def _dfa(self, schema):
+        from bcg_tpu.guided.dfa import ast_to_dfa
+        from bcg_tpu.guided.schema_compiler import schema_to_ast
+
+        return ast_to_dfa(schema_to_ast(schema))
+
+    def test_array_item_count_bounds(self):
+        d = self._dfa({"type": "array",
+                       "items": {"type": "integer", "minimum": 0, "maximum": 9},
+                       "minItems": 2, "maxItems": 3})
+        assert not d.matches(b"[1]")
+        assert d.matches(b"[1, 2]")
+        assert d.matches(b"[1, 2, 3]")
+        assert not d.matches(b"[1, 2, 3, 4]")
+        assert not d.matches(b"[]")
+
+    def test_array_min_only_and_max_zero(self):
+        d = self._dfa({"type": "array", "items": {"type": "integer"},
+                       "minItems": 1})
+        assert not d.matches(b"[]")
+        assert d.matches(b"[1]") and d.matches(b"[1, 2, 3, 4, 5]")
+        d0 = self._dfa({"type": "array", "items": {"type": "integer"},
+                        "maxItems": 0})
+        assert d0.matches(b"[]") and not d0.matches(b"[1]")
+
+    def test_array_invalid_bounds_raise(self):
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="array bounds"):
+            self._dfa({"type": "array", "items": {"type": "integer"},
+                       "minItems": 3, "maxItems": 2})
+
+    def test_exclusive_integer_bounds(self):
+        d = self._dfa({"type": "integer",
+                       "exclusiveMinimum": 0, "exclusiveMaximum": 10})
+        assert not d.matches(b"0")
+        assert d.matches(b"1") and d.matches(b"9")
+        assert not d.matches(b"10")
+
+    def test_exclusive_combines_with_inclusive(self):
+        d = self._dfa({"type": "integer", "minimum": 3, "exclusiveMinimum": 4,
+                       "maximum": 9})
+        assert not d.matches(b"4")
+        assert d.matches(b"5") and d.matches(b"9")
+
+    def test_exclusive_bound_edges(self):
+        import pytest as _pytest
+
+        # Non-integral bounds: 9 < 9.5 must be admitted; 0 > -0.5 too.
+        d = self._dfa({"type": "integer", "exclusiveMaximum": 9.5,
+                       "exclusiveMinimum": -0.5})
+        assert d.matches(b"0") and d.matches(b"9")
+        assert not d.matches(b"10") and not d.matches(b"-1")
+        # Draft-04 boolean form fails loudly instead of mis-compiling.
+        with _pytest.raises(ValueError, match="draft-04"):
+            self._dfa({"type": "integer", "minimum": 5,
+                       "exclusiveMinimum": True})
