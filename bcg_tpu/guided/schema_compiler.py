@@ -249,6 +249,19 @@ def schema_to_ast(schema: Dict[str, Any], ws: Optional[Node] = None) -> Node:
             hi = ex if hi is None else min(int(hi), ex)
         return int_range_ast(lo, hi)
     if t == "number":
+        if any(k in schema for k in (
+            "minimum", "maximum", "exclusiveMinimum", "exclusiveMaximum",
+        )):
+            import warnings
+
+            # Float range enforcement needs decimal digit-DP the
+            # automaton does not implement (outlines likewise skips
+            # it) — generate unconstrained, but never silently.
+            warnings.warn(
+                "number schema bounds (minimum/maximum) are not "
+                "enforced by guided decoding; use type 'integer' for "
+                "enforced ranges",
+            )
         return number_ast()
     if t == "boolean":
         return alt(literal("true"), literal("false"))

@@ -462,3 +462,17 @@ class TestArrayBoundsAndExclusive:
         with _pytest.raises(ValueError, match="draft-04"):
             self._dfa({"type": "integer", "minimum": 5,
                        "exclusiveMinimum": True})
+
+    def test_number_bounds_warn_unenforced(self):
+        import warnings as _warnings
+
+        from bcg_tpu.guided.schema_compiler import schema_to_ast
+
+        with _warnings.catch_warnings(record=True) as got:
+            _warnings.simplefilter("always")
+            schema_to_ast({"type": "number", "minimum": 0.5})
+        assert any("not enforced" in str(w.message) for w in got)
+        with _warnings.catch_warnings(record=True) as got:
+            _warnings.simplefilter("always")
+            schema_to_ast({"type": "number"})
+        assert not got
