@@ -6,10 +6,19 @@ basics, mirroring what vLLM's guided decoding (outlines-style) accepts:
 
 * ``object`` with ordered ``properties``, ``required`` subsets,
   ``additionalProperties: false``
-* ``string`` (sanitised ASCII content with escapes), ``enum`` of strings
-* ``integer`` with ``minimum``/``maximum`` (tight digit-DP range regex)
-* ``number``, ``boolean``, ``null``, ``array`` (bounded whitespace)
-* ``anyOf`` alternation (the Byzantine ``int | "abstain"`` case)
+* ``string`` (sanitised ASCII content with escapes) with
+  ``minLength``/``maxLength`` or a ``pattern`` regex
+  (guided/regex_parser.py), ``enum``/``const`` scalars
+* ``integer`` with ``minimum``/``maximum`` and numeric
+  ``exclusiveMinimum``/``exclusiveMaximum`` (tight digit-DP range regex)
+* ``number``, ``boolean``, ``null``; ``array`` with
+  ``minItems``/``maxItems`` (bounded whitespace)
+* ``anyOf``/``oneOf`` alternation (the Byzantine ``int | "abstain"``
+  case)
+
+Anything outside this surface fails loudly at schema-compile time —
+silent divergence from the author's schema is the one unacceptable
+failure mode for a constrained decoder.
 
 Strings are restricted to printable ASCII + escaped ``\\" \\\\ \\n \\t``:
 the game prompts demand English-only output, and a byte-exact ASCII
