@@ -173,6 +173,41 @@ def main():
         print(f"  official jax tpu flash: unavailable ({type(exc).__name__}: "
               f"{str(exc)[:120]})")
 
+    # A/B against the official SPLASH kernel, GQA-NATIVE via the MQA
+    # variant (per kv-head: `group` query heads share one KV stream —
+    # no KV repeat, unlike the flash row above).  q is pre-scaled
+    # (splash applies no sm_scale itself).
+    try:
+        from jax.experimental.pallas.ops.tpu.splash_attention import (
+            splash_attention_kernel as sk,
+            splash_attention_mask as sm,
+        )
+
+        group = H // Hkv
+        smask = sm.MultiHeadMask([sm.CausalMask((L, S)) for _ in range(group)])
+        mqa = sk.make_splash_mqa(smask, head_shards=1, q_seq_shards=1,
+                                 block_sizes=sk.BlockSizes.get_default())
+        splash_fn = jax.vmap(jax.vmap(mqa))  # over batch, then kv-head
+
+        kg = k_.transpose(0, 2, 1, 3)                      # [B,Hkv,S,Dh]
+        vg = v_.transpose(0, 2, 1, 3)
+
+        def sp_body(i, carry):
+            qq, acc = carry
+            qg2 = (qq * scale).transpose(0, 2, 1, 3).reshape(
+                B, Hkv, group, L, Dh)
+            out = splash_fn(qg2, kg, vg)                   # [B,Hkv,g,L,Dh]
+            out = out.reshape(B, H, L, Dh).transpose(0, 2, 1, 3)
+            return (feedback(qq, out), acc + out.astype(jnp.float32).mean())
+
+        dt = loop_time(sp_body, (q, jnp.float32(0)))
+        print(f"  {'official splash (GQA-mqa)':<28s} {dt*1e3:7.2f} ms  "
+              f"{attn_flops/dt/1e12:6.1f} TF/s"
+              f"  {100*attn_flops/dt/PEAK_BF16:5.1f}% peak")
+    except Exception as exc:  # noqa: BLE001 — comparison point, not critical
+        print(f"  official splash: unavailable ({type(exc).__name__}: "
+              f"{str(exc)[:120]})")
+
     # Rope + rmsnorm via the PRODUCTION ops (transformer.py) at the
     # spec's constants, so the microbench measures the real code path
     # (bandwidth-bound elementwise; report ms + GB/s).
