@@ -130,6 +130,12 @@ run_step() {
     fi
   fi
   step_spec "$name" || { log "BUG: no spec for step $name"; touch "$OUT/$name.skip"; return 0; }
+  # Never START a step that could still be running at the deadline —
+  # a leftover bench process would contend with the driver's own run.
+  if [ $(( $(date -u +%s) + TMOS )) -gt "${DEADLINE:-9999999999}" ]; then
+    log "DEFER $name: its timeout window crosses the watcher deadline"
+    return 2
+  fi
   log "START $name"
   timeout "$TMOS" "${CMD[@]}" > "$OUT/$name.json" 2> "$OUT/$name.log"
   local rc=$?
@@ -197,8 +203,17 @@ all_done() {
   return 0
 }
 
+# Hard deadline (epoch seconds; env-overridable): the watcher must be
+# gone before the round driver runs its own bench — two engines
+# contending for one 16 GB chip would OOM the driver's recorded number.
+DEADLINE=${HW_WATCHER_DEADLINE:-1785508800}  # 2026-07-31 14:40 UTC
+
 log "watcher started (pid $$)"
 while true; do
+  if [ "$(date -u +%s)" -ge "$DEADLINE" ]; then
+    log "deadline reached — exiting to leave the chip to the driver"
+    exit 0
+  fi
   if all_done; then log "queue fully drained — exiting"; exit 0; fi
   if probe; then
     log "probe OK — draining queue"
