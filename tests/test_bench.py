@@ -97,5 +97,8 @@ def test_fake_backend_end_to_end_smoke(monkeypatch, capsys):
     assert out["metric"] == "agent_decisions_per_sec"
     assert out["value"] > 0
     for key in ("quantization", "kv_cache_dtype", "fast_forward",
-                "prefix_caching", "scan_layers", "shared_core_votes"):
+                "prefix_caching", "scan_layers", "shared_core_votes",
+                "boot_plus_first_round_s"):
         assert key in out["extra"]
+    # Cold-boot metric is a real measurement, not the None fallback.
+    assert out["extra"]["boot_plus_first_round_s"] is not None
