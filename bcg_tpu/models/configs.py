@@ -89,6 +89,41 @@ class ModelSpec:
         embed_total = embed if self.tie_embeddings else 2 * embed
         return embed_total + self.num_layers * self.matmul_params_per_layer
 
+    def weight_bytes(self, quantization: Optional[str] = None) -> int:
+        """Estimated served-weight footprint in bytes for a quantization
+        mode (None = bf16, "int8" = W8A8, "int4" = grouped W4A16).
+
+        Counts what the engine actually holds: the bf16 embedding table
+        (token gathers stay bf16), a quantized LM head (explicit for
+        tied models too, models/quantize.py), and the per-layer matmul
+        weights with their scale tensors (int8: f32 per-output-channel;
+        int4: bf16 per (group=128, output)).  Norm vectors are noise.
+        This is the capacity-math half of the single-chip fit question;
+        add KV cache + activations (config-dependent) for the total.
+        """
+        embed = self.vocab_size * self.hidden_size  # bf16 gathers
+        mm = self.num_layers * self.matmul_params_per_layer + embed  # + head
+        # Scale elements = one per output channel (int8) or per
+        # (group, output) (int4).  Output-channel totals per layer:
+        out_per_layer = (
+            self.q_size + 2 * self.kv_size + self.hidden_size
+            + 2 * self.intermediate_size + self.hidden_size
+        )
+        out_total = self.num_layers * out_per_layer + self.vocab_size
+        if quantization is None:
+            # Tied bf16 serving shares ONE table (transformer._logits
+            # uses embed.T; no lm_head is stored) — don't double-count.
+            head_bf16 = 0 if self.tie_embeddings else embed
+            return embed * 2 + (mm - embed + head_bf16) * 2
+        if quantization == "int8":
+            return embed * 2 + mm + out_total * 4
+        if quantization == "int4":
+            group = 128
+            # gscale elements ~= (in/group) * out summed over matmuls
+            # ~= mm / group.
+            return embed * 2 + mm // 2 + (mm // group) * 2
+        raise ValueError(f"unknown quantization {quantization!r}")
+
 
 MODEL_SPECS: Dict[str, ModelSpec] = {
     # Qwen3 dense family (HF config.json values).

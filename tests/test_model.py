@@ -147,3 +147,49 @@ def test_llama3_rope_scaling():
     spec = spec_for_model("meta-llama/Meta-Llama-3.1-8B-Instruct")
     assert spec.rope_scaling is not None and spec.rope_scaling.factor == 8.0
     assert spec_for_model("Qwen/Qwen2.5-7B-Instruct").attn_bias
+
+
+class TestCapacityMath:
+    """Single-chip fit story as tested arithmetic (16 GB v5e, ~15.75
+    usable): which presets board one chip at which quantization —
+    weights must leave room for KV cache + activations (~3 GB at game
+    shapes), so the serving-fit bar is ~12 GB of weights."""
+
+    USABLE = 15.75 * (1 << 30)
+    SERVING_FIT = 12.0 * (1 << 30)
+
+    def _wb(self, name, mode):
+        return spec_for_model(name).weight_bytes(mode)
+
+    def test_fit_matrix(self):
+        # 1B serves even in bf16.
+        assert self._wb("bcg-tpu/bench-1b", None) < self.SERVING_FIT
+        # 8B needs quantized weights; int8 fits with room for cache.
+        assert self._wb("bcg-tpu/bench-8b", None) > self.SERVING_FIT
+        assert self._wb("bcg-tpu/bench-8b", "int8") < self.SERVING_FIT
+        # 14B: int8 weights alone nearly fill the chip; int4 serves.
+        assert self._wb("bcg-tpu/bench-14b", "int8") > self.SERVING_FIT
+        assert self._wb("bcg-tpu/bench-14b", "int4") < self.SERVING_FIT
+        # 32B cannot board one chip even at int4 -> tp>=2 territory.
+        assert self._wb("bcg-tpu/bench-32b", "int4") > self.USABLE
+
+    def test_estimates_track_modes(self):
+        for name in ("bcg-tpu/bench-1b", "bcg-tpu/bench-8b"):
+            bf16 = self._wb(name, None)
+            i8 = self._wb(name, "int8")
+            i4 = self._wb(name, "int4")
+            assert bf16 > i8 > i4
+            # int8 halves the matmul bytes (embedding stays bf16).
+            assert 0.4 * bf16 < i8 < 0.62 * bf16
+
+    def test_tied_embeddings_not_double_counted_bf16(self):
+        import dataclasses
+
+        spec = spec_for_model("bcg-tpu/bench-1b")
+        tied = dataclasses.replace(spec, tie_embeddings=True)
+        embed_bytes = spec.vocab_size * spec.hidden_size * 2
+        # bf16: tied serving shares one table -> exactly one head less.
+        assert spec.weight_bytes(None) - tied.weight_bytes(None) == embed_bytes
+        # Quantized: tied models materialize an explicit quantized head
+        # (models/quantize.py ensure_quantized_head) -> same estimate.
+        assert spec.weight_bytes("int8") == tied.weight_bytes("int8")
