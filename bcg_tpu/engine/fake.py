@@ -185,7 +185,11 @@ class FakeEngine(InferenceEngine):
             value: Any = lo
         elif policy == "stubborn":
             # Never follows: the deterministic no-consensus dynamic.
+            # Clamp like every other numeric branch — an out-of-range
+            # "Your current value" line must not yield a schema-
+            # violating emission.
             value = current_value if current_value is not None else (lo + hi) // 2
+            value = max(lo, min(hi, value))
         elif policy == "median":
             if observed:
                 ordered = sorted(observed)

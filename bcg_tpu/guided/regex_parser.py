@@ -6,8 +6,9 @@ this parser closes that sub-gap for the TPU guided pipeline.  The
 supported subset is the practical outlines-compatible core:
 
 * literals (printable ASCII), ``.`` (any string-content char)
-* escapes ``\\d \\D \\w \\W \\s \\S`` and escaped metacharacters /
-  ``\\n \\t \\r``
+* escapes ``\\d \\D \\w \\W \\s \\S``, ``\\n \\t \\r``, and identity
+  escapes of any printable non-alphanumeric ASCII char (``\\" \\- \\!``
+  ... — the ECMA convention pattern authors expect)
 * character classes ``[abc]``, ranges ``[a-z0-9]``, negation ``[^...]``
   (complement within printable ASCII + ``\\n\\t\\r``)
 * quantifiers ``* + ?`` and ``{m} {m,} {m,n}``
@@ -199,7 +200,11 @@ class _Parser:
         controls = {"n": 0x0A, "t": 0x09, "r": 0x0D}
         if c in controls:
             return CharClass(frozenset({controls[c]}))
-        if c in _META or c in "-/]":
+        # Identity escapes: ECMA-262 lets any non-word punctuation be
+        # escaped to itself, and pattern authors habitually write \" or
+        # \/ even where the raw char would do.  Accept every printable
+        # non-alphanumeric ASCII char (covers _META and '-/]').
+        if ord(c) in _VALUE_BYTES and not c.isalnum() and c.isprintable():
             return CharClass(frozenset({ord(c)}))
         raise self.fail(f"unsupported escape \\{c}")
 

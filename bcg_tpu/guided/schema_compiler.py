@@ -238,6 +238,13 @@ def schema_to_ast(schema: Dict[str, Any], ws: Optional[Node] = None) -> Node:
 
         lo = schema.get("minimum")
         hi = schema.get("maximum")
+        # Inclusive non-integral bounds: the smallest admissible
+        # integer >= 4.5 is 5 (ceil), the largest <= 4.5 is 4 (floor)
+        # — int() truncation would admit 4 for minimum=4.5.
+        if lo is not None:
+            lo = math.ceil(lo)
+        if hi is not None:
+            hi = math.floor(hi)
         # Exclusive bounds, draft-06+ NUMERIC form only (the draft-04
         # boolean form would silently mis-compile via int(True)).
         # floor/ceil handle non-integral bounds: the smallest integer
@@ -252,10 +259,10 @@ def schema_to_ast(schema: Dict[str, Any], ws: Optional[Node] = None) -> Node:
             )
         if ex_lo is not None:
             ex = math.floor(ex_lo) + 1
-            lo = ex if lo is None else max(int(lo), ex)
+            lo = ex if lo is None else max(lo, ex)
         if ex_hi is not None:
             ex = math.ceil(ex_hi) - 1
-            hi = ex if hi is None else min(int(hi), ex)
+            hi = ex if hi is None else min(hi, ex)
         return int_range_ast(lo, hi)
     if t == "number":
         if any(k in schema for k in (

@@ -83,6 +83,17 @@ class TestPolicyUnits:
         out = eng.generate_json(PROMPT, HONEST_DECISION)
         assert out["value"] == 30
 
+    def test_stubborn_clamps_out_of_range_current_value(self):
+        """A 'Your current value' line outside [lo, hi] must not be
+        echoed as a schema-violating emission (advisor finding)."""
+        eng = FakeEngine(policy="stubborn")
+        out = eng.generate_json(
+            PROMPT.replace("Your current value: 30",
+                           "Your current value: 999"),
+            HONEST_DECISION,
+        )
+        assert out["value"] == 50  # clamped to the schema maximum
+
     def test_median_proposes_order_statistic(self):
         eng = FakeEngine(policy="median")
         out = eng.generate_json(PROMPT, HONEST_DECISION)

@@ -463,6 +463,20 @@ class TestArrayBoundsAndExclusive:
             self._dfa({"type": "integer", "minimum": 5,
                        "exclusiveMinimum": True})
 
+    def test_non_integral_inclusive_bounds(self):
+        # minimum=4.5 admits 5, not 4 (int() truncation would admit 4);
+        # maximum=8.5 admits 8, not 9.
+        d = self._dfa({"type": "integer", "minimum": 4.5, "maximum": 8.5})
+        assert not d.matches(b"4")
+        assert d.matches(b"5") and d.matches(b"8")
+        assert not d.matches(b"9")
+        # Combined with exclusive bounds the ceil'd inclusive minimum
+        # still participates in max()/min() correctly.
+        d2 = self._dfa({"type": "integer", "minimum": 5.5,
+                        "exclusiveMinimum": 3, "maximum": 9})
+        assert not d2.matches(b"5")
+        assert d2.matches(b"6")
+
     def test_number_bounds_warn_unenforced(self):
         import warnings as _warnings
 

@@ -58,6 +58,20 @@ class TestParser:
         with pytest.raises((PatternError, ValueError)):
             parse_pattern(bad)
 
+    @pytest.mark.parametrize("pattern,yes,no", [
+        (r"a\"b", ['a"b'], ["ab", "a\\b"]),
+        (r"\!\@\#", ["!@#"], ["!@", "!@#$"]),
+        (r"x\~y", ["x~y"], ["xy"]),
+    ])
+    def test_identity_escapes(self, pattern, yes, no):
+        """ECMA identity escapes (\\" etc.) on printable punctuation are
+        accepted; alphanumeric escapes without a meaning still raise
+        (covered by test_malformed_or_unsupported_raises's \\q)."""
+        for v in yes:
+            assert matches(pattern, v), (pattern, v)
+        for v in no:
+            assert not matches(pattern, v), (pattern, v)
+
     def test_anchors_are_whole_string(self):
         # Anchored and unanchored parse to the SAME automaton (documented
         # outlines-convention divergence from JSON-Schema search
