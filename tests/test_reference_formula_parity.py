@@ -1,0 +1,241 @@
+"""Mechanical formula parity against the reference implementation.
+
+The round-3 verdict's residual doubt: our fake-policy sweeps prove the
+statistics pipeline *runs*, not that it computes the same numbers the
+reference would.  Both statistics layers are pure Python dict-in /
+dict-out (`/root/reference/byzantine_consensus_game/byzantine_consensus.py:544-839`
+vs ``bcg_tpu/game/statistics.py``), so parity can be pinned exactly:
+
+1. run a real bcg_tpu simulation (orchestrator + fake backend, seeded),
+   recording every game mutation (proposals, reasoning, votes) as a
+   trace;
+2. replay the identical trace into the reference's own
+   ``ByzantineConsensusGame`` (imported from /root/reference at test
+   time — never copied), with its random agent init overwritten by our
+   game's seeded init;
+3. assert ``get_statistics()`` equality key by key, across every
+   outcome-taxonomy region the scripted policies reach (valid /
+   invalid / timeout, with and without Byzantine agents).
+
+Skipped when the reference checkout is absent (the test imports it; the
+shipped package never does).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import pathlib
+import sys
+
+import pytest
+
+from bcg_tpu.api import run_simulation
+from bcg_tpu.config import BCGConfig, EngineConfig
+from bcg_tpu.game import ByzantineConsensusGame
+
+REF_DIR = pathlib.Path("/root/reference/byzantine_consensus_game")
+
+pytestmark = pytest.mark.skipif(
+    not REF_DIR.is_dir(), reason="reference checkout not available"
+)
+
+
+# --------------------------------------------------------------- loader
+
+def _load_reference_module():
+    """Import the reference's byzantine_consensus.py in isolation.
+
+    It does ``from config import BCG_CONFIG`` at module level, so its
+    own config.py must transiently occupy sys.modules["config"]; both
+    entries are restored/removed afterwards so the suite's import
+    space stays clean.
+    """
+    saved_config = sys.modules.get("config")
+    spec_c = importlib.util.spec_from_file_location("config", REF_DIR / "config.py")
+    cfg = importlib.util.module_from_spec(spec_c)
+    sys.modules["config"] = cfg
+    try:
+        spec_c.loader.exec_module(cfg)
+        spec_b = importlib.util.spec_from_file_location(
+            "_bcg_reference_game", REF_DIR / "byzantine_consensus.py"
+        )
+        mod = importlib.util.module_from_spec(spec_b)
+        spec_b.loader.exec_module(mod)
+        return mod
+    finally:
+        if saved_config is not None:
+            sys.modules["config"] = saved_config
+        else:
+            sys.modules.pop("config", None)
+
+
+@pytest.fixture(scope="module")
+def ref():
+    return _load_reference_module()
+
+
+# ------------------------------------------------------------ recording
+
+class RecordingGame(ByzantineConsensusGame):
+    """Our game, with every mutating call journaled for replay."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.trace = []
+        # The seeded initial assignment, captured before any round runs.
+        self.initial_agents = {
+            aid: (st.is_byzantine, st.initial_value)
+            for aid, st in self.agents.items()
+        }
+
+    def update_agent_proposal(self, agent_id, new_value):
+        self.trace.append(("update_agent_proposal", (agent_id, new_value)))
+        return super().update_agent_proposal(agent_id, new_value)
+
+    def store_round_reasoning(self, reasoning):
+        self.trace.append(("store_round_reasoning", (dict(reasoning),)))
+        return super().store_round_reasoning(reasoning)
+
+    def advance_round(self, agent_votes=None):
+        votes = None if agent_votes is None else dict(agent_votes)
+        self.trace.append(("advance_round", (votes,)))
+        return super().advance_round(agent_votes)
+
+
+_TRACE_CACHE: dict = {}
+
+
+def _run_traced(policy, honest, byz, rounds, seed, monkeypatch):
+    """Run a full bcg_tpu simulation with the game journaled (cached —
+    both tests below walk the same CASES matrix)."""
+    key = (policy, honest, byz, rounds, seed)
+    if key in _TRACE_CACHE:
+        return _TRACE_CACHE[key]
+    import bcg_tpu.runtime.orchestrator as orch
+
+    captured = {}
+
+    def factory(*args, **kwargs):
+        game = RecordingGame(*args, **kwargs)
+        captured["game"] = game
+        return game
+
+    monkeypatch.setattr(orch, "ByzantineConsensusGame", factory)
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        BCGConfig(), engine=EngineConfig(backend="fake", fake_policy=policy)
+    )
+    run_simulation(
+        n_agents=honest + byz,
+        byzantine_count=byz,
+        max_rounds=rounds,
+        backend="fake",
+        seed=seed,
+        config=cfg,
+    )
+    _TRACE_CACHE[key] = captured["game"]
+    return captured["game"]
+
+
+def _replay_into_reference(ref, game):
+    """Build a reference game mirroring our seeded init, replay the trace."""
+    ref_game = ref.ByzantineConsensusGame(
+        num_honest=game.num_honest,
+        num_byzantine=game.num_byzantine,
+        value_range=tuple(game.value_range),
+        consensus_threshold=game.consensus_threshold,
+        max_rounds=game.max_rounds,
+    )
+    # Replace the reference's unseeded random init with OUR seeded one
+    # (same ids, roles, initial values), exactly as its
+    # _initialize_agents would have produced them (reference
+    # byzantine_consensus.py:118-147: Byzantine agents start with
+    # None current/proposed values).
+    ref_game.agents = {
+        aid: ref.AgentState(
+            agent_id=aid,
+            is_byzantine=is_byz,
+            initial_value=init,
+            current_value=init,
+            proposed_value=init,
+        )
+        for aid, (is_byz, init) in game.initial_agents.items()
+    }
+    for method, args in game.trace:
+        getattr(ref_game, method)(*args)
+    return ref_game
+
+
+# ----------------------------------------------------------- comparison
+
+def _assert_equivalent(path, ours, theirs):
+    if isinstance(theirs, dict):
+        assert isinstance(ours, dict), path
+        assert set(ours.keys()) == set(theirs.keys()), (
+            f"{path}: key sets differ: only-ours="
+            f"{set(ours) - set(theirs)} only-reference={set(theirs) - set(ours)}"
+        )
+        for k in theirs:
+            _assert_equivalent(f"{path}.{k}", ours[k], theirs[k])
+    elif isinstance(theirs, (list, tuple)):
+        assert isinstance(ours, (list, tuple)), path
+        assert len(ours) == len(theirs), f"{path}: length {len(ours)} != {len(theirs)}"
+        for i, (a, b) in enumerate(zip(ours, theirs)):
+            _assert_equivalent(f"{path}[{i}]", a, b)
+    elif isinstance(theirs, float):
+        assert ours == pytest.approx(theirs, rel=1e-12, abs=1e-12), (
+            f"{path}: {ours!r} != {theirs!r}"
+        )
+    else:
+        assert ours == theirs, f"{path}: {ours!r} != {theirs!r}"
+
+
+# A config per outcome-taxonomy region (PARITY.md fake-policy table):
+# silent -> valid consensus, oscillate -> invalid (vote w/o consensus),
+# disrupt -> timeout, plus honest-only valid (median) and timeout
+# (stubborn) paths, and an awareness-keyword-bearing default run.
+CASES = [
+    ("consensus", 4, 0, 6, 0),
+    ("median", 5, 0, 6, 7),
+    ("stubborn", 4, 0, 5, 3),
+    ("mixed:consensus:silent", 6, 2, 8, 11),
+    ("mixed:consensus:oscillate", 6, 2, 8, 5),
+    ("mixed:consensus:disrupt", 6, 2, 6, 2),
+    ("mixed:consensus:mimic", 8, 2, 8, 13),
+    ("mixed:stubborn:oscillate", 4, 2, 5, 17),
+]
+
+
+@pytest.mark.parametrize("policy,honest,byz,rounds,seed", CASES)
+def test_statistics_formula_parity(ref, monkeypatch, policy, honest, byz, rounds, seed):
+    game = _run_traced(policy, honest, byz, rounds, seed, monkeypatch)
+    assert game.trace, "simulation produced an empty trace"
+    ref_game = _replay_into_reference(ref, game)
+
+    # The replayed game must terminate identically before statistics
+    # can be compared meaningfully.
+    assert ref_game.game_over == game.game_over
+    assert ref_game.termination_reason == game.termination_reason
+
+    ours = game.get_statistics()
+    theirs = ref_game.get_statistics()
+    _assert_equivalent("statistics", ours, theirs)
+
+
+def test_traces_cover_all_termination_reasons(monkeypatch):
+    """The case matrix must keep exercising every taxonomy branch —
+    if a policy change collapses the regions, this fails loudly
+    instead of silently weakening the parity claim."""
+    reasons = set()
+    outcomes = set()
+    for policy, honest, byz, rounds, seed in CASES:
+        game = _run_traced(policy, honest, byz, rounds, seed, monkeypatch)
+        reasons.add(game.termination_reason)
+        outcomes.add(game.get_statistics()["consensus_outcome"])
+    assert "vote_with_consensus" in reasons
+    assert "max_rounds" in reasons
+    assert {"valid", "timeout"} <= outcomes
+    # Value-flipping adversaries force premature termination without
+    # valid consensus (invalid or none).
+    assert outcomes & {"invalid", "none"}
