@@ -1,5 +1,5 @@
 #!/bin/bash
-# Hardware-recovery watcher for the round-3 validation queue.
+# Hardware-recovery watcher for the round-4 validation queue.
 #
 # The axon-tunneled TPU comes and goes (see BENCH_NOTES outage
 # timelines).  This script probes the chip with a real (non-toy)
@@ -12,16 +12,21 @@
 # in the log instead of wedging the queue.
 set -u
 cd /root/repo
-OUT=results/hw_r3b
+OUT=results/hw_r4
 declare -A TMO
 LOG=$OUT/watcher.log
 mkdir -p "$OUT"
 
 # Single source of truth for the queue: drain() runs these in order and
 # all_done() checks the same list, so the two can never drift.
-STEPS="bench_default int8_probe bench_int8kv bench_hf1b bench_conc2 \
-art_convert bench_artifact bench_bf16w bench_finesuffix bench_w8a16 \
-mb_prefill mb_decode bench_8b w4_probe bench_14b \
+# Round-4 order follows the verdict's priorities: a recorded default
+# number first, then the 8B/14B capability proofs (with the kernel
+# probes they depend on), then the prefill-MFU attack, then the smaller
+# A/Bs, with the long parity sweeps last — a short healthy window must
+# not be spent on minor A/Bs while the flagship claims starve.
+STEPS="bench_default int8_probe bench_int8kv bench_8b w4_probe bench_14b \
+bench_hf1b mb_prefill bench_w8a16 bench_bf16w bench_finesuffix \
+bench_conc2 art_convert bench_artifact mb_decode \
 parity_q1-baseline parity_q1-full parity_q2"
 
 log() { echo "$(date -u +%H:%M:%S) $*" >> "$LOG"; }
@@ -108,7 +113,7 @@ step_spec() {
     parity_*)
       TMOS=5400; PAT='"aggregate"'
       CMD=(python -m bcg_tpu.experiments "${1#parity_}" --backend jax
-           --model bcg-tpu/bench-1b --runs 10 --rounds 8
+           --model bcg-hf/bench-1b --runs 10 --rounds 8
            --concurrency 2 --seed 100);;
     *) return 1;;
   esac
