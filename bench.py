@@ -191,15 +191,20 @@ def _run_attempt(cfg, model: str, backend: str, concurrency: int,
             if warmed >= warmup_rounds + 6:
                 break
 
+        from bcg_tpu.runtime.profiler import jax_trace
+
         waves = 0
         w0 = _counters()
         t0 = time.perf_counter()
-        while waves < measured_rounds:
-            # Replace at the TOP (like the single-game path): the final
-            # wave's terminations aren't pointlessly rebuilt on the clock.
-            sims, seed = replace_done(sims, seed)
-            run_wave(sims)
-            waves += 1
+        prof_dir = os.environ.get("BENCH_PROFILE_DIR") if backend != "fake" else None
+        with jax_trace(prof_dir):
+            while waves < measured_rounds:
+                # Replace at the TOP (like the single-game path): the
+                # final wave's terminations aren't pointlessly rebuilt
+                # on the clock.
+                sims, seed = replace_done(sims, seed)
+                run_wave(sims)
+                waves += 1
         elapsed = time.perf_counter() - t0
         rounds_done = waves * concurrency
     else:
@@ -225,15 +230,25 @@ def _run_attempt(cfg, model: str, backend: str, concurrency: int,
         # A game may terminate at any round (random-weight votes are
         # correlated); keep starting fresh games until N rounds are
         # measured.
+        from bcg_tpu.runtime.profiler import jax_trace
+
         rounds_done = 0
         w0 = _counters()
         t0 = time.perf_counter()
-        while rounds_done < measured_rounds:
-            if sim.game.game_over:
-                sim = fresh_sim(seed)  # cheap: no engine re-init, no compile
-                seed += 1
-            sim.run_round()
-            rounds_done += 1
+        # BENCH_PROFILE_DIR=<dir>: capture a jax.profiler trace of the
+        # measured window (device timeline per op — the prefill-MFU
+        # attribution the microbench cannot see inside fused programs).
+        # Real backends only: start_trace initializes the default
+        # backend, which on the fake path would attach the (possibly
+        # dead) tunnel a fake bench never needs.
+        prof_dir = os.environ.get("BENCH_PROFILE_DIR") if backend != "fake" else None
+        with jax_trace(prof_dir):
+            while rounds_done < measured_rounds:
+                if sim.game.game_over:
+                    sim = fresh_sim(seed)  # no engine re-init, no compile
+                    seed += 1
+                sim.run_round()
+                rounds_done += 1
         elapsed = time.perf_counter() - t0
 
     # Sanity: a real engine must actually have DECODED across the WHOLE

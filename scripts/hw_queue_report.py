@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Render the hardware-watcher queue results into a markdown table.
 
-Reads ``results/hw_r3b/*.json`` (each the single-line bench JSON, or an
+Reads ``results/hw_r4/*.json`` (each the single-line bench JSON, or an
 experiments-aggregate JSON for parity_* steps) and prints a
 BENCH_NOTES-ready summary: one row per completed bench step with dec/s,
 round rate, cold-boot seconds and the headline perf keys, plus a
@@ -33,7 +33,7 @@ def _load(path: str):
 
 
 def main() -> None:
-    out_dir = sys.argv[1] if len(sys.argv) > 1 else "results/hw_r3b"
+    out_dir = sys.argv[1] if len(sys.argv) > 1 else "results/hw_r4"
     names = sorted(
         os.path.basename(p)[:-5]
         for p in glob.glob(os.path.join(out_dir, "*.json"))
