@@ -39,7 +39,11 @@ batches per phase; decisions/sec then counts all G games),
 BENCH_PREFIX_CACHING (0 to disable cached prefix KV for models whose
 weights leave no room), BENCH_SHARED_CORE (1 to enable vote-phase
 shared-core prompt caching — opt-in because its prompt text diverges
-from the reference's vote format).  The emitted JSON labels every knob.
+from the reference's vote format), BENCH_PROFILE_DIR (capture a
+jax.profiler trace of the measured window; real backends only),
+BENCH_FORCE_CPU (1 = run the real jax path on the host CPU in-process
+— the hermetic flag-stack smoke tests/test_bench_cpu_stack.py uses).
+The emitted JSON labels every knob.
 """
 
 from __future__ import annotations
@@ -372,6 +376,16 @@ def _run_attempt(cfg, model: str, backend: str, concurrency: int,
 
 
 def main() -> None:
+    force_cpu = _env_flag("BENCH_FORCE_CPU", False)
+    if force_cpu:
+        # Hermetic mode: run the REAL jax path on the host CPU — the
+        # whole bench stack (size-class gating, engine boot, measured
+        # window) minus the accelerator.  The env var alone is not
+        # enough under this environment's axon sitecustomize, so force
+        # it in-process before any backend init.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     model = os.environ.get("BENCH_MODEL", "bcg-tpu/bench-1b")
     backend = os.environ.get("BENCH_BACKEND", "jax")
     quant_env = os.environ.get("BENCH_QUANTIZATION", "int8")
@@ -397,10 +411,14 @@ def main() -> None:
         import subprocess
 
         attach_timeout = int(os.environ.get("BENCH_ATTACH_TIMEOUT", "900"))
+        cpu_stmt = (
+            'jax.config.update("jax_platforms", "cpu"); ' if force_cpu else ""
+        )
         try:
             subprocess.run(
                 [sys.executable, "-c",
-                 "import jax; jax.devices(); import jax.numpy as jnp; "
+                 f"import jax; {cpu_stmt}jax.devices(); "
+                 "import jax.numpy as jnp; "
                  "(jnp.ones((8,8)) @ jnp.ones((8,8))).block_until_ready()"],
                 timeout=attach_timeout, check=True, capture_output=True,
             )
