@@ -1,0 +1,100 @@
+"""Worker process for tests/test_multihost.py (not a pytest module).
+
+Runs as one rank of a REAL 2-process JAX cluster (CPU devices, Gloo
+collectives): joins the process group through
+bcg_tpu.parallel.distributed.initialize — the exact call a Cloud TPU
+pod worker makes — then drives cross-process collectives through the
+library's own mesh builders and SPMD game step.
+
+Usage: python tests/_multihost_worker.py <coordinator> <num_procs> <pid>
+"""
+
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+COORD, NPROC, PID = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+
+from bcg_tpu.parallel import distributed  # noqa: E402
+
+distributed.initialize(
+    coordinator_address=COORD, num_processes=NPROC, process_id=PID
+)
+
+info = distributed.process_info()
+assert info["process_count"] == NPROC, info
+assert info["global_device_count"] == NPROC * info["local_device_count"], info
+n_local = info["local_device_count"]
+n_global = info["global_device_count"]
+
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from bcg_tpu.parallel.game_step import (  # noqa: E402
+    exchange_values, spmd_round_arrays, tally_votes,
+)
+
+# --- hybrid mesh: tp groups must stay inside one host ------------------
+mesh_h = distributed.build_hybrid_mesh(tp=2, sp=1)
+assert mesh_h.shape["tp"] == 2 and mesh_h.shape["dp"] == n_global // 2
+for row in mesh_h.devices.reshape(mesh_h.shape["dp"], 2):
+    hosts = {d.process_index for d in row}
+    assert len(hosts) == 1, f"tp group straddles hosts: {row}"
+
+# --- pure-dp mesh spanning both hosts: the game exchange over "DCN" ----
+mesh = distributed.build_hybrid_mesh(tp=1, sp=1)  # dp = n_global
+n = n_global
+
+
+def global_array(np_arr, spec):
+    sharding = NamedSharding(mesh, spec)
+    return jax.make_array_from_callback(
+        np_arr.shape, sharding, lambda idx: np_arr[idx]
+    )
+
+
+values_np = np.arange(10, 10 + n, dtype=np.int32)
+values_np[1] = -1  # one abstainer
+mask_np = ~np.eye(n, dtype=bool)  # fully connected
+votes_np = np.array([1] * (n - 2) + [0, -1], dtype=np.int32)
+byz_np = np.zeros(n, dtype=bool)
+inits_np = values_np.copy()
+
+values = global_array(values_np, P("dp"))
+mask = global_array(mask_np, P("dp", None))
+votes = global_array(votes_np, P("dp"))
+byz = global_array(byz_np, P("dp"))
+inits = global_array(inits_np, P("dp"))
+
+received = exchange_values(values, mask, mesh)
+# Expected: row i holds j's value for j != i when j proposed, else -1.
+expected = np.where(mask_np & (values_np >= 0)[None, :], values_np[None, :], -1)
+for shard in received.addressable_shards:
+    rows = shard.index[0]
+    np.testing.assert_array_equal(np.asarray(shard.data), expected[rows])
+
+tally = tally_votes(votes, mesh)
+assert int(tally["stop"]) == n - 2
+assert int(tally["continue"]) == 1
+assert int(tally["abstain"]) == 1
+assert bool(tally["terminate"]) == ((n - 2) * 3 >= n * 2)
+
+# Full round helper (exchange + tally + consensus) on the same mesh.
+received2, tally2, consensus = spmd_round_arrays(
+    values, votes, mask, byz, inits, mesh
+)
+jax.block_until_ready(received2)
+assert int(tally2["stop"]) == n - 2
+assert not bool(consensus["has_consensus"])  # distinct values: no consensus
+
+# Unanimous case crossing hosts: every agent holds agent 0's value.
+uni_np = np.full(n, 10, dtype=np.int32)
+uni = global_array(uni_np, P("dp"))
+_, _, consensus_u = spmd_round_arrays(uni, votes, mask, byz, inits, mesh)
+assert bool(consensus_u["has_consensus"])
+
+print(f"MULTIHOST-OK pid={PID} procs={NPROC} global_devices={n_global}",
+      flush=True)
