@@ -45,16 +45,65 @@ def _bfs_dist(dfa):
     return dist
 
 
+def _random_pattern(rng: random.Random) -> str:
+    """A random pattern from the supported subset, chosen so the SAME
+    string is a valid Python regex with identical semantics (the fuzz
+    oracle is ``re.fullmatch``)."""
+    pieces = []
+    for _ in range(rng.randint(1, 3)):
+        kind = rng.choice(["lit", "esc", "digits", "class", "alt"])
+        if kind == "lit":
+            pieces.append(rng.choice(["id", "AB", "x9"]))
+        elif kind == "esc":
+            # Identity escapes of printable punctuation (round-4 widening).
+            pieces.append(rng.choice([r"\!", r"\@", r"\#", r"\~", r"\%"]))
+        elif kind == "digits":
+            m = rng.randint(1, 3)
+            pieces.append(rf"\d{{{m}}}")
+        elif kind == "class":
+            pieces.append(rng.choice(["[a-c]", "[xy]", "[0-4]"]))
+            if rng.random() < 0.5:
+                pieces.append(rng.choice(["+", "?"]))
+        else:
+            pieces.append(rng.choice(["(a|bc)", "(?:x|yz)"]))
+    return "".join(pieces)
+
+
 def _random_schema(rng: random.Random):
     props = {}
     required = []
     for i in range(rng.randint(1, 4)):
         name = f"f{i}"
-        kind = rng.choice(["string", "int", "enum", "anyof", "bool"])
+        kind = rng.choice([
+            "string", "int", "enum", "anyof", "bool",
+            "pattern", "floatbounds", "exclusive", "array",
+        ])
         if kind == "string":
             lo = rng.choice([0, 1, 3])
             hi = rng.choice([lo + 2, lo + 8])
             props[name] = {"type": "string", "minLength": lo, "maxLength": hi}
+        elif kind == "pattern":
+            props[name] = {"type": "string", "pattern": _random_pattern(rng)}
+        elif kind == "floatbounds":
+            # Non-integral inclusive bounds (round-4 ceil/floor fix).
+            lo = rng.randint(-20, 10) + rng.choice([0.5, 0.25])
+            hi = lo + rng.randint(1, 40)
+            props[name] = {"type": "integer", "minimum": lo, "maximum": hi}
+        elif kind == "exclusive":
+            lo = rng.randint(-20, 10)
+            props[name] = {
+                "type": "integer",
+                "exclusiveMinimum": lo,
+                "exclusiveMaximum": lo + rng.randint(2, 40),
+            }
+        elif kind == "array":
+            mn = rng.randint(0, 2)
+            props[name] = {
+                "type": "array",
+                "items": {"type": "integer", "minimum": 0, "maximum": 9},
+                "minItems": mn,
+                "maxItems": mn + rng.randint(0, 3),
+            }
         elif kind == "int":
             lo = rng.randint(-30, 20)
             hi = lo + rng.randint(0, 60)
@@ -125,28 +174,46 @@ def _validate(obj, schema):
 
 
 def _validate_leaf(val, sub):
+    import re
+
     t = sub.get("type")
     if t == "string":
         assert isinstance(val, str)
         if "enum" in sub:
             assert val in sub["enum"], (val, sub["enum"])
+        if "pattern" in sub:
+            assert re.fullmatch(sub["pattern"], val), (sub["pattern"], val)
         if "minLength" in sub:
             assert len(val) >= sub["minLength"]
         if "maxLength" in sub:
             assert len(val) <= sub["maxLength"]
     elif t == "integer":
         assert isinstance(val, int) and not isinstance(val, bool)
+        # Float bounds compare directly: an int >= 4.5 iff it is >= 5,
+        # which is exactly the JSON-schema semantics the compiler must
+        # realize via ceil/floor.
         if "minimum" in sub:
-            assert val >= sub["minimum"]
+            assert val >= sub["minimum"], (val, sub)
         if "maximum" in sub:
-            assert val <= sub["maximum"]
+            assert val <= sub["maximum"], (val, sub)
+        if "exclusiveMinimum" in sub:
+            assert val > sub["exclusiveMinimum"], (val, sub)
+        if "exclusiveMaximum" in sub:
+            assert val < sub["exclusiveMaximum"], (val, sub)
+    elif t == "array":
+        assert isinstance(val, list)
+        assert len(val) >= sub.get("minItems", 0), (val, sub)
+        if "maxItems" in sub:
+            assert len(val) <= sub["maxItems"], (val, sub)
+        for item in val:
+            _validate_leaf(item, sub["items"])
     elif t == "boolean":
         assert isinstance(val, bool)
     else:
         raise AssertionError(f"unknown leaf {sub}")
 
 
-@pytest.mark.parametrize("seed", range(25))
+@pytest.mark.parametrize("seed", range(60))
 def test_random_schema_walks_always_validate(seed):
     rng = random.Random(seed)
     schema = _random_schema(rng)
