@@ -526,3 +526,29 @@ class TestEngineUnderMesh:
                 assert 0 <= o["value"] <= 50
         eng.shutdown()
 
+
+
+def test_spmd_exchange_composes_with_engine_mesh():
+    """Real serving engine (tp=2 mesh) + SPMD collective exchange (dp
+    mesh) in ONE simulation: two meshes over the same devices, the
+    layout a one-agent-per-chip sweep with a TP-sharded model uses.
+    Previously covered only separately (dryrun stages 7/8)."""
+    import dataclasses
+
+    from bcg_tpu.runtime.orchestrator import BCGSimulation
+
+    base = BCGConfig()
+    cfg = dataclasses.replace(
+        base,
+        game=GameConfig(num_honest=3, num_byzantine=1, max_rounds=2, seed=7),
+        network=dataclasses.replace(base.network, spmd_exchange=True),
+        engine=EngineConfig(backend="jax", model_name="bcg-tpu/tiny-test",
+                            max_model_len=2048, tensor_parallel_size=2),
+        metrics=MetricsConfig(save_results=False),
+    )
+    sim = BCGSimulation(config=cfg)
+    stats = sim.run()
+    assert stats["total_rounds"] >= 1
+    assert sim._spmd_mesh is not None and sim._spmd_mesh.shape["dp"] == 4
+    assert sim.engine.mesh is not None and sim.engine.mesh.shape["tp"] == 2
+    sim.engine.shutdown()
