@@ -83,6 +83,18 @@ def _env_flag(name: str, default: bool) -> bool:
     return env_flag(name, default)
 
 
+def _progress(msg: str) -> None:
+    """Stage stamp on stderr (stdout stays the driver's single JSON line).
+
+    The axon tunnel can hang for tens of minutes mid-run; a silent bench
+    is undiagnosable after the fact (round-4 opener: 25 min of nothing,
+    then a timeout with no indication whether boot, compile, warmup, or
+    the measured window died).  These stamps name the last stage reached.
+    """
+    sys.stderr.write(f"bench[{time.strftime('%H:%M:%S')}]: {msg}\n")
+    sys.stderr.flush()
+
+
 def _is_transient(exc: BaseException) -> bool:
     text = f"{type(exc).__name__}: {exc}"
     return any(m in text for m in _TRANSIENT_MARKERS)
@@ -111,7 +123,9 @@ def _run_attempt(cfg, model: str, backend: str, concurrency: int,
 
     t_boot0 = time.perf_counter()
     first_round_s = None  # boot + compile + first full round (cold cost)
+    _progress("building engine + weights (BCGSimulation)")
     sim = BCGSimulation(config=cfg)
+    _progress(f"engine built in {time.perf_counter() - t_boot0:.1f}s")
     n_agents = cfg.game.num_honest + cfg.game.num_byzantine
     engine = sim.engine  # reuse across games: compiled loops persist
 
@@ -188,6 +202,8 @@ def _run_attempt(cfg, model: str, backend: str, concurrency: int,
             if first_round_s is None:
                 first_round_s = time.perf_counter() - t_boot0
             warmed += 1
+            _progress(f"warmup wave {warmed} done "
+                      f"(+{time.perf_counter() - t_boot0:.1f}s)")
             saw_round2 = saw_round2 or any(
                 len(s.game.rounds) >= 2 for s in sims
             )
@@ -201,6 +217,7 @@ def _run_attempt(cfg, model: str, backend: str, concurrency: int,
         w0 = _counters()
         t0 = time.perf_counter()
         prof_dir = os.environ.get("BENCH_PROFILE_DIR") if backend != "fake" else None
+        _progress("measured window start")
         with jax_trace(prof_dir):
             while waves < measured_rounds:
                 # Replace at the TOP (like the single-game path): the
@@ -209,6 +226,7 @@ def _run_attempt(cfg, model: str, backend: str, concurrency: int,
                 sims, seed = replace_done(sims, seed)
                 run_wave(sims)
                 waves += 1
+                _progress(f"measured wave {waves}/{measured_rounds}")
         elapsed = time.perf_counter() - t0
         rounds_done = waves * concurrency
     else:
@@ -227,6 +245,8 @@ def _run_attempt(cfg, model: str, backend: str, concurrency: int,
             if first_round_s is None:
                 first_round_s = time.perf_counter() - t_boot0
             warmed += 1
+            _progress(f"warmup round {warmed} done "
+                      f"(+{time.perf_counter() - t_boot0:.1f}s)")
             saw_round2 = saw_round2 or len(sim.game.rounds) >= 2
             if warmed >= warmup_rounds + 6:  # pathological termination streak
                 break
@@ -246,6 +266,7 @@ def _run_attempt(cfg, model: str, backend: str, concurrency: int,
         # backend, which on the fake path would attach the (possibly
         # dead) tunnel a fake bench never needs.
         prof_dir = os.environ.get("BENCH_PROFILE_DIR") if backend != "fake" else None
+        _progress("measured window start")
         with jax_trace(prof_dir):
             while rounds_done < measured_rounds:
                 if sim.game.game_over:
@@ -253,6 +274,7 @@ def _run_attempt(cfg, model: str, backend: str, concurrency: int,
                     seed += 1
                 sim.run_round()
                 rounds_done += 1
+                _progress(f"measured round {rounds_done}/{measured_rounds}")
         elapsed = time.perf_counter() - t0
 
     # Sanity: a real engine must actually have DECODED across the WHOLE
@@ -436,6 +458,12 @@ def main() -> None:
                 "stderr_tail": stderr[-500:],
             }))
             return
+        # Wording matters: hw_watcher.sh greps step logs for
+        # unavailable|attach|connection refused|response body closed to
+        # classify failures as outages — a success stamp containing any
+        # of those markers would make every later failure of the step
+        # look like an outage and retry forever.
+        _progress("accelerator probe OK (device responds)")
 
     # bcg-hf/* models run the REAL checkpoint pipeline (AutoTokenizer +
     # safetensors + config.json from local disk, models/hf_fixture.py)
