@@ -154,9 +154,13 @@ run_step() {
     return 0
   fi
   # Availability failure (attach error, tunnel death): leave un-stamped
-  # and signal the caller to go back to probing.
-  if grep -qiE "unavailable|attach|connection refused|response body closed" \
-      "$OUT/$name.json" "$OUT/$name.log" 2>/dev/null; then
+  # and signal the caller to go back to probing.  bench.py's "bench[..]:"
+  # stage stamps are excluded first — a stamp whose wording happened to
+  # contain a marker substring would otherwise turn every deterministic
+  # failure of the step into an endless outage-retry loop.
+  if cat "$OUT/$name.json" "$OUT/$name.log" 2>/dev/null \
+      | grep -v '^bench\[' \
+      | grep -qiE "unavailable|attach|connection refused|response body closed"; then
     log "UNAVAIL $name rc=$rc — back to probing"
     return 2
   fi

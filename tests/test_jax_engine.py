@@ -508,6 +508,36 @@ class TestEngineUnderMesh:
         assert 0 <= out_tp[2]["value"] <= 50
         eng_tp.shutdown()
 
+    @pytest.mark.parametrize("quant", ["int8", "int4"])
+    def test_quantized_scan_tp2_end_to_end(self, quant):
+        """The pod-slice serving configuration for the reference's
+        large presets (8B: int8 + scan + tp; 14B/32B: int4 + scan + tp —
+        config.py:20-25 presets served at vllm_agent.py:139-142 with
+        tensor_parallel_size>1): quantized stacked weight trees sharded
+        over a tp mesh, serving guided JSON through the full engine.
+        Each pairwise composition is covered elsewhere; this is the
+        triple the real large-model boot actually runs."""
+        eng = self._engine(
+            tensor_parallel_size=2, quantization=quant, scan_layers=True,
+        )
+        assert eng.mesh is not None and eng.mesh.shape["tp"] == 2
+        # The stacked quantized projection must be physically split over
+        # two devices (axis 0 of each leaf is the layer stack).
+        wq = eng.params["layers"]["wq"]
+        q = wq["q4"] if quant == "int4" else wq["q"]
+        assert q.shape[0] == eng.spec.num_layers  # stacked for lax.scan
+        assert len({s.device for s in q.addressable_shards}) == 2
+        out = eng.batch_generate_json(
+            [("You are honest.", "Pick a value.", DECISION_SCHEMA),
+             ("You vote.", "Stop or continue?", VOTE_SCHEMA)],
+            temperature=0.0, max_tokens=96,
+        )
+        for o in out:
+            assert "error" not in o, o
+        assert 0 <= out[0]["value"] <= 50
+        assert out[1]["decision"] in ("stop", "continue")
+        eng.shutdown()
+
     def test_batch_generate_json_dp2_tp2(self):
         """Composed dp x tp mesh: batch rows shard over dp while weights
         shard over tp — the one-agent-per-device scale-out layout."""
