@@ -449,6 +449,13 @@ class JaxEngine(InferenceEngine):
         # round (VERDICT round-2 weak #3) — counted and warned-once now.
         self.prefix_fallbacks = 0
         self._prefix_fallback_warned = False
+        # Full-prefill calls that bypassed the configured sequence-
+        # parallel ring path (chunked prefill took the call, or the
+        # bucket didn't divide by sp).  Counted + warned-once like
+        # prefix_fallbacks: silent disengagement of a configured
+        # optimization hid a disabled cache for a whole round once.
+        self.sp_bypasses = 0
+        self._sp_bypass_warned = False
         # Calls whose batch the hbm_utilization provisioner chunked.
         self.provision_chunk_events = 0
         # Pad the token-byte table to the MODEL vocab (embedding tables are
@@ -472,6 +479,24 @@ class JaxEngine(InferenceEngine):
             partial(prefill_with_prefix, spec=self.spec, impl=self.attention_impl),
             donate_argnames=("cache",),
         )
+        # Sequence-parallel full-prompt prefill (ring attention over the
+        # mesh's `sp` axis, transformer.prefill_sp): selected per call by
+        # _prefill_possibly_chunked when the call is a single-pass full
+        # prefill whose bucket divides by sp.  Chunked prefill and the
+        # cached-prefix path win over it (neither is ring-capable);
+        # bypasses are counted in engine.sp_bypasses.  Long-context
+        # counterpart to the reference's context COMPRESSION (SURVEY.md
+        # §5.7) — prefill activations shard O(L/sp) per chip.
+        self._prefill_sp = None
+        self._sp_devices = mesh.shape.get("sp", 1) if mesh is not None else 1
+        if self._sp_devices > 1:
+            from bcg_tpu.models.transformer import prefill_sp
+
+            self._prefill_sp = jax.jit(
+                partial(prefill_sp, spec=self.spec, mesh=mesh,
+                        impl=self.attention_impl),
+                donate_argnames=("cache",),
+            )
         self._prefill_chunk_at = jax.jit(
             partial(prefill_chunk_at, spec=self.spec, impl=self.attention_impl),
             donate_argnames=("cache",),
@@ -1306,6 +1331,20 @@ class JaxEngine(InferenceEngine):
             parts, batch, sig, real_B, temps, budgets, top_p
         )
 
+    def _note_sp_bypass(self, reason: str) -> None:
+        """Count (and warn once about) a full-prefill call that skipped
+        the configured sequence-parallel ring path."""
+        self.sp_bypasses += 1
+        if not self._sp_bypass_warned:
+            import warnings
+
+            warnings.warn(
+                f"sequence-parallel prefill bypassed: {reason}; further "
+                "bypasses are counted in engine.sp_bypasses",
+                stacklevel=3,
+            )
+            self._sp_bypass_warned = True
+
     def _prefill_possibly_chunked(self, tokens, valid, L: int, cache,
                                   prefix_valid=None, prefix_lens=None):
         """Prefill ``tokens`` (optionally against an existing cached
@@ -1335,9 +1374,25 @@ class JaxEngine(InferenceEngine):
                     prefix_valid=jnp.asarray(prefix_valid),
                     prefix_lens=jnp.asarray(prefix_lens),
                 )
+            if self._prefill_sp is not None and L % self._sp_devices == 0:
+                return self._prefill_sp(
+                    self.params, tokens=jnp.asarray(tokens),
+                    valid=jnp.asarray(valid), cache=cache,
+                )
+            if self._prefill_sp is not None:
+                self._note_sp_bypass(f"bucket L={L} not divisible by "
+                                     f"sp={self._sp_devices}")
             return self._prefill(
                 self.params, tokens=jnp.asarray(tokens),
                 valid=jnp.asarray(valid), cache=cache,
+            )
+        if self._prefill_sp is not None and not has_prefix:
+            # Both prefill_chunk and sequence_parallel_size are set:
+            # chunking wins (prefill_chunk_at is not ring-capable), so
+            # the ring path never sees exactly the long prompts it
+            # targets — count it rather than disengage silently.
+            self._note_sp_bypass(
+                f"chunked prefill (chunk={C}) took the L={L} call"
             )
         # Single-shape chunk stepping (transformer.prefill_chunk_at): the
         # history window is a FIXED [B, P + L - Ct] mask and the write

@@ -305,6 +305,9 @@ def _block(
     impl: str,
     hist_len: int = 0,         # static: cache slots [0, hist_len) hold a
                                # reusable prefix (prefix caching)
+    ring=None,                 # static (Mesh, axis_name): sequence-parallel
+                               # ring attention for the full-prefill branch
+    kv_valid=None,             # [B, T] bool, ring mode only (pads False)
 ) -> Tuple[jax.Array, Dict]:
     B, T, D = x.shape
     h = rms_norm(x, layer["attn_norm"], spec.rms_eps)
@@ -323,7 +326,21 @@ def _block(
     new_entry = _write_cache(cache_entry, k, v, kv_write_pos)
 
     scale = 1.0 / math.sqrt(spec.head_dim)
-    if T > 1 and hist_len > 0:
+    if T > 1 and ring is not None:
+        # Sequence-parallel full prefill: K/V blocks rotate over the sp
+        # ring (ops/ring_attention.py) instead of materializing the
+        # [B, T, T] mask and scores on one device.  Causality is by
+        # physical position (left-padding preserves order) and pads are
+        # masked via kv_valid — exactly prefill()'s mask semantics.
+        from bcg_tpu.ops.ring_attention import ring_attention
+
+        assert hist_len == 0, "ring prefill has no cached-prefix path"
+        mesh, axis_name = ring
+        attn_out = ring_attention(
+            q, k, v, mesh, axis_name=axis_name, causal=True, scale=scale,
+            kv_valid=kv_valid,
+        )
+    elif T > 1 and hist_len > 0:
         # Suffix prefill: the chunk attends over the cached prefix KV
         # plus itself.  Prefix slots are read once per call instead of
         # being recomputed — the point of prefix caching.
@@ -359,6 +376,8 @@ def _run_layers(
     impl: str,
     hist_len: int = 0,
     chunk: bool = False,
+    ring=None,
+    kv_valid=None,
 ):
     """Apply every decoder block: a Python loop for list-form params
     (each layer unrolled into the HLO — best when the program already
@@ -390,7 +409,7 @@ def _run_layers(
             else:
                 h, entry = _block(
                     lp, spec, h, cos, sin, write_pos, ce, attn_mask, impl,
-                    hist_len=hist_len,
+                    hist_len=hist_len, ring=ring, kv_valid=kv_valid,
                 )
             c = jax.tree.map(
                 lambda a, e: jax.lax.dynamic_update_index_in_dim(a, e, li, 0),
@@ -411,7 +430,7 @@ def _run_layers(
         else:
             x, entry = _block(
                 layer, spec, x, cos, sin, write_pos, cache[li], attn_mask,
-                impl, hist_len=hist_len,
+                impl, hist_len=hist_len, ring=ring, kv_valid=kv_valid,
             )
         new_cache.append(entry)
     return x, new_cache
@@ -500,6 +519,53 @@ def prefill(
         params, spec, x, cos, sin, jnp.int32(0), cache, attn_mask, impl
     )
     logits = _logits(params, spec, x[:, -1:, :])[:, 0, :]  # [B, V]
+    return logits, new_cache
+
+
+def prefill_sp(
+    params: TransformerParams,
+    spec: ModelSpec,
+    tokens: jax.Array,        # [B, L] left-padded, L divisible by sp
+    valid: jax.Array,         # [B, L] bool, False on pads
+    cache: Dict,
+    mesh,                     # jax.sharding.Mesh with an `axis_name` axis
+    axis_name: str = "sp",
+    impl: str = "xla",
+) -> Tuple[jax.Array, jax.Array]:
+    """Sequence-parallel full-prompt prefill: ring attention over ``sp``.
+
+    Long-context serving (SURVEY.md §5.7 stretch goal made first-class):
+    the token dimension is sharded over the ``sp`` mesh axis, so per-chip
+    prefill activation memory is O(L/sp) and attention never materializes
+    the [B, L, L] score matrix on one device — K/V blocks rotate around
+    the ICI ring (ops/ring_attention.py).  Per-token work (norms, matmuls,
+    RoPE) partitions over the same axis via the sharding constraint; XLA
+    SPMD inserts the collectives.  Results match :func:`prefill` (same
+    causal-by-physical-position + validity mask semantics; left-padding
+    preserves order).  The reference has no long-context machinery at
+    all — it compresses context instead (truncation ladders,
+    bcg_agents.py:632, a2a_sim.py:69-73).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    B, L = tokens.shape
+    sp = mesh.shape[axis_name]
+    if L % sp:
+        raise ValueError(f"prompt length {L} not divisible by sp={sp}")
+    positions = jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1
+    positions = jnp.maximum(positions, 0)
+    cos, sin = rope_table(positions, spec.head_dim, spec.rope_theta,
+                          spec.rope_scaling)
+
+    x = params["embed"][tokens]
+    x = jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(None, axis_name, None))
+    )
+    x, new_cache = _run_layers(
+        params, spec, x, cos, sin, jnp.int32(0), cache, None, impl,
+        ring=(mesh, axis_name), kv_valid=valid,
+    )
+    logits = _logits(params, spec, x[:, -1:, :])[:, 0, :]
     return logits, new_cache
 
 

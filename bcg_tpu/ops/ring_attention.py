@@ -22,10 +22,11 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-def _block_attend(q, k, v, q_pos, k_pos, scale, causal):
+def _block_attend(q, k, v, q_pos, k_pos, scale, causal, kv_valid=None):
     """One q-block x kv-block partial attention.
 
-    q: [B, Tq, H, Dh], k/v: [B, Tk, Hkv, Dh].
+    q: [B, Tq, H, Dh], k/v: [B, Tk, Hkv, Dh], kv_valid: [B, Tk] bool
+    (False = padded kv position, masked for every query).
     Returns (scores_max [B,H',G,Tq], exp_sum, acc [B,Tq,H,Dh-as-grouped]).
     """
     B, Tq, H, Dh = q.shape
@@ -38,6 +39,11 @@ def _block_attend(q, k, v, q_pos, k_pos, scale, causal):
     if causal:
         mask = q_pos[:, None] >= k_pos[None, :]  # [Tq, Tk]
         logits = jnp.where(mask[None, None, None], logits, -jnp.inf)
+    if kv_valid is not None:
+        # [B, Tk] -> [B, 1, 1, 1, Tk] over (Hkv, G, Tq)
+        logits = jnp.where(
+            kv_valid[:, None, None, None, :], logits, -jnp.inf
+        )
     m = jnp.max(logits, axis=-1)  # [B,Hkv,G,Tq]
     # Guard fully-masked rows (no valid kv yet): exp(-inf - -inf) -> 0.
     safe_m = jnp.where(jnp.isfinite(m), m, 0.0)
@@ -52,38 +58,59 @@ def _block_attend(q, k, v, q_pos, k_pos, scale, causal):
 
 
 def _ring_body(axis_name: str, sp: int, causal: bool, scale: float,
-               q, k0, v0, q_offset, block_len):
-    """Runs on each device inside shard_map."""
+               q, k0, v0, q_offset, block_len, kv_valid0=None,
+               vary_axes=None):
+    """Runs on each device inside shard_map.
+
+    The carry tuple (and the per-step ppermute set) includes the kv
+    validity block only when one was given — the unmasked path must not
+    rotate a dummy all-ones block around the ring every step.
+    """
     B, Tq, H, Dh = q.shape
     Hkv = k0.shape[2]
     group = H // Hkv
     my_idx = jax.lax.axis_index(axis_name)
     q_pos = q_offset + jnp.arange(Tq)
+    masked = kv_valid0 is not None
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
 
     def step(s, carry):
-        m, l, acc, k, v = carry
+        if masked:
+            m, l, acc, k, v, kvv = carry
+        else:
+            m, l, acc, k, v = carry
+            kvv = None
         # After s rotations device i holds block (i - s) mod sp.
         block_owner = (my_idx - s) % sp
         k_pos = block_owner * block_len + jnp.arange(k.shape[1])
-        bm, bl, bacc = _block_attend(q, k, v, q_pos, k_pos, scale, causal)
+        bm, bl, bacc = _block_attend(
+            q, k, v, q_pos, k_pos, scale, causal, kv_valid=kvv,
+        )
         new_m = jnp.maximum(m, bm)
         alpha = jnp.exp(m - new_m)
         beta = jnp.exp(bm - new_m)
         l = l * alpha + bl * beta
         acc = acc * alpha.transpose(0, 3, 1, 2)[..., None] + \
             bacc * beta.transpose(0, 3, 1, 2)[..., None]
-        # Rotate kv to the next device.
-        perm = [(i, (i + 1) % sp) for i in range(sp)]
+        # Rotate kv (and its validity block) to the next device.
         k = jax.lax.ppermute(k, axis_name, perm)
         v = jax.lax.ppermute(v, axis_name, perm)
-        return new_m, l, acc, k, v
+        if not masked:
+            return new_m, l, acc, k, v
+        kvv = jax.lax.ppermute(kvv, axis_name, perm)
+        return new_m, l, acc, k, v, kvv
 
-    # Initial accumulators must carry the same "varying over sp" type as
-    # the loop outputs (which depend on axis_index) — hence pvary.
-    m0 = jax.lax.pvary(jnp.full((B, Hkv, group, Tq), -jnp.inf, jnp.float32), axis_name)
-    l0 = jax.lax.pvary(jnp.zeros((B, Hkv, group, Tq), jnp.float32), axis_name)
-    acc0 = jax.lax.pvary(jnp.zeros((B, Tq, Hkv, group, Dh), jnp.float32), axis_name)
-    m, l, acc, _, _ = jax.lax.fori_loop(0, sp, step, (m0, l0, acc0, k0, v0))
+    # Initial accumulators must carry the same varying-over-mesh-axes
+    # type as the loop outputs (which derive from the sharded inputs and
+    # axis_index) — hence pvary over every axis the inputs are sharded
+    # on (sp always; plus dp/tp on a composed mesh).
+    vary = vary_axes if vary_axes is not None else (axis_name,)
+    m0 = jax.lax.pvary(jnp.full((B, Hkv, group, Tq), -jnp.inf, jnp.float32), vary)
+    l0 = jax.lax.pvary(jnp.zeros((B, Hkv, group, Tq), jnp.float32), vary)
+    acc0 = jax.lax.pvary(jnp.zeros((B, Tq, Hkv, group, Dh), jnp.float32), vary)
+    carry0 = (m0, l0, acc0, k0, v0) + ((kv_valid0,) if masked else ())
+    out_carry = jax.lax.fori_loop(0, sp, step, carry0)
+    m, l, acc = out_carry[0], out_carry[1], out_carry[2]
     out = acc / jnp.maximum(l.transpose(0, 3, 1, 2)[..., None], 1e-30)
     return out.reshape(B, Tq, H, Dh).astype(q.dtype)
 
@@ -96,27 +123,58 @@ def ring_attention(
     axis_name: str = "sp",
     causal: bool = True,
     scale: Optional[float] = None,
+    kv_valid: Optional[jax.Array] = None,  # [B, T] bool; False = pad
 ) -> jax.Array:
-    """Exact attention with the sequence sharded over ``axis_name``."""
+    """Exact attention with the sequence sharded over ``axis_name``.
+
+    ``kv_valid`` masks padded kv positions for every query (the engine's
+    left-padded batches need it); the validity block rotates around the
+    ring with its k/v block.  Fully-masked query rows output 0, matching
+    the engine's flash path.
+    """
     sp = mesh.shape[axis_name]
     B, T, H, Dh = q.shape
+    Hkv = k.shape[2]
     if T % sp:
         raise ValueError(f"sequence length {T} not divisible by sp={sp}")
     block_len = T // sp
     scale = scale if scale is not None else 1.0 / math.sqrt(Dh)
 
-    seq_sharded = P(None, axis_name, None, None)
+    # Composed meshes: attention is independent per batch row and per
+    # GQA group, so shard batch over `dp` and heads over `tp` whenever
+    # the dims divide (a spec that omits a mesh axis REPLICATES over it —
+    # on a dp x tp x sp mesh that would all-gather the tp-sharded heads
+    # into every device and defeat the O(L/sp) memory point).  Sharding
+    # heads requires BOTH H and Hkv to divide so each shard keeps whole
+    # GQA groups.
+    dp_ax = next(
+        (a for a in ("dp",) if mesh.shape.get(a, 1) > 1 and B % mesh.shape[a] == 0),
+        None,
+    )
+    tp_ax = (
+        "tp"
+        if (mesh.shape.get("tp", 1) > 1
+            and H % mesh.shape["tp"] == 0 and Hkv % mesh.shape["tp"] == 0)
+        else None
+    )
+    qkv_spec = P(dp_ax, axis_name, tp_ax, None)
+    valid_spec = P(dp_ax, axis_name)
+    in_specs = (qkv_spec, qkv_spec, qkv_spec) + (
+        (valid_spec,) if kv_valid is not None else ()
+    )
 
-    def body(q_blk, k_blk, v_blk):
+    vary_axes = tuple(a for a in (dp_ax, axis_name, tp_ax) if a is not None)
+
+    def body(q_blk, k_blk, v_blk, *rest):
         my_idx = jax.lax.axis_index(axis_name)
         q_offset = my_idx * block_len
-        return _ring_body(axis_name, sp, causal, scale, q_blk, k_blk, v_blk,
-                          q_offset, block_len)
+        return _ring_body(axis_name, sp, causal, scale,
+                          q_blk, k_blk, v_blk, q_offset, block_len,
+                          kv_valid0=rest[0] if rest else None,
+                          vary_axes=vary_axes)
 
     f = jax.shard_map(
-        body,
-        mesh=mesh,
-        in_specs=(seq_sharded, seq_sharded, seq_sharded),
-        out_specs=seq_sharded,
+        body, mesh=mesh, in_specs=in_specs, out_specs=qkv_spec,
     )
-    return f(q, k, v)
+    args = (q, k, v) + ((kv_valid,) if kv_valid is not None else ())
+    return f(*args)

@@ -158,8 +158,7 @@ run_step() {
   # stage stamps are excluded first — a stamp whose wording happened to
   # contain a marker substring would otherwise turn every deterministic
   # failure of the step into an endless outage-retry loop.
-  if cat "$OUT/$name.json" "$OUT/$name.log" 2>/dev/null \
-      | grep -v '^bench\[' \
+  if grep -hv '^bench\[' "$OUT/$name.json" "$OUT/$name.log" 2>/dev/null \
       | grep -qiE "unavailable|attach|connection refused|response body closed"; then
     log "UNAVAIL $name rc=$rc — back to probing"
     return 2

@@ -538,6 +538,48 @@ class TestEngineUnderMesh:
         assert out[1]["decision"] in ("stop", "continue")
         eng.shutdown()
 
+    def test_sequence_parallel_prefill_end_to_end(self):
+        """sequence_parallel_size=2: the engine's full-prompt prefill
+        dispatches to the ring-attention path (transformer.prefill_sp)
+        and the game-facing contract — schema-valid guided JSON — holds.
+        Long-context SP is an ENGINE capability, not just an op."""
+        eng = self._engine(sequence_parallel_size=2, prefix_caching=False)
+        assert eng._prefill_sp is not None and eng._sp_devices == 2
+        calls = []
+        orig = eng._prefill_sp
+        eng._prefill_sp = lambda *a, **kw: (calls.append(1) or orig(*a, **kw))
+        out = eng.batch_generate_json(
+            [("You are honest.", "Pick a value.", DECISION_SCHEMA),
+             ("You vote.", "Stop or continue?", VOTE_SCHEMA)],
+            temperature=0.0, max_tokens=96,
+        )
+        assert calls, "ring prefill path was never taken"
+        for o in out:
+            assert "error" not in o, o
+        assert 0 <= out[0]["value"] <= 50
+        assert out[1]["decision"] in ("stop", "continue")
+        eng.shutdown()
+
+    def test_sp_bypass_counted_when_chunking_wins(self):
+        """prefill_chunk and sequence_parallel_size are both long-context
+        knobs; chunking wins (prefill_chunk_at is not ring-capable) and
+        that disengagement must be counted, not silent."""
+        import warnings as _w
+
+        eng = self._engine(sequence_parallel_size=2, prefix_caching=False,
+                           prefill_chunk=64)
+        with _w.catch_warnings(record=True) as rec:
+            _w.simplefilter("always")
+            out = eng.batch_generate_json(
+                [("You are honest.", "Pick a value. " * 20, DECISION_SCHEMA)],
+                temperature=0.0, max_tokens=96,
+            )
+        assert "error" not in out[0], out[0]
+        assert eng.sp_bypasses >= 1
+        assert any("sequence-parallel prefill bypassed" in str(w.message)
+                   for w in rec)
+        eng.shutdown()
+
     def test_batch_generate_json_dp2_tp2(self):
         """Composed dp x tp mesh: batch rows shard over dp while weights
         shard over tp — the one-agent-per-device scale-out layout."""

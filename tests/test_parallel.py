@@ -80,6 +80,125 @@ class TestRingAttention:
         with pytest.raises(ValueError, match="divisible"):
             ring_attention(x, x, x, mesh)
 
+    def test_composed_mesh_dp_tp_sp(self):
+        """On a dp x tp x sp mesh the batch shards over dp and heads
+        over tp (replicating them would all-gather tp-sharded heads into
+        every device and defeat the O(L/sp) memory point); results must
+        still match full attention."""
+        mesh = build_mesh(dp=2, tp=2, sp=2)
+        B, T, H, Hkv, Dh = 4, 16, 4, 2, 8
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(5), 3)
+        q = jax.random.normal(kq, (B, T, H, Dh), jnp.float32)
+        k = jax.random.normal(kk, (B, T, Hkv, Dh), jnp.float32)
+        v = jax.random.normal(kv, (B, T, Hkv, Dh), jnp.float32)
+        pad = jnp.array([0, 3, 9, 1])
+        valid = jnp.arange(T)[None, :] >= pad[:, None]
+
+        ring = ring_attention(q, k, v, mesh, axis_name="sp", causal=True,
+                              kv_valid=valid)
+        causal = jnp.tril(jnp.ones((T, T), bool))[None]
+        mask = causal & valid[:, None, :] & valid[:, :, None]
+        full = _xla_attention(q, k, v, mask, 1.0 / np.sqrt(Dh))
+        vmask = np.asarray(valid)
+        np.testing.assert_allclose(
+            np.asarray(ring)[vmask], np.asarray(full)[vmask],
+            rtol=2e-4, atol=2e-4,
+        )
+
+    @pytest.mark.parametrize("sp", [2, 4])
+    def test_kv_valid_matches_masked_full_attention(self, sp):
+        """Left-padded rows (the engine's batch layout): ring with a
+        kv_valid mask must equal full attention under causal & validity
+        masking, and fully-padded query rows must output 0."""
+        mesh = build_mesh(dp=1, tp=1, sp=sp)
+        B, T, H, Hkv, Dh = 3, 32, 4, 2, 16
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(3), 3)
+        q = jax.random.normal(kq, (B, T, H, Dh), jnp.float32)
+        k = jax.random.normal(kk, (B, T, Hkv, Dh), jnp.float32)
+        v = jax.random.normal(kv, (B, T, Hkv, Dh), jnp.float32)
+        pad = jnp.array([0, 5, 19])  # row pad counts (left-padding)
+        valid = jnp.arange(T)[None, :] >= pad[:, None]  # [B, T]
+
+        ring = ring_attention(q, k, v, mesh, axis_name="sp", causal=True,
+                              kv_valid=valid)
+        causal = jnp.tril(jnp.ones((T, T), bool))[None]
+        mask = causal & valid[:, None, :] & valid[:, :, None]
+        full = _xla_attention(q, k, v, mask, 1.0 / np.sqrt(Dh))
+        r, f = np.asarray(ring), np.asarray(full)
+        # Pad q rows: engine's flash path zeroes them; _xla_attention's
+        # f32 softmax over all -inf is NaN there — compare valid rows.
+        vmask = np.asarray(valid)
+        np.testing.assert_allclose(r[vmask], f[vmask], rtol=2e-4, atol=2e-4)
+        assert not np.isnan(r).any()
+        np.testing.assert_array_equal(r[~vmask], 0.0)
+
+
+class TestSequenceParallelPrefill:
+    """prefill_sp (ring attention over the sp mesh axis) must reproduce
+    the single-device prefill exactly: same last-position logits, same
+    KV cache — for list-form layers and for the stacked lax.scan form."""
+
+    @pytest.mark.parametrize("stacked", [False, True])
+    def test_matches_plain_prefill(self, stacked):
+        from bcg_tpu.models.transformer import (
+            init_kv_cache, prefill, prefill_sp, stack_layer_params,
+        )
+
+        spec = spec_for_model("bcg-tpu/tiny-test")
+        params = init_params(spec, jax.random.PRNGKey(0))
+        if stacked:
+            params = stack_layer_params(params)
+        mesh = build_mesh(dp=1, tp=1, sp=4)
+        B, L, S = 3, 64, 96
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (B, L), 0,
+                                    spec.vocab_size)
+        pad = jnp.array([0, 7, 33])
+        valid = jnp.arange(L)[None, :] >= pad[:, None]
+        tokens = jnp.where(valid, tokens, 0)
+
+        ref_logits, ref_cache = prefill(
+            params, spec, tokens, valid,
+            init_kv_cache(spec, B, S, stacked=stacked),
+        )
+        sp_logits, sp_cache = prefill_sp(
+            params, spec, tokens, valid,
+            init_kv_cache(spec, B, S, stacked=stacked),
+            mesh,
+        )
+        # bf16 activations accumulate ~0.05 abs noise through the layers
+        # when the reduction order changes; greedy choice must not move.
+        np.testing.assert_allclose(
+            np.asarray(sp_logits, np.float32),
+            np.asarray(ref_logits, np.float32),
+            rtol=5e-2, atol=6e-2,
+        )
+        assert (np.argmax(np.asarray(sp_logits), -1)
+                == np.argmax(np.asarray(ref_logits), -1)).all()
+        # Compare cache only at valid token slots: pad positions hold
+        # whatever the masked attention produced there (never attended
+        # later — suffix calls mask prefix slots by validity).
+        vmask = np.zeros((B, S), bool)
+        vmask[:, :L] = np.asarray(valid)
+        for a, b in zip(jax.tree.leaves(sp_cache), jax.tree.leaves(ref_cache)):
+            a = np.asarray(a, np.float32)
+            b = np.asarray(b, np.float32)
+            if a.ndim == 4 and a.shape[:2] == (B, S):  # [B, S, Hkv, Dh]
+                a, b = a[vmask], b[vmask]
+            elif a.ndim == 5:  # stacked [Lyr, B, S, Hkv, Dh]
+                a, b = a[:, vmask], b[:, vmask]
+            np.testing.assert_allclose(a, b, rtol=5e-2, atol=6e-2)
+
+    def test_indivisible_length_raises(self):
+        from bcg_tpu.models.transformer import init_kv_cache, prefill_sp
+
+        spec = spec_for_model("bcg-tpu/tiny-test")
+        params = init_params(spec, jax.random.PRNGKey(0))
+        mesh = build_mesh(dp=1, tp=1, sp=4)
+        tokens = jnp.zeros((1, 30), jnp.int32)
+        with pytest.raises(ValueError, match="divisible"):
+            prefill_sp(params, spec, tokens, jnp.ones((1, 30), bool),
+                       init_kv_cache(spec, 1, 32), mesh)
+
 
 class TestSPMDGameStep:
     def setup_method(self):
