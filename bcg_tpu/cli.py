@@ -47,6 +47,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-every-round", action="store_true", help="Write a resumable checkpoint after each round")
     p.add_argument("--resume", type=str, default=None, help="Resume from checkpoint file")
     p.add_argument("--tensor-parallel", type=int, default=None, help="TP mesh axis size")
+    p.add_argument("--sequence-parallel", type=int, default=None,
+                   help="SP mesh axis size (ring-attention long-context prefill)")
     p.add_argument("--quantization", type=str, default=None, choices=["int8", "int4"],
                    help="Weight quantization: int8 = dynamic W8A8 (halves decode "
                         "weight traffic); int4 = grouped W4A16 (capacity: fits "
@@ -120,6 +122,10 @@ def config_from_args(args) -> BCGConfig:
         engine = dataclasses.replace(engine, model_name=resolve_model_name(args.model))
     if args.tensor_parallel:
         engine = dataclasses.replace(engine, tensor_parallel_size=args.tensor_parallel)
+    if args.sequence_parallel:
+        engine = dataclasses.replace(
+            engine, sequence_parallel_size=args.sequence_parallel
+        )
     if args.quantization:
         engine = dataclasses.replace(engine, quantization=args.quantization)
     if args.kv_cache_dtype:

@@ -1368,6 +1368,11 @@ class JaxEngine(InferenceEngine):
         P = prefix_valid.shape[1] if has_prefix else 0
         if not C or L <= C:
             if has_prefix:
+                if self._prefill_sp is not None:
+                    self._note_sp_bypass(
+                        "cached-prefix suffix prefill took the call "
+                        "(prefill_with_prefix is not ring-capable)"
+                    )
                 return self._prefill_suffix(
                     self.params, tokens=jnp.asarray(tokens),
                     valid=jnp.asarray(valid), cache=cache,
@@ -1386,7 +1391,7 @@ class JaxEngine(InferenceEngine):
                 self.params, tokens=jnp.asarray(tokens),
                 valid=jnp.asarray(valid), cache=cache,
             )
-        if self._prefill_sp is not None and not has_prefix:
+        if self._prefill_sp is not None:
             # Both prefill_chunk and sequence_parallel_size are set:
             # chunking wins (prefill_chunk_at is not ring-capable), so
             # the ring path never sees exactly the long prompts it

@@ -531,7 +531,7 @@ def prefill_sp(
     mesh,                     # jax.sharding.Mesh with an `axis_name` axis
     axis_name: str = "sp",
     impl: str = "xla",
-) -> Tuple[jax.Array, jax.Array]:
+) -> Tuple[jax.Array, Dict]:
     """Sequence-parallel full-prompt prefill: ring attention over ``sp``.
 
     Long-context serving (SURVEY.md §5.7 stretch goal made first-class):

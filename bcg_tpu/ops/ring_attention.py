@@ -147,9 +147,10 @@ def ring_attention(
     # into every device and defeat the O(L/sp) memory point).  Sharding
     # heads requires BOTH H and Hkv to divide so each shard keeps whole
     # GQA groups.
-    dp_ax = next(
-        (a for a in ("dp",) if mesh.shape.get(a, 1) > 1 and B % mesh.shape[a] == 0),
-        None,
+    dp_ax = (
+        "dp"
+        if mesh.shape.get("dp", 1) > 1 and B % mesh.shape["dp"] == 0
+        else None
     )
     tp_ax = (
         "tp"
