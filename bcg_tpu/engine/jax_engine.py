@@ -277,6 +277,16 @@ class JaxEngine(InferenceEngine):
             self._kv_align = ALIGN_S
         else:
             self._kv_align = 1
+        # Sequence-parallel decode shards the cache over sp, so the
+        # allocated length must divide by sp — the length-bucket ladders
+        # are all even but S = bucket + max_new + 1 is odd, which would
+        # otherwise quietly disqualify EVERY engine cache from the ring
+        # decode path (caught by review, round 4).
+        _sp = mesh.shape.get("sp", 1) if mesh is not None else 1
+        if _sp > 1:
+            import math as _math
+
+            self._kv_align = _math.lcm(self._kv_align, _sp)
         # Bytes per (position, layer) cache slot — the unit shared by the
         # perf accounting, the KV budget guard, and the provisioner.
         self._kv_slot_bytes = self.spec.num_kv_heads * self.spec.head_dim * 2
@@ -456,6 +466,13 @@ class JaxEngine(InferenceEngine):
         # optimization hid a disabled cache for a whole round once.
         self.sp_bypasses = 0
         self._sp_bypass_warned = False
+        # True once a decode loop was built with the sp-sharded-cache
+        # attention (set in _get_decode_loop).  Truthful by construction:
+        # cache allocation is sp-aligned (_kv_align) and an indivisible
+        # cache length raises inside sp_decode_attention instead of
+        # silently replicating, so an active flag cannot coexist with a
+        # disengaged path.
+        self._decode_ring_active = False
         # Calls whose batch the hbm_utilization provisioner chunked.
         self.provision_chunk_events = 0
         # Pad the token-byte table to the MODEL vocab (embedding tables are
@@ -1103,6 +1120,17 @@ class JaxEngine(InferenceEngine):
         impl = self.decode_attention_impl
         eos_id = self.tokenizer.eos_id
         sampler = self._make_masked_sampler(eos_id, top_p)
+        # Sequence-parallel decode: keep the cache sharded over sp inside
+        # the loop and merge per-slice attention partials with pmax/psum
+        # (transformer.decode_step ring= -> sp_decode_attention).  bf16
+        # cache only; the quantized cache's [B, Hkv, S, Dh] layout takes
+        # its own kernels.
+        ring = (
+            (self.mesh, "sp")
+            if self._sp_devices > 1 and not self.kv_quantized
+            else None
+        )
+        self._decode_ring_active = ring is not None
 
         def loop(params, cache, first_logits, valid_mask, prompt_lens, L,
                  tables, accepting, min_budget, dfa_ids, init_states,
@@ -1132,6 +1160,7 @@ class JaxEngine(InferenceEngine):
                     params, spec,
                     jnp.where(done, eos_id, cur_tok),
                     L + i, prompt_lens + i, cache, valid_mask, impl,
+                    ring=ring,
                 )
                 tok, states, rng = masked_sample(logits, states, rng, i + 1)
                 tok = jnp.where(done, eos_id, tok)
@@ -1184,6 +1213,16 @@ class JaxEngine(InferenceEngine):
             if self.kv_quantized and self.decode_attention_impl == "pallas"
             else "xla"
         )
+        if self._sp_devices > 1:
+            # Fast-forward's [B, K] chunk attention has no sp-sharded
+            # variant yet — the loop runs with a replicated cache.  Same
+            # no-silent-disengagement policy as the prefill-side bypass;
+            # counted per CALL (before the compiled-loop cache hit), like
+            # the prefill-side notes.
+            self._note_sp_bypass(
+                "fast-forward decode loop has no sequence-parallel "
+                "variant; its cache is not sp-sharded"
+            )
         key = ("ff", guided_sig, int(max_new), float(top_p), chunk_impl)
         if key in self._decode_loops:
             return self._decode_loops[key]

@@ -355,6 +355,24 @@ def _block(
         # in the cache), so prefill cost is O(L^2) not O(L*S_cache) and
         # is unaffected by cache quantization.
         attn_out = attention(q, k, v, attn_mask, scale, impl)
+    elif ring is not None and "k_scale" not in new_entry:
+        # Sequence-parallel decode: the cache stays sharded over sp and
+        # each device attends its slice; partials merge via pmax/psum of
+        # O(B*H) stats (ops/ring_attention.sp_decode_attention).  bf16
+        # cache layout only — a quantized cache falls through to
+        # _cache_attention's dequant path.  Indivisible cache length is
+        # a LOUD error, not a silent fallback: the engine aligns its
+        # cache allocation to sp (jax_engine._kv_align), so reaching
+        # here with S % sp != 0 means that guarantee broke — and a
+        # silent replicated fallback once made this whole path dead
+        # while its feature flag read as active.
+        from bcg_tpu.ops.ring_attention import sp_decode_attention
+
+        mesh, axis_name = ring
+        attn_out = sp_decode_attention(
+            q[:, 0], new_entry["k"], new_entry["v"], attn_mask, mesh,
+            axis_name=axis_name, scale=scale,
+        )[:, None]
     else:
         attn_out = _cache_attention(q, new_entry, attn_mask, scale, impl)
     x = x + dense(attn_out.reshape(B, T, spec.q_size), layer["wo"])
@@ -657,6 +675,8 @@ def decode_step(
     cache: Dict,
     valid_mask: jax.Array,     # [B, S] which cache slots are attendable
     impl: str = "xla",
+    ring=None,                 # static (Mesh, axis_name): sp-sharded-cache
+                               # decode (ops/ring_attention.sp_decode_attention)
 ) -> Tuple[jax.Array, Dict]:
     """One autoregressive step for the whole batch."""
     B = token.shape[0]
@@ -664,7 +684,8 @@ def decode_step(
     x = params["embed"][token][:, None, :]  # [B, 1, D]
 
     x, new_cache = _run_layers(
-        params, spec, x, cos, sin, write_pos, cache, valid_mask, impl
+        params, spec, x, cos, sin, write_pos, cache, valid_mask, impl,
+        ring=ring,
     )
     logits = _logits(params, spec, x)[:, 0, :]
     return logits, new_cache

@@ -115,6 +115,90 @@ def _ring_body(axis_name: str, sp: int, causal: bool, scale: float,
     return out.reshape(B, Tq, H, Dh).astype(q.dtype)
 
 
+def sp_decode_attention(
+    q: jax.Array,        # [B, H, Dh] one decode-step query
+    k: jax.Array,        # [B, S, Hkv, Dh] cache, S divisible by sp
+    v: jax.Array,        # [B, S, Hkv, Dh]
+    mask: jax.Array,     # [B, S] bool attendable slots
+    mesh: Mesh,
+    axis_name: str = "sp",
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Decode-step attention over a sequence-sharded KV cache.
+
+    Flash-decoding shape: each device attends its local S/sp cache slice
+    (partial max / exp-sum / accumulator in f32), then the partials merge
+    across the ``sp`` axis with one ``pmax`` + two ``psum``s of
+    O(B*H)-sized stats — the cache itself never moves.  With sp chips the
+    decode-bandwidth roof scales ~sp× for long contexts: decode is
+    KV-bound (BENCH_NOTES: 88% of single-chip HBM roof at bench shapes),
+    so slicing the cache across chips is the scaling lever single-chip
+    kernels cannot reach.  Exact, not approximate.  bf16 cache layout
+    ([B, S, Hkv, Dh]); a quantized cache dequantizes before this op.
+
+    Composed meshes shard batch over ``dp`` and whole GQA groups over
+    ``tp`` when the dims divide (same policy as :func:`ring_attention`).
+    """
+    B, H, Dh = q.shape
+    S = k.shape[1]
+    Hkv = k.shape[2]
+    sp = mesh.shape[axis_name]
+    if S % sp:
+        raise ValueError(f"cache length {S} not divisible by sp={sp}")
+    scale = scale if scale is not None else 1.0 / math.sqrt(Dh)
+    group = H // Hkv
+
+    dp_ax = (
+        "dp"
+        if mesh.shape.get("dp", 1) > 1 and B % mesh.shape["dp"] == 0
+        else None
+    )
+    tp_ax = (
+        "tp"
+        if (mesh.shape.get("tp", 1) > 1
+            and H % mesh.shape["tp"] == 0 and Hkv % mesh.shape["tp"] == 0)
+        else None
+    )
+
+    def body(q_blk, k_blk, v_blk, mask_blk):
+        qg = q_blk.reshape(q_blk.shape[0], -1, group, Dh)  # [b, hkv, g, Dh]
+        logits = jnp.einsum(
+            "bhgd,bshd->bhgs", qg, k_blk,
+            preferred_element_type=jnp.float32,
+        ) * scale
+        logits = jnp.where(mask_blk[:, None, None, :], logits, -jnp.inf)
+        m_loc = jnp.max(logits, axis=-1)              # [b, hkv, g]
+        safe_m = jnp.where(jnp.isfinite(m_loc), m_loc, 0.0)
+        p = jnp.exp(logits - safe_m[..., None])
+        p = jnp.where(jnp.isfinite(logits), p, 0.0)
+        l_loc = jnp.sum(p, axis=-1)                   # [b, hkv, g]
+        acc_loc = jnp.einsum(
+            "bhgs,bshd->bhgd", p.astype(v_blk.dtype), v_blk,
+            preferred_element_type=jnp.float32,
+        )
+        # Merge partials across the cache slices: global running max,
+        # then rescale each slice's exp-sum/accumulator into it.
+        m_glob = jax.lax.pmax(safe_m, axis_name)
+        corr = jnp.exp(safe_m - m_glob)
+        l = jax.lax.psum(l_loc * corr, axis_name)
+        acc = jax.lax.psum(acc_loc * corr[..., None], axis_name)
+        out = acc / jnp.maximum(l[..., None], 1e-30)
+        return out.reshape(out.shape[0], -1, Dh).astype(q_blk.dtype)
+
+    f = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P(dp_ax, tp_ax, None),            # q [B, H, Dh]
+            P(dp_ax, axis_name, tp_ax, None),  # k [B, S, Hkv, Dh]
+            P(dp_ax, axis_name, tp_ax, None),  # v
+            P(dp_ax, axis_name),               # mask [B, S]
+        ),
+        out_specs=P(dp_ax, tp_ax, None),
+    )
+    return f(q, k, v, mask)
+
+
 def ring_attention(
     q: jax.Array,   # [B, T, H, Dh], T divisible by sp
     k: jax.Array,   # [B, T, Hkv, Dh]

@@ -554,6 +554,9 @@ class TestEngineUnderMesh:
             temperature=0.0, max_tokens=96,
         )
         assert calls, "ring prefill path was never taken"
+        # Decode ran over the sp-sharded cache (sp_decode_attention
+        # inside the jitted loop), not a replicated one.
+        assert eng._decode_ring_active
         for o in out:
             assert "error" not in o, o
         assert 0 <= out[0]["value"] <= 50
