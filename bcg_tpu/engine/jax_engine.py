@@ -1130,6 +1130,14 @@ class JaxEngine(InferenceEngine):
             if self._sp_devices > 1 and not self.kv_quantized
             else None
         )
+        if self._sp_devices > 1 and self.kv_quantized:
+            # Same no-silent-disengagement policy as every other sp
+            # bypass: the int8 cache's [B, Hkv, S, Dh] layout has no
+            # sp-sharded decode variant.
+            self._note_sp_bypass(
+                "int8 KV cache has no sequence-parallel decode variant; "
+                "the decode loop's cache is not sp-sharded"
+            )
         self._decode_ring_active = ring is not None
 
         def loop(params, cache, first_logits, valid_mask, prompt_lens, L,
@@ -1371,14 +1379,15 @@ class JaxEngine(InferenceEngine):
         )
 
     def _note_sp_bypass(self, reason: str) -> None:
-        """Count (and warn once about) a full-prefill call that skipped
-        the configured sequence-parallel ring path."""
+        """Count (and warn once about) a call that skipped the configured
+        sequence-parallel path (ring prefill or sp-sharded-cache decode —
+        the reason string names which)."""
         self.sp_bypasses += 1
         if not self._sp_bypass_warned:
             import warnings
 
             warnings.warn(
-                f"sequence-parallel prefill bypassed: {reason}; further "
+                f"sequence-parallel path bypassed: {reason}; further "
                 "bypasses are counted in engine.sp_bypasses",
                 stacklevel=3,
             )

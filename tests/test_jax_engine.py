@@ -579,7 +579,7 @@ class TestEngineUnderMesh:
             )
         assert "error" not in out[0], out[0]
         assert eng.sp_bypasses >= 1
-        assert any("sequence-parallel prefill bypassed" in str(w.message)
+        assert any("sequence-parallel path bypassed" in str(w.message)
                    for w in rec)
         eng.shutdown()
 
