@@ -1111,34 +1111,32 @@ class JaxEngine(InferenceEngine):
         compile keys: one compiled loop serves greedy and sampled rows,
         decide- and vote-budget rows, in the same batch — which is what
         lets desynchronized games merge under the collective engine."""
-        key = (guided_sig, int(max_new), float(top_p),
-               self.decode_attention_impl)
-        if key in self._decode_loops:
-            return self._decode_loops[key]
-
-        spec = self.spec
-        impl = self.decode_attention_impl
-        eos_id = self.tokenizer.eos_id
-        sampler = self._make_masked_sampler(eos_id, top_p)
         # Sequence-parallel decode: keep the cache sharded over sp inside
         # the loop and merge per-slice attention partials with pmax/psum
         # (transformer.decode_step ring= -> sp_decode_attention).  bf16
         # cache only; the quantized cache's [B, Hkv, S, Dh] layout takes
-        # its own kernels.
+        # its own kernels — that bypass is counted per CALL (before the
+        # compiled-loop cache hit), like every other sp bypass.
         ring = (
             (self.mesh, "sp")
             if self._sp_devices > 1 and not self.kv_quantized
             else None
         )
         if self._sp_devices > 1 and self.kv_quantized:
-            # Same no-silent-disengagement policy as every other sp
-            # bypass: the int8 cache's [B, Hkv, S, Dh] layout has no
-            # sp-sharded decode variant.
             self._note_sp_bypass(
                 "int8 KV cache has no sequence-parallel decode variant; "
                 "the decode loop's cache is not sp-sharded"
             )
+        key = (guided_sig, int(max_new), float(top_p),
+               self.decode_attention_impl)
+        if key in self._decode_loops:
+            return self._decode_loops[key]
         self._decode_ring_active = ring is not None
+
+        spec = self.spec
+        impl = self.decode_attention_impl
+        eos_id = self.tokenizer.eos_id
+        sampler = self._make_masked_sampler(eos_id, top_p)
 
         def loop(params, cache, first_logits, valid_mask, prompt_lens, L,
                  tables, accepting, min_budget, dfa_ids, init_states,
@@ -1221,19 +1219,24 @@ class JaxEngine(InferenceEngine):
             if self.kv_quantized and self.decode_attention_impl == "pallas"
             else "xla"
         )
-        if self._sp_devices > 1:
-            # Fast-forward's [B, K] chunk attention has no sp-sharded
-            # variant yet — the loop runs with a replicated cache.  Same
-            # no-silent-disengagement policy as the prefill-side bypass;
-            # counted per CALL (before the compiled-loop cache hit), like
-            # the prefill-side notes.
+        # Sequence-parallel chunk decode (bf16 cache): the cache stays
+        # sp-sharded inside the ff loop too (sp_chunk_decode_attention).
+        # The int8 cache's [B, Hkv, S, Dh] layout has no sp variant —
+        # counted per CALL (before the compiled-loop cache hit).
+        ring = (
+            (self.mesh, "sp")
+            if self._sp_devices > 1 and not self.kv_quantized
+            else None
+        )
+        if self._sp_devices > 1 and self.kv_quantized:
             self._note_sp_bypass(
-                "fast-forward decode loop has no sequence-parallel "
-                "variant; its cache is not sp-sharded"
+                "int8 KV cache has no sequence-parallel chunk-decode "
+                "variant; the fast-forward loop's cache is not sp-sharded"
             )
         key = ("ff", guided_sig, int(max_new), float(top_p), chunk_impl)
         if key in self._decode_loops:
             return self._decode_loops[key]
+        self._decode_ring_active = ring is not None
 
         spec = self.spec
         eos_id = self.tokenizer.eos_id
@@ -1293,7 +1296,7 @@ class JaxEngine(InferenceEngine):
                 positions = (prompt_lens + emitted)[:, None] + j
                 logits, cache = decode_chunk(
                     params, spec, chunk, chunk_valid, wp, positions,
-                    cache, valid_mask, impl=chunk_impl,
+                    cache, valid_mask, impl=chunk_impl, ring=ring,
                 )
                 valid_mask = jax.lax.dynamic_update_slice(
                     valid_mask, chunk_valid, (0, wp)

@@ -422,7 +422,8 @@ def _run_layers(
             )
             if chunk:
                 h, entry = _block_chunk(
-                    lp, spec, h, cos, sin, write_pos, ce, attn_mask, impl
+                    lp, spec, h, cos, sin, write_pos, ce, attn_mask, impl,
+                    ring=ring,
                 )
             else:
                 h, entry = _block(
@@ -443,7 +444,8 @@ def _run_layers(
     for li, layer in enumerate(layers):
         if chunk:
             x, entry = _block_chunk(
-                layer, spec, x, cos, sin, write_pos, cache[li], attn_mask, impl
+                layer, spec, x, cos, sin, write_pos, cache[li], attn_mask,
+                impl, ring=ring,
             )
         else:
             x, entry = _block(
@@ -701,6 +703,8 @@ def decode_chunk(
     cache: Dict,
     cache_valid: jax.Array,    # [B, S] attendable cache slots BEFORE chunk
     impl: str = "xla",
+    ring=None,                 # static (Mesh, axis_name): sp-sharded-cache
+                               # chunk decode (sp_chunk_decode_attention)
 ) -> Tuple[jax.Array, Dict]:
     """One fast-forward step: process a [B, K] token chunk against the
     cache (forced-chain fast-forward — the sampled token plus up to K-1
@@ -725,7 +729,7 @@ def decode_chunk(
     x = params["embed"][tokens]
     x, new_cache = _run_layers(
         params, spec, x, cos, sin, write_pos, cache, attn_mask, impl,
-        chunk=True,
+        chunk=True, ring=ring,
     )
     # Per-row last valid chunk position -> one LM-head application.
     last = jnp.sum(chunk_valid.astype(jnp.int32), axis=1) - 1      # [B]
@@ -743,6 +747,8 @@ def _block_chunk(
     cache_entry: Dict,
     attn_mask: jax.Array,      # [B, K, S]
     impl: str,
+    ring=None,                 # static (Mesh, axis_name): sp-sharded-cache
+                               # chunk decode (sp_chunk_decode_attention)
 ) -> Tuple[jax.Array, Dict]:
     """Chunk decode block: write the fresh K positions into the cache,
     then attend over the WHOLE cache (prior context + the chunk itself,
@@ -776,6 +782,17 @@ def _block_chunk(
         attn_out = chunk_decode_attention(
             q, new_entry["k"], new_entry["v"], attn_mask, scale,
             k_scale=new_entry["k_scale"], v_scale=new_entry["v_scale"],
+        )
+    elif ring is not None and not quantized:
+        # Sequence-parallel chunk decode: cache stays sharded over sp,
+        # partials merge via pmax/psum (same loud-on-indivisible policy
+        # as the single-token path — the engine sp-aligns its caches).
+        from bcg_tpu.ops.ring_attention import sp_chunk_decode_attention
+
+        mesh, axis_name = ring
+        attn_out = sp_chunk_decode_attention(
+            q, new_entry["k"], new_entry["v"], attn_mask, mesh,
+            axis_name=axis_name, scale=scale,
         )
     else:
         ck, cv = new_entry["k"], new_entry["v"]

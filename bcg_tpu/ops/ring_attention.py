@@ -115,31 +115,34 @@ def _ring_body(axis_name: str, sp: int, causal: bool, scale: float,
     return out.reshape(B, Tq, H, Dh).astype(q.dtype)
 
 
-def sp_decode_attention(
-    q: jax.Array,        # [B, H, Dh] one decode-step query
+def sp_chunk_decode_attention(
+    q: jax.Array,        # [B, K, H, Dh] chunk of decode queries
     k: jax.Array,        # [B, S, Hkv, Dh] cache, S divisible by sp
     v: jax.Array,        # [B, S, Hkv, Dh]
-    mask: jax.Array,     # [B, S] bool attendable slots
+    mask: jax.Array,     # [B, K, S] bool attendable slots per query
     mesh: Mesh,
     axis_name: str = "sp",
     scale: Optional[float] = None,
 ) -> jax.Array:
-    """Decode-step attention over a sequence-sharded KV cache.
+    """Chunk-decode attention over a sequence-sharded KV cache.
 
     Flash-decoding shape: each device attends its local S/sp cache slice
     (partial max / exp-sum / accumulator in f32), then the partials merge
     across the ``sp`` axis with one ``pmax`` + two ``psum``s of
-    O(B*H)-sized stats — the cache itself never moves.  With sp chips the
-    decode-bandwidth roof scales ~sp× for long contexts: decode is
+    O(B*K*H)-sized stats — the cache itself never moves.  With sp chips
+    the decode-bandwidth roof scales ~sp× for long contexts: decode is
     KV-bound (BENCH_NOTES: 88% of single-chip HBM roof at bench shapes),
     so slicing the cache across chips is the scaling lever single-chip
-    kernels cannot reach.  Exact, not approximate.  bf16 cache layout
-    ([B, S, Hkv, Dh]); a quantized cache dequantizes before this op.
+    kernels cannot reach.  Exact, not approximate.  Serves both the
+    plain single-token loop (K=1 via :func:`sp_decode_attention`) and
+    the forced-chain fast-forward loop's [B, K] chunks.  bf16 cache
+    layout ([B, S, Hkv, Dh]); a quantized cache dequantizes before this
+    op.
 
     Composed meshes shard batch over ``dp`` and whole GQA groups over
     ``tp`` when the dims divide (same policy as :func:`ring_attention`).
     """
-    B, H, Dh = q.shape
+    B, K, H, Dh = q.shape
     S = k.shape[1]
     Hkv = k.shape[2]
     sp = mesh.shape[axis_name]
@@ -161,42 +164,64 @@ def sp_decode_attention(
     )
 
     def body(q_blk, k_blk, v_blk, mask_blk):
-        qg = q_blk.reshape(q_blk.shape[0], -1, group, Dh)  # [b, hkv, g, Dh]
+        b = q_blk.shape[0]
+        qg = q_blk.reshape(b, K, -1, group, Dh)       # [b, K, hkv, g, Dh]
+        # Stats layout [b, K, hkv, g(, ...)] throughout — K stays in
+        # position 1 on every side, so no transposes in the merge.
         logits = jnp.einsum(
-            "bhgd,bshd->bhgs", qg, k_blk,
+            "bkhgd,bshd->bkhgs", qg, k_blk,
             preferred_element_type=jnp.float32,
         ) * scale
-        logits = jnp.where(mask_blk[:, None, None, :], logits, -jnp.inf)
-        m_loc = jnp.max(logits, axis=-1)              # [b, hkv, g]
+        logits = jnp.where(
+            mask_blk[:, :, None, None, :], logits, -jnp.inf
+        )
+        m_loc = jnp.max(logits, axis=-1)              # [b, K, hkv, g]
         safe_m = jnp.where(jnp.isfinite(m_loc), m_loc, 0.0)
         p = jnp.exp(logits - safe_m[..., None])
         p = jnp.where(jnp.isfinite(logits), p, 0.0)
-        l_loc = jnp.sum(p, axis=-1)                   # [b, hkv, g]
+        l_loc = jnp.sum(p, axis=-1)                   # [b, K, hkv, g]
         acc_loc = jnp.einsum(
-            "bhgs,bshd->bhgd", p.astype(v_blk.dtype), v_blk,
+            "bkhgs,bshd->bkhgd", p.astype(v_blk.dtype), v_blk,
             preferred_element_type=jnp.float32,
         )
         # Merge partials across the cache slices: global running max,
         # then rescale each slice's exp-sum/accumulator into it.
         m_glob = jax.lax.pmax(safe_m, axis_name)
-        corr = jnp.exp(safe_m - m_glob)
+        corr = jnp.exp(safe_m - m_glob)               # [b, K, hkv, g]
         l = jax.lax.psum(l_loc * corr, axis_name)
         acc = jax.lax.psum(acc_loc * corr[..., None], axis_name)
         out = acc / jnp.maximum(l[..., None], 1e-30)
-        return out.reshape(out.shape[0], -1, Dh).astype(q_blk.dtype)
+        return out.reshape(b, K, -1, Dh).astype(q_blk.dtype)
 
     f = jax.shard_map(
         body,
         mesh=mesh,
         in_specs=(
-            P(dp_ax, tp_ax, None),            # q [B, H, Dh]
+            P(dp_ax, None, tp_ax, None),       # q [B, K, H, Dh]
             P(dp_ax, axis_name, tp_ax, None),  # k [B, S, Hkv, Dh]
             P(dp_ax, axis_name, tp_ax, None),  # v
-            P(dp_ax, axis_name),               # mask [B, S]
+            P(dp_ax, None, axis_name),         # mask [B, K, S]
         ),
-        out_specs=P(dp_ax, tp_ax, None),
+        out_specs=P(dp_ax, None, tp_ax, None),
     )
     return f(q, k, v, mask)
+
+
+def sp_decode_attention(
+    q: jax.Array,        # [B, H, Dh] one decode-step query
+    k: jax.Array,        # [B, S, Hkv, Dh] cache, S divisible by sp
+    v: jax.Array,        # [B, S, Hkv, Dh]
+    mask: jax.Array,     # [B, S] bool attendable slots
+    mesh: Mesh,
+    axis_name: str = "sp",
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Single-token decode attention over a sequence-sharded KV cache
+    (the K=1 case of :func:`sp_chunk_decode_attention`)."""
+    return sp_chunk_decode_attention(
+        q[:, None], k, v, mask[:, None, :], mesh,
+        axis_name=axis_name, scale=scale,
+    )[:, 0]
 
 
 def ring_attention(

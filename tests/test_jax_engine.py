@@ -563,6 +563,29 @@ class TestEngineUnderMesh:
         assert out[1]["decision"] in ("stop", "continue")
         eng.shutdown()
 
+    def test_sequence_parallel_fast_forward_decode(self):
+        """The fast-forward loop (the bench-default decode path) also
+        keeps its bf16 cache sp-sharded (sp_chunk_decode_attention)."""
+        eng = self._engine(sequence_parallel_size=2, prefix_caching=False,
+                           decode_fast_forward=True)
+        out = eng.batch_generate_json(
+            [("You are honest.", "Pick a value.", DECISION_SCHEMA),
+             ("You vote.", "Stop or continue?", VOTE_SCHEMA)],
+            temperature=0.0, max_tokens=96,
+        )
+        assert eng._decode_ring_active
+        assert eng.sp_bypasses == 0
+        for o in out:
+            assert "error" not in o, o
+        assert out[1]["decision"] in ("stop", "continue")
+        # Same schema-valid result twice: deterministic under the mesh.
+        assert out == eng.batch_generate_json(
+            [("You are honest.", "Pick a value.", DECISION_SCHEMA),
+             ("You vote.", "Stop or continue?", VOTE_SCHEMA)],
+            temperature=0.0, max_tokens=96,
+        )
+        eng.shutdown()
+
     def test_sp_bypass_counted_when_chunking_wins(self):
         """prefill_chunk and sequence_parallel_size are both long-context
         knobs; chunking wins (prefill_chunk_at is not ring-capable) and

@@ -191,6 +191,32 @@ class TestSpDecodeAttention:
                 jnp.zeros((1, 12, 2, 8)), jnp.ones((1, 12), bool), mesh,
             )
 
+    @pytest.mark.parametrize("sp", [2, 4])
+    def test_chunk_queries_match_full_attention(self, sp):
+        """K>1 chunks (the fast-forward loop's shape): per-query masks
+        over the sharded cache, incl. intra-chunk causal structure."""
+        from bcg_tpu.models.transformer import _xla_attention
+        from bcg_tpu.ops.ring_attention import sp_chunk_decode_attention
+
+        mesh = build_mesh(dp=1, tp=1, sp=sp)
+        B, K, S, H, Hkv, Dh = 2, 4, 32, 4, 2, 16
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(9), 3)
+        q = jax.random.normal(kq, (B, K, H, Dh), jnp.float32)
+        k = jax.random.normal(kk, (B, S, Hkv, Dh), jnp.float32)
+        v = jax.random.normal(kv, (B, S, Hkv, Dh), jnp.float32)
+        # Each chunk query attends a row-specific prefix plus its own
+        # causally-visible chunk slots (as decode_chunk builds it).
+        prior = [10, 3]
+        mask_np = np.zeros((B, K, S), bool)
+        for b in range(B):
+            for j in range(K):
+                mask_np[b, j, :prior[b] + j + 1] = True
+        mask = jnp.asarray(mask_np)
+        out = sp_chunk_decode_attention(q, k, v, mask, mesh)
+        ref = _xla_attention(q, k, v, mask, 1.0 / np.sqrt(Dh))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
 
 class TestSequenceParallelPrefill:
     """prefill_sp (ring attention over the sp mesh axis) must reproduce
