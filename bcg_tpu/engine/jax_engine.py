@@ -1113,20 +1113,9 @@ class JaxEngine(InferenceEngine):
         lets desynchronized games merge under the collective engine."""
         # Sequence-parallel decode: keep the cache sharded over sp inside
         # the loop and merge per-slice attention partials with pmax/psum
-        # (transformer.decode_step ring= -> sp_decode_attention).  bf16
-        # cache only; the quantized cache's [B, Hkv, S, Dh] layout takes
-        # its own kernels — that bypass is counted per CALL (before the
-        # compiled-loop cache hit), like every other sp bypass.
-        ring = (
-            (self.mesh, "sp")
-            if self._sp_devices > 1 and not self.kv_quantized
-            else None
-        )
-        if self._sp_devices > 1 and self.kv_quantized:
-            self._note_sp_bypass(
-                "int8 KV cache has no sequence-parallel decode variant; "
-                "the decode loop's cache is not sp-sharded"
-            )
+        # (transformer.decode_step ring= -> sp_decode_attention).  An
+        # int8 cache dequantizes only its local S/sp slice in there.
+        ring = (self.mesh, "sp") if self._sp_devices > 1 else None
         key = (guided_sig, int(max_new), float(top_p),
                self.decode_attention_impl)
         if key in self._decode_loops:
@@ -1219,20 +1208,10 @@ class JaxEngine(InferenceEngine):
             if self.kv_quantized and self.decode_attention_impl == "pallas"
             else "xla"
         )
-        # Sequence-parallel chunk decode (bf16 cache): the cache stays
-        # sp-sharded inside the ff loop too (sp_chunk_decode_attention).
-        # The int8 cache's [B, Hkv, S, Dh] layout has no sp variant —
-        # counted per CALL (before the compiled-loop cache hit).
-        ring = (
-            (self.mesh, "sp")
-            if self._sp_devices > 1 and not self.kv_quantized
-            else None
-        )
-        if self._sp_devices > 1 and self.kv_quantized:
-            self._note_sp_bypass(
-                "int8 KV cache has no sequence-parallel chunk-decode "
-                "variant; the fast-forward loop's cache is not sp-sharded"
-            )
+        # Sequence-parallel chunk decode: the cache stays sp-sharded
+        # inside the ff loop too (sp_chunk_decode_attention); an int8
+        # cache dequantizes only its local S/sp slice in there.
+        ring = (self.mesh, "sp") if self._sp_devices > 1 else None
         key = ("ff", guided_sig, int(max_new), float(top_p), chunk_impl)
         if key in self._decode_loops:
             return self._decode_loops[key]

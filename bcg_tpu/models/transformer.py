@@ -355,23 +355,25 @@ def _block(
         # in the cache), so prefill cost is O(L^2) not O(L*S_cache) and
         # is unaffected by cache quantization.
         attn_out = attention(q, k, v, attn_mask, scale, impl)
-    elif ring is not None and "k_scale" not in new_entry:
+    elif ring is not None:
         # Sequence-parallel decode: the cache stays sharded over sp and
         # each device attends its slice; partials merge via pmax/psum of
-        # O(B*H) stats (ops/ring_attention.sp_decode_attention).  bf16
-        # cache layout only — a quantized cache falls through to
-        # _cache_attention's dequant path.  Indivisible cache length is
-        # a LOUD error, not a silent fallback: the engine aligns its
-        # cache allocation to sp (jax_engine._kv_align), so reaching
-        # here with S % sp != 0 means that guarantee broke — and a
-        # silent replicated fallback once made this whole path dead
-        # while its feature flag read as active.
+        # O(B*H) stats (ops/ring_attention.sp_decode_attention).  An
+        # int8 cache dequantizes only its local S/sp slice inside the
+        # shard_map.  Indivisible cache length is a LOUD error, not a
+        # silent fallback: the engine aligns its cache allocation to sp
+        # (jax_engine._kv_align), so reaching here with S % sp != 0
+        # means that guarantee broke — and a silent replicated fallback
+        # once made this whole path dead while its feature flag read as
+        # active.
         from bcg_tpu.ops.ring_attention import sp_decode_attention
 
         mesh, axis_name = ring
         attn_out = sp_decode_attention(
             q[:, 0], new_entry["k"], new_entry["v"], attn_mask, mesh,
             axis_name=axis_name, scale=scale,
+            k_scale=new_entry.get("k_scale"),
+            v_scale=new_entry.get("v_scale"),
         )[:, None]
     else:
         attn_out = _cache_attention(q, new_entry, attn_mask, scale, impl)
@@ -772,7 +774,23 @@ def _block_chunk(
     # Attend over the full cache including the just-written chunk.
     scale = 1.0 / math.sqrt(spec.head_dim)
     quantized = "k_scale" in new_entry
-    if quantized and impl == "pallas" and jax.default_backend() == "tpu" \
+    if ring is not None:
+        # Sequence-parallel chunk decode: cache stays sharded over sp,
+        # partials merge via pmax/psum (same loud-on-indivisible policy
+        # as the single-token path — the engine sp-aligns its caches).
+        # Takes precedence over the single-device Pallas kernel: with
+        # sp>1 the replicated full-cache kernel would defeat the
+        # sharding.  An int8 cache dequantizes its local slice only.
+        from bcg_tpu.ops.ring_attention import sp_chunk_decode_attention
+
+        mesh, axis_name = ring
+        attn_out = sp_chunk_decode_attention(
+            q, new_entry["k"], new_entry["v"], attn_mask, mesh,
+            axis_name=axis_name, scale=scale,
+            k_scale=new_entry.get("k_scale"),
+            v_scale=new_entry.get("v_scale"),
+        )
+    elif quantized and impl == "pallas" and jax.default_backend() == "tpu" \
             and spec.head_dim % 128 == 0:
         # int8 cache: stream once, dequantize in VMEM (K*group query rows
         # per program — the prefill flash kernel would pad K chunk rows
@@ -782,17 +800,6 @@ def _block_chunk(
         attn_out = chunk_decode_attention(
             q, new_entry["k"], new_entry["v"], attn_mask, scale,
             k_scale=new_entry["k_scale"], v_scale=new_entry["v_scale"],
-        )
-    elif ring is not None and not quantized:
-        # Sequence-parallel chunk decode: cache stays sharded over sp,
-        # partials merge via pmax/psum (same loud-on-indivisible policy
-        # as the single-token path — the engine sp-aligns its caches).
-        from bcg_tpu.ops.ring_attention import sp_chunk_decode_attention
-
-        mesh, axis_name = ring
-        attn_out = sp_chunk_decode_attention(
-            q, new_entry["k"], new_entry["v"], attn_mask, mesh,
-            axis_name=axis_name, scale=scale,
         )
     else:
         ck, cv = new_entry["k"], new_entry["v"]

@@ -118,11 +118,14 @@ def _ring_body(axis_name: str, sp: int, causal: bool, scale: float,
 def sp_chunk_decode_attention(
     q: jax.Array,        # [B, K, H, Dh] chunk of decode queries
     k: jax.Array,        # [B, S, Hkv, Dh] cache, S divisible by sp
+                         # (int8 layout [B, Hkv, S, Dh] with k_scale/v_scale)
     v: jax.Array,        # [B, S, Hkv, Dh]
     mask: jax.Array,     # [B, K, S] bool attendable slots per query
     mesh: Mesh,
     axis_name: str = "sp",
     scale: Optional[float] = None,
+    k_scale: Optional[jax.Array] = None,  # [B, Hkv, S] f32 (int8 cache)
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Chunk-decode attention over a sequence-sharded KV cache.
 
@@ -135,16 +138,20 @@ def sp_chunk_decode_attention(
     so slicing the cache across chips is the scaling lever single-chip
     kernels cannot reach.  Exact, not approximate.  Serves both the
     plain single-token loop (K=1 via :func:`sp_decode_attention`) and
-    the forced-chain fast-forward loop's [B, K] chunks.  bf16 cache
-    layout ([B, S, Hkv, Dh]); a quantized cache dequantizes before this
-    op.
+    the forced-chain fast-forward loop's [B, K] chunks.
+
+    With ``k_scale``/``v_scale`` the cache is int8 in its storage layout
+    [B, Hkv, S, Dh] (scales [B, Hkv, S]); each device dequantizes only
+    its LOCAL S/sp slice inside the shard_map — sp× less dequant work
+    and traffic than the replicated full-cache fallback.
 
     Composed meshes shard batch over ``dp`` and whole GQA groups over
     ``tp`` when the dims divide (same policy as :func:`ring_attention`).
     """
+    quantized = k_scale is not None
     B, K, H, Dh = q.shape
-    S = k.shape[1]
-    Hkv = k.shape[2]
+    S = k.shape[2] if quantized else k.shape[1]
+    Hkv = k.shape[1] if quantized else k.shape[2]
     sp = mesh.shape[axis_name]
     if S % sp:
         raise ValueError(f"cache length {S} not divisible by sp={sp}")
@@ -163,13 +170,29 @@ def sp_chunk_decode_attention(
         else None
     )
 
-    def body(q_blk, k_blk, v_blk, mask_blk):
+    def body(q_blk, k_blk, v_blk, mask_blk, *scales):
         b = q_blk.shape[0]
+        if quantized:
+            # Dequantize the LOCAL slice, KEEPING the int8 storage
+            # layout [b, hkv, s, Dh] — layout-native einsum subscripts
+            # below let XLA fuse the dequant into the dots instead of
+            # materializing a transposed bf16 copy of the slice every
+            # decode step (the transpose is the materialization point,
+            # see _dequant_slice).
+            from bcg_tpu.ops.decode_attention import dequantize_kv
+
+            ks_blk, vs_blk = scales
+            k_loc = dequantize_kv(k_blk, ks_blk).astype(q_blk.dtype)
+            v_loc = dequantize_kv(v_blk, vs_blk).astype(q_blk.dtype)
+            kv_sub = "bhsd"
+        else:
+            k_loc, v_loc = k_blk, v_blk
+            kv_sub = "bshd"
         qg = q_blk.reshape(b, K, -1, group, Dh)       # [b, K, hkv, g, Dh]
         # Stats layout [b, K, hkv, g(, ...)] throughout — K stays in
         # position 1 on every side, so no transposes in the merge.
         logits = jnp.einsum(
-            "bkhgd,bshd->bkhgs", qg, k_blk,
+            f"bkhgd,{kv_sub}->bkhgs", qg, k_loc,
             preferred_element_type=jnp.float32,
         ) * scale
         logits = jnp.where(
@@ -181,7 +204,7 @@ def sp_chunk_decode_attention(
         p = jnp.where(jnp.isfinite(logits), p, 0.0)
         l_loc = jnp.sum(p, axis=-1)                   # [b, K, hkv, g]
         acc_loc = jnp.einsum(
-            "bkhgs,bshd->bkhgd", p.astype(v_blk.dtype), v_blk,
+            f"bkhgs,{kv_sub}->bkhgd", p.astype(v_loc.dtype), v_loc,
             preferred_element_type=jnp.float32,
         )
         # Merge partials across the cache slices: global running max,
@@ -193,18 +216,25 @@ def sp_chunk_decode_attention(
         out = acc / jnp.maximum(l[..., None], 1e-30)
         return out.reshape(b, K, -1, Dh).astype(q_blk.dtype)
 
+    if quantized:
+        kv_spec = P(dp_ax, tp_ax, axis_name, None)   # [B, Hkv, S, Dh]
+        extra_in = (P(dp_ax, tp_ax, axis_name),) * 2  # scales [B, Hkv, S]
+        extra_args = (k_scale, v_scale)
+    else:
+        kv_spec = P(dp_ax, axis_name, tp_ax, None)   # [B, S, Hkv, Dh]
+        extra_in = ()
+        extra_args = ()
     f = jax.shard_map(
         body,
         mesh=mesh,
         in_specs=(
             P(dp_ax, None, tp_ax, None),       # q [B, K, H, Dh]
-            P(dp_ax, axis_name, tp_ax, None),  # k [B, S, Hkv, Dh]
-            P(dp_ax, axis_name, tp_ax, None),  # v
+            kv_spec, kv_spec,
             P(dp_ax, None, axis_name),         # mask [B, K, S]
-        ),
+        ) + extra_in,
         out_specs=P(dp_ax, None, tp_ax, None),
     )
-    return f(q, k, v, mask)
+    return f(q, k, v, mask, *extra_args)
 
 
 def sp_decode_attention(
@@ -215,12 +245,14 @@ def sp_decode_attention(
     mesh: Mesh,
     axis_name: str = "sp",
     scale: Optional[float] = None,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Single-token decode attention over a sequence-sharded KV cache
     (the K=1 case of :func:`sp_chunk_decode_attention`)."""
     return sp_chunk_decode_attention(
         q[:, None], k, v, mask[:, None, :], mesh,
-        axis_name=axis_name, scale=scale,
+        axis_name=axis_name, scale=scale, k_scale=k_scale, v_scale=v_scale,
     )[:, 0]
 
 

@@ -586,6 +586,22 @@ class TestEngineUnderMesh:
         )
         eng.shutdown()
 
+    @pytest.mark.parametrize("ff", [False, True])
+    def test_sequence_parallel_int8_kv_decode(self, ff):
+        """int8 KV cache under sp=2: the decode loops shard the
+        quantized cache and dequantize per-slice — no bypass."""
+        eng = self._engine(sequence_parallel_size=2, prefix_caching=False,
+                           kv_cache_dtype="int8", decode_fast_forward=ff)
+        out = eng.batch_generate_json(
+            [("You vote.", "Stop or continue?", VOTE_SCHEMA)],
+            temperature=0.0, max_tokens=64,
+        )
+        assert eng._decode_ring_active
+        assert eng.sp_bypasses == 0
+        assert "error" not in out[0], out[0]
+        assert out[0]["decision"] in ("stop", "continue")
+        eng.shutdown()
+
     def test_sp_bypass_counted_when_chunking_wins(self):
         """prefill_chunk and sequence_parallel_size are both long-context
         knobs; chunking wins (prefill_chunk_at is not ring-capable) and

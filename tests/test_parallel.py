@@ -191,6 +191,35 @@ class TestSpDecodeAttention:
                 jnp.zeros((1, 12, 2, 8)), jnp.ones((1, 12), bool), mesh,
             )
 
+    def test_int8_cache_local_dequant_matches(self):
+        """The int8 storage layout [B, Hkv, S, Dh]: each shard
+        dequantizes only its local slice; result must equal full-cache
+        attention over the fully-dequantized cache."""
+        from bcg_tpu.models.transformer import _xla_attention
+        from bcg_tpu.ops.decode_attention import dequantize_kv, quantize_kv
+        from bcg_tpu.ops.ring_attention import sp_decode_attention
+
+        mesh = build_mesh(dp=1, tp=1, sp=4)
+        B, S, H, Hkv, Dh = 2, 32, 4, 2, 16
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(11), 3)
+        q = jax.random.normal(kq, (B, H, Dh), jnp.float32)
+        k_full = jax.random.normal(kk, (B, S, Hkv, Dh), jnp.float32)
+        v_full = jax.random.normal(kv, (B, S, Hkv, Dh), jnp.float32)
+        # Engine storage layout: [B, Hkv, S, Dh] + scales [B, Hkv, S].
+        kq8, ks = quantize_kv(k_full.transpose(0, 2, 1, 3))
+        vq8, vs = quantize_kv(v_full.transpose(0, 2, 1, 3))
+        mask = jnp.arange(S)[None, :] < jnp.array([32, 11])[:, None]
+        scale = 1.0 / np.sqrt(Dh)
+
+        out = sp_decode_attention(q, kq8, vq8, mask, mesh, scale=scale,
+                                  k_scale=ks, v_scale=vs)
+        k_deq = dequantize_kv(kq8, ks).transpose(0, 2, 1, 3)
+        v_deq = dequantize_kv(vq8, vs).transpose(0, 2, 1, 3)
+        ref = _xla_attention(q[:, None], k_deq, v_deq,
+                             mask[:, None, :], scale)[:, 0]
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
     @pytest.mark.parametrize("sp", [2, 4])
     def test_chunk_queries_match_full_attention(self, sp):
         """K>1 chunks (the fast-forward loop's shape): per-query masks
