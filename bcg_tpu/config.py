@@ -207,7 +207,12 @@ class GameConfig:
 
 @dataclass(frozen=True)
 class MetricsConfig:
-    """Result sinks (reference METRICS_CONFIG, config.py:70-77)."""
+    """Result sinks (reference METRICS_CONFIG, config.py:70-77).
+
+    The ``track_*`` flags gate their metric families in the payload
+    (runtime/metrics.py) — the reference defines the same flags but
+    never reads them; here off = the family's fields are nulled.
+    """
 
     track_convergence: bool = True
     track_byzantine_impact: bool = True

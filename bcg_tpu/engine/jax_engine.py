@@ -215,6 +215,17 @@ class JaxEngine(InferenceEngine):
                 f"quantization={config.quantization!r}: expected None, "
                 "'int8' or 'int4'"
             )
+        # The activation/weight compute dtype is bf16 by design on TPU
+        # (MXU-native; f32 would halve matmul throughput and double HBM
+        # traffic; lower precision goes through `quantization`).  The
+        # knob exists for serving-config interface parity — reject
+        # rather than silently ignore other values.
+        if getattr(config, "dtype", "bfloat16") not in ("bfloat16", "bf16"):
+            raise ValueError(
+                f"dtype={config.dtype!r}: TPU serving computes in "
+                "bfloat16; use quantization='int8'/'int4' for lower-"
+                "precision weights"
+            )
         self.kv_quantized = config.kv_cache_dtype == "int8"
         # Decode impl: the bf16 einsum path is a well-fused GEMV and the
         # hardware-validated default; the Pallas cache-streaming kernel
@@ -268,7 +279,17 @@ class JaxEngine(InferenceEngine):
         # (BENCH_NOTES rounds 1-2).  Allocating the cache pre-aligned
         # makes that pad a no-op; the extra masked slots cost only their
         # streaming bandwidth (<= BLOCK_S-1 slots).
-        if self.decode_attention_impl == "pallas":
+        # Sequence-parallel decode shards the cache over sp, so the
+        # allocated length must divide by sp — the length-bucket ladders
+        # are all even but S = bucket + max_new + 1 is odd, which would
+        # otherwise quietly disqualify EVERY engine cache from the ring
+        # decode path (caught by review, round 4).  Under sp>1 the ring
+        # path preempts the Pallas decode kernels entirely, so ALIGN_S
+        # would only waste cache HBM + per-step streaming there.
+        _sp = mesh.shape.get("sp", 1) if mesh is not None else 1
+        if _sp > 1:
+            self._kv_align = _sp
+        elif self.decode_attention_impl == "pallas":
             from bcg_tpu.ops.decode_attention import ALIGN_S
 
             # ALIGN_S (1024) also unlocks the kernels' large-block path
@@ -277,16 +298,6 @@ class JaxEngine(InferenceEngine):
             self._kv_align = ALIGN_S
         else:
             self._kv_align = 1
-        # Sequence-parallel decode shards the cache over sp, so the
-        # allocated length must divide by sp — the length-bucket ladders
-        # are all even but S = bucket + max_new + 1 is odd, which would
-        # otherwise quietly disqualify EVERY engine cache from the ring
-        # decode path (caught by review, round 4).
-        _sp = mesh.shape.get("sp", 1) if mesh is not None else 1
-        if _sp > 1:
-            import math as _math
-
-            self._kv_align = _math.lcm(self._kv_align, _sp)
         # Bytes per (position, layer) cache slot — the unit shared by the
         # perf accounting, the KV budget guard, and the provisioner.
         self._kv_slot_bytes = self.spec.num_kv_heads * self.spec.head_dim * 2

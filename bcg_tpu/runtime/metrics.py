@@ -93,10 +93,17 @@ def build_metrics_payload(
     profile: Optional[Dict] = None,
     timestamp: Optional[str] = None,
 ) -> Dict:
-    """Flat ~38-field metrics dict (reference main.py:852-903)."""
+    """Flat ~38-field metrics dict (reference main.py:852-903).
+
+    The ``metrics.track_*`` flags gate their metric families (the
+    reference defines the same flags in METRICS_CONFIG, config.py:71-73,
+    but never reads them — here a disabled family's fields are nulled so
+    the CSV header stays fixed while the knob actually does something).
+    """
     convergence_rate = stats.get("convergence_rate")
     profile = profile or {}
-    return {
+    mcfg = config.metrics
+    payload = {
         "run_number": run_number,
         "timestamp": timestamp or datetime.now().strftime("%Y%m%d_%H%M%S"),
         # Core outcome
@@ -146,6 +153,19 @@ def build_metrics_payload(
         "rounds_per_sec": profile.get("rounds_per_sec"),
         "decisions_per_sec": profile.get("decisions_per_sec"),
     }
+    _Q1 = ("convergence_speed", "consensus_is_median", "consensus_is_extreme",
+           "consensus_is_initial", "trajectory_stability",
+           "final_convergence_metric", "convergence_rate_percent")
+    _Q2 = ("centrality", "inclusivity", "stability_rounds", "agreement_rate",
+           "consensus_quality_score", "avg_distance_from_consensus",
+           "byzantine_infiltration")
+    if not getattr(mcfg, "track_convergence", True):
+        payload.update(dict.fromkeys(_Q1))
+    if not getattr(mcfg, "track_byzantine_impact", True):
+        payload.update(dict.fromkeys(_Q2))
+    if not getattr(mcfg, "track_communication", True):
+        payload["a2a_message_count"] = None
+    return payload
 
 
 def save_json_results(

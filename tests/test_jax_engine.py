@@ -381,6 +381,23 @@ class TestChunkedPrefill:
                 prefill_chunk=-64,
             ))
 
+    def test_non_bf16_dtype_rejected(self):
+        """EngineConfig.dtype exists for serving-config interface parity
+        but TPU serving computes in bf16 — other values must be a loud
+        error, not a silently ignored knob."""
+        import dataclasses
+
+        import pytest
+
+        from bcg_tpu.config import EngineConfig
+        from bcg_tpu.engine.jax_engine import JaxEngine
+
+        with pytest.raises(ValueError, match="bfloat16"):
+            JaxEngine(dataclasses.replace(
+                EngineConfig(backend="jax", model_name="bcg-tpu/tiny-test"),
+                dtype="float32",
+            ))
+
 
 def test_fine_suffix_ladder_config(monkeypatch):
     """EngineConfig.fine_suffix_buckets selects the 1536/3072-rung

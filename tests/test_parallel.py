@@ -191,15 +191,19 @@ class TestSpDecodeAttention:
                 jnp.zeros((1, 12, 2, 8)), jnp.ones((1, 12), bool), mesh,
             )
 
-    def test_int8_cache_local_dequant_matches(self):
+    @pytest.mark.parametrize("dims", [(1, 1, 4), (2, 2, 2)])
+    def test_int8_cache_local_dequant_matches(self, dims):
         """The int8 storage layout [B, Hkv, S, Dh]: each shard
         dequantizes only its local slice; result must equal full-cache
-        attention over the fully-dequantized cache."""
+        attention over the fully-dequantized cache.  Parametrized over a
+        composed dp x tp x sp mesh so the quantized kv/scales shard
+        specs execute with dp/tp actually bound."""
         from bcg_tpu.models.transformer import _xla_attention
         from bcg_tpu.ops.decode_attention import dequantize_kv, quantize_kv
         from bcg_tpu.ops.ring_attention import sp_decode_attention
 
-        mesh = build_mesh(dp=1, tp=1, sp=4)
+        dp, tp, sp = dims
+        mesh = build_mesh(dp=dp, tp=tp, sp=sp)
         B, S, H, Hkv, Dh = 2, 32, 4, 2, 16
         kq, kk, kv = jax.random.split(jax.random.PRNGKey(11), 3)
         q = jax.random.normal(kq, (B, H, Dh), jnp.float32)

@@ -149,6 +149,33 @@ class TestSinks:
         log_text = log_path.read_text()
         assert "Round 1" in log_text and "SIMULATION COMPLETE" in log_text
 
+    def test_track_flags_gate_metric_families(self, tmp_path):
+        """METRICS_CONFIG's track_* flags (dead in the reference,
+        config.py:71-73) actually gate their families here: off = the
+        family's fields are nulled, CSV header unchanged."""
+        import dataclasses
+
+        cfg = make_config(tmp_path=tmp_path, nh=3, max_rounds=6)
+        cfg = dataclasses.replace(
+            cfg,
+            metrics=dataclasses.replace(
+                cfg.metrics, track_convergence=False,
+                track_byzantine_impact=False, track_communication=False,
+            ),
+        )
+        sim = BCGSimulation(config=cfg)
+        sim.run()
+        sim.close()
+        blob = json.loads((tmp_path / "json" / "run_001.json").read_text())
+        m = blob["metrics"]
+        assert m["convergence_speed"] is None          # Q1 gated
+        assert m["consensus_quality_score"] is None    # Q2 gated
+        assert m["a2a_message_count"] is None          # comm gated
+        assert m["consensus_reached"] is not None      # core outcome stays
+        with open(tmp_path / "metrics" / "run_001.csv") as f:
+            rows = list(csv.DictReader(f))
+        assert "convergence_speed" in rows[0]          # fixed header
+
     def test_run_numbering_increments(self, tmp_path):
         for expected in ("001", "002"):
             cfg = make_config(tmp_path=tmp_path, nh=3, max_rounds=6)
