@@ -22,6 +22,15 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 
+def _pvary(x, axis_names):
+    """Mark a constant as varying over mesh axes (carry-type match for
+    loop accumulators).  jax.lax.pvary is deprecated in favor of pcast;
+    support both so the op tracks the installed JAX."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axis_names, to="varying")
+    return jax.lax.pvary(x, axis_names)
+
+
 def _block_attend(q, k, v, q_pos, k_pos, scale, causal, kv_valid=None):
     """One q-block x kv-block partial attention.
 
@@ -105,9 +114,9 @@ def _ring_body(axis_name: str, sp: int, causal: bool, scale: float,
     # axis_index) — hence pvary over every axis the inputs are sharded
     # on (sp always; plus dp/tp on a composed mesh).
     vary = vary_axes if vary_axes is not None else (axis_name,)
-    m0 = jax.lax.pvary(jnp.full((B, Hkv, group, Tq), -jnp.inf, jnp.float32), vary)
-    l0 = jax.lax.pvary(jnp.zeros((B, Hkv, group, Tq), jnp.float32), vary)
-    acc0 = jax.lax.pvary(jnp.zeros((B, Tq, Hkv, group, Dh), jnp.float32), vary)
+    m0 = _pvary(jnp.full((B, Hkv, group, Tq), -jnp.inf, jnp.float32), vary)
+    l0 = _pvary(jnp.zeros((B, Hkv, group, Tq), jnp.float32), vary)
+    acc0 = _pvary(jnp.zeros((B, Tq, Hkv, group, Dh), jnp.float32), vary)
     carry0 = (m0, l0, acc0, k0, v0) + ((kv_valid0,) if masked else ())
     out_carry = jax.lax.fori_loop(0, sp, step, carry0)
     m, l, acc = out_carry[0], out_carry[1], out_carry[2]

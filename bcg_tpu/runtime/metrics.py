@@ -17,6 +17,29 @@ from dataclasses import asdict
 from datetime import datetime
 from typing import Dict, Optional
 
+# Q1/Q2 metric families — single source of truth for the CSV column
+# sections below AND the track_* gating in build_metrics_payload (a
+# field added to one list but not the other would silently escape its
+# gate, the exact dead-flag failure the gating exists to fix).
+Q1_FIELDS = (
+    "convergence_speed",
+    "consensus_is_median",
+    "consensus_is_extreme",
+    "consensus_is_initial",
+    "trajectory_stability",
+    "final_convergence_metric",
+    "convergence_rate_percent",
+)
+Q2_FIELDS = (
+    "centrality",
+    "inclusivity",
+    "stability_rounds",
+    "agreement_rate",
+    "consensus_quality_score",
+    "avg_distance_from_consensus",
+    "byzantine_infiltration",
+)
+
 # Fixed CSV column order (reference main.py:911-951).
 CSV_FIELDNAMES = [
     "run_number",
@@ -28,22 +51,8 @@ CSV_FIELDNAMES = [
     "total_rounds",
     "max_rounds",
     "consensus_value",
-    # Q1
-    "convergence_speed",
-    "consensus_is_median",
-    "consensus_is_extreme",
-    "consensus_is_initial",
-    "trajectory_stability",
-    "final_convergence_metric",
-    "convergence_rate_percent",
-    # Q2
-    "centrality",
-    "inclusivity",
-    "stability_rounds",
-    "agreement_rate",
-    "consensus_quality_score",
-    "avg_distance_from_consensus",
-    "byzantine_infiltration",
+    *Q1_FIELDS,
+    *Q2_FIELDS,
     # Initial state
     "honest_initial_mean",
     "honest_initial_median",
@@ -153,17 +162,11 @@ def build_metrics_payload(
         "rounds_per_sec": profile.get("rounds_per_sec"),
         "decisions_per_sec": profile.get("decisions_per_sec"),
     }
-    _Q1 = ("convergence_speed", "consensus_is_median", "consensus_is_extreme",
-           "consensus_is_initial", "trajectory_stability",
-           "final_convergence_metric", "convergence_rate_percent")
-    _Q2 = ("centrality", "inclusivity", "stability_rounds", "agreement_rate",
-           "consensus_quality_score", "avg_distance_from_consensus",
-           "byzantine_infiltration")
-    if not getattr(mcfg, "track_convergence", True):
-        payload.update(dict.fromkeys(_Q1))
-    if not getattr(mcfg, "track_byzantine_impact", True):
-        payload.update(dict.fromkeys(_Q2))
-    if not getattr(mcfg, "track_communication", True):
+    if not mcfg.track_convergence:
+        payload.update(dict.fromkeys(Q1_FIELDS))
+    if not mcfg.track_byzantine_impact:
+        payload.update(dict.fromkeys(Q2_FIELDS))
+    if not mcfg.track_communication:
         payload["a2a_message_count"] = None
     return payload
 

@@ -385,13 +385,6 @@ class TestChunkedPrefill:
         """EngineConfig.dtype exists for serving-config interface parity
         but TPU serving computes in bf16 — other values must be a loud
         error, not a silently ignored knob."""
-        import dataclasses
-
-        import pytest
-
-        from bcg_tpu.config import EngineConfig
-        from bcg_tpu.engine.jax_engine import JaxEngine
-
         with pytest.raises(ValueError, match="bfloat16"):
             JaxEngine(dataclasses.replace(
                 EngineConfig(backend="jax", model_name="bcg-tpu/tiny-test"),

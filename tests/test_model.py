@@ -172,6 +172,12 @@ class TestCapacityMath:
         assert self._wb("bcg-tpu/bench-14b", "int4") < self.SERVING_FIT
         # 32B cannot board one chip even at int4 -> tp>=2 territory.
         assert self._wb("bcg-tpu/bench-32b", "int4") > self.USABLE
+        # Mistral-Small-22B (the reference's 4th preset): int8 exceeds
+        # the chip, int4 boards it — same class as 14B.
+        assert self._wb("mistralai/Mistral-Small-Instruct-2409", "int8") \
+            > self.SERVING_FIT
+        assert self._wb("mistralai/Mistral-Small-Instruct-2409", "int4") \
+            < self.SERVING_FIT
 
     def test_estimates_track_modes(self):
         for name in ("bcg-tpu/bench-1b", "bcg-tpu/bench-8b"):

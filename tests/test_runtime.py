@@ -153,8 +153,6 @@ class TestSinks:
         """METRICS_CONFIG's track_* flags (dead in the reference,
         config.py:71-73) actually gate their families here: off = the
         family's fields are nulled, CSV header unchanged."""
-        import dataclasses
-
         cfg = make_config(tmp_path=tmp_path, nh=3, max_rounds=6)
         cfg = dataclasses.replace(
             cfg,
