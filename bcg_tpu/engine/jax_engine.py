@@ -608,6 +608,13 @@ class JaxEngine(InferenceEngine):
             buckets.append(buckets[-1] * 2)
         L = next((b for b in buckets if b >= max_len), max_limit)
         L = max(min(L, max_limit), max_len)
+        # Sequence-parallel prefill shards the token dim over sp: align
+        # the window up so near-cap prompts (clamped to max_limit, an
+        # arbitrary value like 8095) still divide.  The extra slots are
+        # left-pads — masked, position-free — so the model-len cap on
+        # real tokens (the [-lim:] truncation above) is unaffected.
+        if self._sp_devices > 1:
+            L += (-L) % self._sp_devices
         B = len(token_lists)
         tokens = np.full((B, L), self.tokenizer.pad_id, dtype=np.int32)
         valid = np.zeros((B, L), dtype=bool)
