@@ -1427,14 +1427,20 @@ class JaxEngine(InferenceEngine):
                     prefix_valid=jnp.asarray(prefix_valid),
                     prefix_lens=jnp.asarray(prefix_lens),
                 )
-            if self._prefill_sp is not None and L % self._sp_devices == 0:
+            if self._prefill_sp is not None:
+                # _encode_leftpad sp-aligns every prompt window, so an
+                # indivisible L here is an engine bug, not a fallback
+                # case — fail loudly rather than silently serve the
+                # replicated path (the no-silent-disengagement policy).
+                assert L % self._sp_devices == 0, (
+                    f"prompt window L={L} not sp-aligned "
+                    f"(sp={self._sp_devices}) — _encode_leftpad broke "
+                    "its alignment guarantee"
+                )
                 return self._prefill_sp(
                     self.params, tokens=jnp.asarray(tokens),
                     valid=jnp.asarray(valid), cache=cache,
                 )
-            if self._prefill_sp is not None:
-                self._note_sp_bypass(f"bucket L={L} not divisible by "
-                                     f"sp={self._sp_devices}")
             return self._prefill(
                 self.params, tokens=jnp.asarray(tokens),
                 valid=jnp.asarray(valid), cache=cache,

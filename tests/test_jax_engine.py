@@ -597,11 +597,14 @@ class TestEngineUnderMesh:
         eng.shutdown()
 
     def test_long_context_serving_via_sp(self):
-        """A ~4K-token prompt served end-to-end under sp=4: ring prefill
-        shards the long prompt's activations, decode attends the long
-        sp-sharded cache — the long-context capability claim (the
+        """An ~8K-byte-token prompt served end-to-end under sp=4: ring
+        prefill shards the long prompt's activations, decode attends the
+        long sp-sharded cache — the long-context capability claim (the
         reference TRUNCATES at this scale, SURVEY §5.7) exercised as one
-        serving call, not just op tests."""
+        serving call, not just op tests.  The prompt deliberately
+        exceeds the window limit so L clamps to max_model_len - budget
+        - 1 = 8095 — the sp-indivisible shape that once bypassed the
+        ring path (the engine now sp-aligns the window)."""
         eng = self._engine(sequence_parallel_size=4, prefix_caching=False,
                            max_model_len=8192)
         calls = []
@@ -617,10 +620,13 @@ class TestEngineUnderMesh:
         )
         assert calls, "long prompt did not take the ring prefill path"
         assert eng._decode_ring_active
+        assert eng.sp_bypasses == 0  # window clamp stayed sp-aligned
         assert "error" not in out[0], out[0]
         assert 0 <= out[0]["value"] <= 50
-        # The prompt really was long-context scale for this engine.
-        assert len(long_history) > 4000
+        # Pin the clamp scenario: the tokenized prompt must exceed every
+        # ladder bucket, or this test degrades to an already-divisible
+        # bucket and stops covering the alignment fix.
+        assert len(eng.tokenizer.encode(long_history)) > 6144
         eng.shutdown()
 
     @pytest.mark.parametrize("ff", [False, True])
