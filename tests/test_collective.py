@@ -177,6 +177,45 @@ class TestConcurrentSimulations:
         assert max(inner.calls) > 4
 
 
+class TestConcurrentGamesUnderMesh:
+    def test_two_games_share_a_tp2_engine(self):
+        """BENCH_CONCURRENCY on a pod slice: two lockstep games merge
+        their phase batches into ONE tp=2-sharded JaxEngine — cross-game
+        batching (engine/collective.py) composed with a real mesh, not a
+        stub.  (The reference runs sweeps as sequential CLI invocations
+        against its TP vLLM engine; here merged batches share each
+        weight stream.)"""
+        from bcg_tpu.api import run_simulation
+        from bcg_tpu.config import EngineConfig
+        from bcg_tpu.engine.interface import create_engine
+
+        eng = create_engine(EngineConfig(
+            backend="jax", model_name="bcg-tpu/tiny-test",
+            max_model_len=1024, tensor_parallel_size=2,
+        ))
+        try:
+
+            def make(r):
+                def go(engine):
+                    return run_simulation(
+                        n_agents=2, byzantine_count=1, max_rounds=2,
+                        backend="jax", seed=r, engine=engine,
+                    )
+                return go
+
+            outs = run_concurrent_simulations(
+                eng, [make(r) for r in range(2)], 2
+            )
+            for o in outs:
+                if isinstance(o, BaseException):
+                    raise o
+            assert eng.mesh is not None and eng.mesh.shape["tp"] == 2
+            for o in outs:
+                assert o["metrics"]["total_rounds"] >= 1
+        finally:
+            eng.shutdown()
+
+
 class FlakyStub(StubEngine):
     """Returns an invalid decision for some rows on their first attempt,
     driving the orchestrator's retry ladder so concurrent games
