@@ -327,13 +327,15 @@ class TestCLI:
 
         args = build_parser().parse_args(
             ["--quantization", "int8", "--kv-cache-dtype", "int8",
-             "--no-prefix-caching", "--tensor-parallel", "2"]
+             "--no-prefix-caching", "--tensor-parallel", "2",
+             "--sequence-parallel", "2"]
         )
         cfg = config_from_args(args)
         assert cfg.engine.quantization == "int8"
         assert cfg.engine.kv_cache_dtype == "int8"
         assert cfg.engine.prefix_caching is False
         assert cfg.engine.tensor_parallel_size == 2
+        assert cfg.engine.sequence_parallel_size == 2
 
     def test_cli_no_save(self, tmp_path, capsys):
         from bcg_tpu.cli import main
