@@ -140,9 +140,26 @@ run_step() {
   step_spec "$name" || { log "BUG: no spec for step $name"; touch "$OUT/$name.skip"; return 0; }
   # Never START a step that could still be running at the deadline —
   # a leftover bench process would contend with the driver's own run.
+  # Exception: bench_default gets a deadline-CAPPED attempt when >=10
+  # min remain — even a partial run populates the persistent compile
+  # cache with exactly the programs the driver's round-end bench needs
+  # (observed: a killed 25-min attempt banked 71 cache entries), so a
+  # late healthy window is spent warming rather than wasted.  The capped
+  # run shares the normal execute/validate/triage path: only its
+  # deadline KILL is non-evidence (no TMO count, no .skip) — a fast
+  # deterministic crash inside the window is real evidence and still
+  # .fails-counts.
+  local capped=0
   if [ $(( $(date -u +%s) + TMOS )) -gt "${DEADLINE:-9999999999}" ]; then
-    log "DEFER $name: its timeout window crosses the watcher deadline"
-    return 2
+    local room=$(( ${DEADLINE:-9999999999} - $(date -u +%s) - 90 ))
+    if [ "$name" = bench_default ] && [ "$room" -ge 600 ]; then
+      log "WARM $name: deadline-capped ${room}s attempt (compile-cache prewarm)"
+      TMOS=$room
+      capped=1
+    else
+      log "DEFER $name: its timeout window crosses the watcher deadline"
+      return 2
+    fi
   fi
   log "START $name"
   timeout "$TMOS" "${CMD[@]}" > "$OUT/$name.json" 2> "$OUT/$name.log"
@@ -169,6 +186,12 @@ run_step() {
   # a healthy probe means the step itself is too slow — bound those so
   # one deterministically-slow step can't wedge the steps behind it.
   if [ $rc -eq 124 ]; then
+    if [ "$capped" = 1 ]; then
+      # Deadline kill of a warm attempt: not evidence about the step —
+      # the compile cache it banked is the point.
+      log "WARM $name deadline kill (no stamp; cache retained)"
+      return 2
+    fi
     if ! probe; then
       log "TIMEOUT $name during outage (probe fails) — back to probing"
       return 2
