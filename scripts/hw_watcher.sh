@@ -162,7 +162,10 @@ run_step() {
     fi
   fi
   log "START $name"
-  timeout "$TMOS" "${CMD[@]}" > "$OUT/$name.json" 2> "$OUT/$name.log"
+  # -k 30: a bench stuck in an unkillable remote-compile RPC must not
+  # outlive its window into the driver's bench slot (SIGKILL backstop
+  # fits inside the warm path's 90 s deadline margin).
+  timeout -k 30 "$TMOS" "${CMD[@]}" > "$OUT/$name.json" 2> "$OUT/$name.log"
   local rc=$?
   if [ $rc -eq 0 ] && grep -q "$PAT" "$OUT/$name.json" \
       && ! grep -qi '"error"' "$OUT/$name.json"; then
