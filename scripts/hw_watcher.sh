@@ -188,7 +188,9 @@ run_step() {
   # a dead chip means an outage timeout (retry forever, like UNAVAIL);
   # a healthy probe means the step itself is too slow — bound those so
   # one deterministically-slow step can't wedge the steps behind it.
-  if [ $rc -eq 124 ]; then
+  # 124 = SIGTERM kill; 137 = the -k SIGKILL backstop (process ignored
+  # TERM) — both are "the window ended", not evidence about the step.
+  if [ $rc -eq 124 ] || [ $rc -eq 137 ]; then
     if [ "$capped" = 1 ]; then
       # Deadline kill of a warm attempt: not evidence about the step —
       # the compile cache it banked is the point.

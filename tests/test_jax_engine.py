@@ -482,6 +482,15 @@ class TestEngineUnderMesh:
         )
         return create_engine(cfg)
 
+    @staticmethod
+    def _spy_prefill_sp(eng):
+        """Wrap eng._prefill_sp with a call counter (dispatch reads the
+        attribute per call, so the wrapper is seen)."""
+        calls = []
+        orig = eng._prefill_sp
+        eng._prefill_sp = lambda *a, **kw: (calls.append(1) or orig(*a, **kw))
+        return calls
+
     def test_params_actually_sharded_tp2(self):
         eng = self._engine(tensor_parallel_size=2)
         assert eng.mesh is not None and eng.mesh.shape["tp"] == 2
@@ -517,6 +526,30 @@ class TestEngineUnderMesh:
         assert 0 <= out_tp[0]["value"] <= 50
         assert 0 <= out_tp[2]["value"] <= 50
         eng_tp.shutdown()
+
+    def test_quant_scan_tp_sp_full_composition(self):
+        """The widest serving composition in one engine: int4 weights x
+        scan-over-layers x tp=2 x sp=2 — the 32B-preset pod-slice layout
+        WITH long context (ring prefill + sp-sharded decode inside the
+        lax.scan layer loop).  Every triple is covered elsewhere; the
+        quadruple is what a 32B long-context deployment actually boots."""
+        eng = self._engine(
+            tensor_parallel_size=2, sequence_parallel_size=2,
+            quantization="int4", scan_layers=True, prefix_caching=False,
+        )
+        assert eng.mesh.shape["tp"] == 2 and eng.mesh.shape["sp"] == 2
+        calls = self._spy_prefill_sp(eng)
+        out = eng.batch_generate_json(
+            [("You are honest.", "Pick a value.", DECISION_SCHEMA),
+             ("You vote.", "Stop or continue?", VOTE_SCHEMA)],
+            temperature=0.0, max_tokens=96,
+        )
+        assert calls and eng._decode_ring_active and eng.sp_bypasses == 0
+        for o in out:
+            assert "error" not in o, o
+        assert 0 <= out[0]["value"] <= 50
+        assert out[1]["decision"] in ("stop", "continue")
+        eng.shutdown()
 
     @pytest.mark.parametrize("quant", ["int8", "int4"])
     def test_quantized_scan_tp2_end_to_end(self, quant):
@@ -555,9 +588,7 @@ class TestEngineUnderMesh:
         Long-context SP is an ENGINE capability, not just an op."""
         eng = self._engine(sequence_parallel_size=2, prefix_caching=False)
         assert eng._prefill_sp is not None and eng._sp_devices == 2
-        calls = []
-        orig = eng._prefill_sp
-        eng._prefill_sp = lambda *a, **kw: (calls.append(1) or orig(*a, **kw))
+        calls = self._spy_prefill_sp(eng)
         out = eng.batch_generate_json(
             [("You are honest.", "Pick a value.", DECISION_SCHEMA),
              ("You vote.", "Stop or continue?", VOTE_SCHEMA)],
@@ -607,9 +638,7 @@ class TestEngineUnderMesh:
         ring path (the engine now sp-aligns the window)."""
         eng = self._engine(sequence_parallel_size=4, prefix_caching=False,
                            max_model_len=8192)
-        calls = []
-        orig = eng._prefill_sp
-        eng._prefill_sp = lambda *a, **kw: (calls.append(1) or orig(*a, **kw))
+        calls = self._spy_prefill_sp(eng)
         long_history = " ".join(
             f"Round {i}: agent_{i % 10} proposed {i % 50}." for i in range(260)
         )
