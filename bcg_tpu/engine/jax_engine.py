@@ -509,12 +509,12 @@ class JaxEngine(InferenceEngine):
         )
         # Sequence-parallel full-prompt prefill (ring attention over the
         # mesh's `sp` axis, transformer.prefill_sp): selected per call by
-        # _prefill_possibly_chunked when the call is a single-pass full
-        # prefill whose bucket divides by sp.  Chunked prefill and the
-        # cached-prefix path win over it (neither is ring-capable);
-        # bypasses are counted in engine.sp_bypasses.  Long-context
-        # counterpart to the reference's context COMPRESSION (SURVEY.md
-        # §5.7) — prefill activations shard O(L/sp) per chip.
+        # _prefill_possibly_chunked for single-pass full prefills.
+        # Chunked prefill shards through its own ring path (the chunk
+        # jit's ring=); only the cached-prefix suffix path bypasses sp,
+        # counted in engine.sp_bypasses.  Long-context counterpart to
+        # the reference's context COMPRESSION (SURVEY.md §5.7) — prefill
+        # activations shard O(L/sp) per chip.
         self._prefill_sp = None
         self._sp_devices = mesh.shape.get("sp", 1) if mesh is not None else 1
         if self._sp_devices > 1:
@@ -526,7 +526,13 @@ class JaxEngine(InferenceEngine):
                 donate_argnames=("cache",),
             )
         self._prefill_chunk_at = jax.jit(
-            partial(prefill_chunk_at, spec=self.spec, impl=self.attention_impl),
+            partial(
+                prefill_chunk_at, spec=self.spec, impl=self.attention_impl,
+                # Chunked prefill is the LARGE size class's default; under
+                # sp it must shard, not bypass (transformer.prefill_chunk_at
+                # ring branch — the chunk attends the whole sharded cache).
+                ring=((mesh, "sp") if self._sp_devices > 1 else None),
+            ),
             donate_argnames=("cache",),
         )
         self._decode_loops: Dict[Tuple, Any] = {}
@@ -1445,14 +1451,8 @@ class JaxEngine(InferenceEngine):
                 self.params, tokens=jnp.asarray(tokens),
                 valid=jnp.asarray(valid), cache=cache,
             )
-        if self._prefill_sp is not None:
-            # Both prefill_chunk and sequence_parallel_size are set:
-            # chunking wins (prefill_chunk_at is not ring-capable), so
-            # the ring path never sees exactly the long prompts it
-            # targets — count it rather than disengage silently.
-            self._note_sp_bypass(
-                f"chunked prefill (chunk={C}) took the L={L} call"
-            )
+        # Chunked prefill under sp is ring-capable (the chunk jit carries
+        # ring=): no bypass to note here.
         # Single-shape chunk stepping (transformer.prefill_chunk_at): the
         # history window is a FIXED [B, P + L - Ct] mask and the write
         # slot a traced scalar, so every full-width chunk shares ONE

@@ -277,6 +277,13 @@ def _cache_attention(q, entry: Dict, mask, scale, impl: str):
     return _xla_attention(q, k, v, mask[:, None, :], scale)
 
 
+def _cache_len(cache) -> int:
+    """Allocated cache length S, across layouts: bf16 k is
+    [(Lyr,) B, S, Hkv, Dh]; quantized storage is [(Lyr,) B, Hkv, S, Dh]."""
+    entry = cache if isinstance(cache, dict) else cache[0]
+    return entry["k"].shape[-2 if "k_scale" in entry else -3]
+
+
 def _dequant_slice(entry: Dict, name: str, upto: int, dtype) -> jax.Array:
     """Cache slots [0, upto) of k or v as [B, upto, Hkv, Dh], dequantized
     (and transposed out of the [B, Hkv, S, Dh] storage) if stored int8."""
@@ -640,6 +647,8 @@ def prefill_chunk_at(
                                # valid chunk token
     write_pos: jax.Array,      # scalar int32: cache slot of chunk col 0
     impl: str = "xla",
+    ring=None,                 # static (Mesh, axis_name): sp-sharded-cache
+                               # chunked prefill (sp_chunk_decode_attention)
 ) -> Tuple[jax.Array, Dict]:
     """One chunk of a chunked prefill with a DYNAMIC write position.
 
@@ -649,6 +658,12 @@ def prefill_chunk_at(
     a traced scalar, so EVERY chunk of every offset shares one compiled
     program per (B, C, H).  On a remote-compile environment that turns
     an 8B boot's L/C prefill compiles into one.
+
+    With ``ring`` the chunk instead attends the WHOLE sp-sharded cache
+    (its own slots written first) through the decode loops' chunk path —
+    this matters most for the LARGE size class, whose default config is
+    exactly chunked prefill, so an 8B+ long-context sp deployment would
+    otherwise never engage sequence parallelism at prefill.
     """
     B, C = tokens.shape
     positions = pos_offset[:, None] + jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1
@@ -659,13 +674,31 @@ def prefill_chunk_at(
     causal = jnp.tril(jnp.ones((C, C), bool))
     chunk_mask = causal[None] & valid[:, None, :] & valid[:, :, None]   # [B, C, C]
     hist_mask = hist_valid[:, None, :] & valid[:, :, None]              # [B, C, H]
-    attn_mask = jnp.concatenate([hist_mask, chunk_mask], axis=2)
 
     x = params["embed"][tokens]
-    x, new_cache = _run_layers(
-        params, spec, x, cos, sin, write_pos, cache, attn_mask, impl,
-        hist_len=H,
-    )
+    if ring is not None:
+        # [B, C, S] whole-cache mask: history slots in [0, H) (hist_valid
+        # is already False at and past the chunk's write region), the
+        # chunk's own causally-visible slots at [write_pos, write_pos+C).
+        # _block_chunk writes the chunk KV before attending, so the key
+        # set matches the hist-concat form exactly; only the (sharded)
+        # storage it reads from differs.
+        S = _cache_len(cache)
+        full_mask = jnp.zeros((B, C, S), bool)
+        full_mask = full_mask.at[:, :, :H].set(hist_mask)
+        full_mask = jax.lax.dynamic_update_slice(
+            full_mask, chunk_mask, (0, 0, write_pos)
+        )
+        x, new_cache = _run_layers(
+            params, spec, x, cos, sin, write_pos, cache, full_mask, impl,
+            chunk=True, ring=ring,
+        )
+    else:
+        attn_mask = jnp.concatenate([hist_mask, chunk_mask], axis=2)
+        x, new_cache = _run_layers(
+            params, spec, x, cos, sin, write_pos, cache, attn_mask, impl,
+            hist_len=H,
+        )
     logits = _logits(params, spec, x[:, -1:, :])[:, 0, :]
     return logits, new_cache
 

@@ -674,21 +674,46 @@ class TestEngineUnderMesh:
         assert out[0]["decision"] in ("stop", "continue")
         eng.shutdown()
 
-    def test_sp_bypass_counted_when_chunking_wins(self):
-        """prefill_chunk and sequence_parallel_size are both long-context
-        knobs; chunking wins (prefill_chunk_at is not ring-capable) and
-        that disengagement must be counted, not silent."""
-        import warnings as _w
-
+    def test_chunked_prefill_runs_sp_sharded(self):
+        """prefill_chunk and sequence_parallel_size compose: the large
+        size class DEFAULTS to chunked prefill, so sp must shard the
+        chunk path (transformer.prefill_chunk_at ring branch), not
+        bypass it — and the output must match the unchunked sp engine."""
         eng = self._engine(sequence_parallel_size=2, prefix_caching=False,
                            prefill_chunk=64)
+        prompts = [("You are honest.", "Pick a value. " * 20,
+                    DECISION_SCHEMA)]
+        out = eng.batch_generate_json(prompts, temperature=0.0, max_tokens=96)
+        assert "error" not in out[0], out[0]
+        assert eng.sp_bypasses == 0
+        # Deterministic per config; schema-valid.  (No byte comparison
+        # against the unchunked sp engine: per-chunk partial-softmax
+        # merges change bf16 reduction order, which flips greedy argmax
+        # on random-weight near-ties — the same caveat as the tp tests.
+        # The plain path's chunked==one-pass identity is covered by
+        # test_chunked_matches_single_pass.)
+        assert out == eng.batch_generate_json(
+            prompts, temperature=0.0, max_tokens=96
+        )
+        assert 0 <= out[0]["value"] <= 50
+        eng.shutdown()
+
+    def test_sp_bypass_counted_for_cached_prefix(self):
+        """The cached-prefix suffix path is the one remaining sp bypass;
+        it must warn once and count."""
+        import warnings as _w
+
+        eng = self._engine(sequence_parallel_size=2, prefix_caching=True)
         with _w.catch_warnings(record=True) as rec:
             _w.simplefilter("always")
             out = eng.batch_generate_json(
-                [("You are honest.", "Pick a value. " * 20, DECISION_SCHEMA)],
+                [("You are honest.", "Pick a value.", DECISION_SCHEMA)],
                 temperature=0.0, max_tokens=96,
             )
         assert "error" not in out[0], out[0]
+        # Non-vacuous: tiny-test's template family IS prefix-split-safe,
+        # so the prefix path must engage and the bypass must count+warn.
+        assert eng._prefix_safe
         assert eng.sp_bypasses >= 1
         assert any("sequence-parallel path bypassed" in str(w.message)
                    for w in rec)

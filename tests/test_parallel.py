@@ -306,6 +306,49 @@ class TestSequenceParallelPrefill:
                 a, b = a[:, vmask], b[:, vmask]
             np.testing.assert_allclose(a, b, rtol=5e-2, atol=6e-2)
 
+    def test_chunked_ring_matches_one_pass_ring(self):
+        """prefill_chunk_at's ring branch (chunk attends the WHOLE
+        sp-sharded cache) must reproduce one-pass prefill_sp: same final
+        logits, same cache at written slots — chunk boundaries invisible
+        under sp."""
+        from bcg_tpu.models.transformer import (
+            init_kv_cache, prefill_chunk_at, prefill_sp,
+        )
+
+        spec = spec_for_model("bcg-tpu/tiny-test")
+        params = init_params(spec, jax.random.PRNGKey(0))
+        mesh = build_mesh(dp=1, tp=1, sp=4)
+        B, L, C, S = 2, 64, 32, 96
+        tokens = jax.random.randint(jax.random.PRNGKey(2), (B, L), 0,
+                                    spec.vocab_size)
+        valid = jnp.ones((B, L), bool)
+
+        ref_logits, ref_cache = prefill_sp(
+            params, spec, tokens, valid, init_kv_cache(spec, B, S), mesh,
+        )
+
+        cache = init_kv_cache(spec, B, S)
+        H = L - C  # fixed history window, as the engine drives it
+        ring = (mesh, "sp")
+        for start in (0, C):
+            hist = jnp.zeros((B, H), bool).at[:, :start].set(True)
+            logits, cache = prefill_chunk_at(
+                params, spec, tokens[:, start:start + C],
+                valid[:, start:start + C], cache, hist,
+                jnp.full((B,), start, jnp.int32), jnp.int32(start),
+                ring=ring,
+            )
+        np.testing.assert_allclose(
+            np.asarray(logits, np.float32),
+            np.asarray(ref_logits, np.float32), rtol=5e-2, atol=6e-2,
+        )
+        assert (np.argmax(np.asarray(logits), -1)
+                == np.argmax(np.asarray(ref_logits), -1)).all()
+        for a, b in zip(jax.tree.leaves(cache), jax.tree.leaves(ref_cache)):
+            a = np.asarray(a, np.float32)[:, :L]
+            b = np.asarray(b, np.float32)[:, :L]
+            np.testing.assert_allclose(a, b, rtol=5e-2, atol=6e-2)
+
     def test_indivisible_length_raises(self):
         from bcg_tpu.models.transformer import init_kv_cache, prefill_sp
 
