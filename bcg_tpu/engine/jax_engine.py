@@ -470,11 +470,15 @@ class JaxEngine(InferenceEngine):
         # round (VERDICT round-2 weak #3) — counted and warned-once now.
         self.prefix_fallbacks = 0
         self._prefix_fallback_warned = False
-        # Full-prefill calls that bypassed the configured sequence-
-        # parallel ring path (chunked prefill took the call, or the
-        # bucket didn't divide by sp).  Counted + warned-once like
-        # prefix_fallbacks: silent disengagement of a configured
-        # optimization hid a disabled cache for a whole round once.
+        # Calls that fell back from a configured sequence-parallel path.
+        # Every serving path shards under sp (one-pass, chunked, and
+        # cached-prefix prefill incl. entry builds; plain and
+        # fast-forward decode; bf16 and int8 caches) for every ladder
+        # shape — the only reachable fallbacks are off-ladder clamp
+        # shapes whose length doesn't divide sp, counted + warned-once
+        # (_note_sp_bypass).  Tests and the dryrun assert zero on ladder
+        # shapes: silent disengagement of a configured optimization hid
+        # a disabled cache for a whole round once.
         self.sp_bypasses = 0
         self._sp_bypass_warned = False
         # True once a decode loop was built with the sp-sharded-cache
@@ -510,10 +514,10 @@ class JaxEngine(InferenceEngine):
         # Sequence-parallel full-prompt prefill (ring attention over the
         # mesh's `sp` axis, transformer.prefill_sp): selected per call by
         # _prefill_possibly_chunked for single-pass full prefills.
-        # Chunked prefill shards through its own ring path (the chunk
-        # jit's ring=); only the cached-prefix suffix path bypasses sp,
-        # counted in engine.sp_bypasses.  Long-context counterpart to
-        # the reference's context COMPRESSION (SURVEY.md §5.7) — prefill
+        # Chunked prefill AND the cached-prefix suffix shard through the
+        # chunk jit's ring path instead (the suffix is one chunk against
+        # the cached prefix).  Long-context counterpart to the
+        # reference's context COMPRESSION (SURVEY.md §5.7) — prefill
         # activations shard O(L/sp) per chip.
         self._prefill_sp = None
         self._sp_devices = mesh.shape.get("sp", 1) if mesh is not None else 1
@@ -722,10 +726,23 @@ class JaxEngine(InferenceEngine):
             self.spec, 1, Pb, quantized=self.kv_quantized,
             stacked=self.scan_layers,
         )
-        _, kv = self._prefill(
-            self.params, tokens=jnp.asarray(tokens), valid=jnp.asarray(valid),
-            cache=cache,
-        )
+        if self._prefill_sp is not None and Pb % self._sp_devices == 0:
+            # Entry builds shard too (every rung ladder value is a
+            # multiple of 128, so this branch is the one that runs).
+            _, kv = self._prefill_sp(
+                self.params, tokens=jnp.asarray(tokens),
+                valid=jnp.asarray(valid), cache=cache,
+            )
+        else:
+            if self._prefill_sp is not None:
+                self._note_sp_bypass(
+                    f"prefix bucket {Pb} not divisible by "
+                    f"sp={self._sp_devices} (off-ladder clamp rung)"
+                )
+            _, kv = self._prefill(
+                self.params, tokens=jnp.asarray(tokens),
+                valid=jnp.asarray(valid), cache=cache,
+            )
         # Entry prefills run inside _decode_batch's t0->t1 window, so
         # their (padded) positions must count toward prefill_tokens or
         # miss-heavy windows understate MFU (advisor round-2).
@@ -902,10 +919,13 @@ class JaxEngine(InferenceEngine):
         cvalid[0, Cb - len(core_toks):] = True
         pv = np.zeros((1, P1b), dtype=bool)
         pv[0] = e1["valid"]
-        _, kv = self._prefill_suffix(
-            self.params, tokens=jnp.asarray(tokens), valid=jnp.asarray(cvalid),
-            cache=cache, prefix_valid=jnp.asarray(pv),
-            prefix_lens=jnp.asarray([e1["len"]], np.int32),
+        # Core-extend = prefill a suffix against a cached prefix: exactly
+        # _prefill_possibly_chunked's prefix branch, which owns the
+        # sp-ring-vs-replicated dispatch (and chunking for oversized
+        # cores) — one copy of that logic, not two.
+        _, kv = self._prefill_possibly_chunked(
+            tokens, cvalid, Cb, cache,
+            prefix_valid=pv, prefix_lens=np.asarray([e1["len"]], np.int32),
         )
         # Counted for the same reason as in _get_prefix_entry: this
         # prefill happens inside the caller's prefill timing window.
@@ -1385,9 +1405,10 @@ class JaxEngine(InferenceEngine):
         )
 
     def _note_sp_bypass(self, reason: str) -> None:
-        """Count (and warn once about) a call that skipped the configured
-        sequence-parallel path (ring prefill or sp-sharded-cache decode —
-        the reason string names which)."""
+        """Count (and warn once about) a call that fell back from a
+        configured sequence-parallel path.  Only reachable for
+        off-ladder shapes (every rung ladder value divides sp); ladder
+        shapes are asserted bypass-free in tests and the dryrun."""
         self.sp_bypasses += 1
         if not self._sp_bypass_warned:
             import warnings
@@ -1422,10 +1443,28 @@ class JaxEngine(InferenceEngine):
         P = prefix_valid.shape[1] if has_prefix else 0
         if not C or L <= C:
             if has_prefix:
+                from bcg_tpu.models.transformer import _cache_len
+
+                if (self._prefill_sp is not None
+                        and _cache_len(cache) % self._sp_devices == 0):
+                    # The suffix is ONE chunk against the cached prefix:
+                    # prefill_chunk_at's ring branch writes it into the
+                    # sp-sharded cache and attends the whole cache
+                    # (prefix slots + its own causal window) — same
+                    # semantics as prefill_with_prefix (identical RoPE
+                    # offsets and mask), sharded instead of replicated.
+                    return self._prefill_chunk_at(
+                        self.params, tokens=jnp.asarray(tokens),
+                        valid=jnp.asarray(valid), cache=cache,
+                        hist_valid=jnp.asarray(prefix_valid),
+                        pos_offset=jnp.asarray(prefix_lens, dtype=jnp.int32),
+                        write_pos=jnp.int32(P),
+                    )
                 if self._prefill_sp is not None:
                     self._note_sp_bypass(
-                        "cached-prefix suffix prefill took the call "
-                        "(prefill_with_prefix is not ring-capable)"
+                        f"prefixed cache length {_cache_len(cache)} not "
+                        f"divisible by sp={self._sp_devices} "
+                        "(off-ladder clamp shape)"
                     )
                 return self._prefill_suffix(
                     self.params, tokens=jnp.asarray(tokens),

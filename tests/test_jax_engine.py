@@ -698,25 +698,44 @@ class TestEngineUnderMesh:
         assert 0 <= out[0]["value"] <= 50
         eng.shutdown()
 
-    def test_sp_bypass_counted_for_cached_prefix(self):
-        """The cached-prefix suffix path is the one remaining sp bypass;
-        it must warn once and count."""
-        import warnings as _w
-
+    def test_cached_prefix_prefill_runs_sp_sharded(self):
+        """Prefix caching composes with sp: the suffix serves as ONE
+        chunk against the cached prefix through the ring-capable chunk
+        jit — no sp path remains that bypasses sharding."""
         eng = self._engine(sequence_parallel_size=2, prefix_caching=True)
-        with _w.catch_warnings(record=True) as rec:
-            _w.simplefilter("always")
-            out = eng.batch_generate_json(
-                [("You are honest.", "Pick a value.", DECISION_SCHEMA)],
-                temperature=0.0, max_tokens=96,
-            )
+        prompts = [("You are honest.", "Pick a value.", DECISION_SCHEMA)]
+        out = eng.batch_generate_json(prompts, temperature=0.0, max_tokens=96)
         assert "error" not in out[0], out[0]
         # Non-vacuous: tiny-test's template family IS prefix-split-safe,
-        # so the prefix path must engage and the bypass must count+warn.
+        # so the prefix path engaged — and it must not have bypassed sp.
         assert eng._prefix_safe
-        assert eng.sp_bypasses >= 1
-        assert any("sequence-parallel path bypassed" in str(w.message)
-                   for w in rec)
+        assert eng.prefix_fallbacks == 0
+        assert eng.sp_bypasses == 0
+        assert 0 <= out[0]["value"] <= 50
+        # Deterministic on the warm prefix cache too.
+        assert out == eng.batch_generate_json(
+            prompts, temperature=0.0, max_tokens=96
+        )
+        eng.shutdown()
+
+    def test_shared_core_rows_under_sp(self):
+        """(system, (core, tail)) rows with sp=2: the two-level core
+        entry build routes through the ring-capable chunk jit
+        (_get_core_entry), and serving stays schema-valid and
+        deterministic with zero sp bypasses."""
+        eng = self._engine(sequence_parallel_size=2)
+        system = "You are an honest agent voting. " + "Rules. " * 30
+        core = "=== PROPOSALS ===\n  agent_0: 5\n  agent_1: 5\n" * 4
+        rows = [(system, (core, f"\n\nYou are agent_{i}. Decide now."),
+                 VOTE_SCHEMA) for i in range(2)]
+        out = eng.batch_generate_json(rows, temperature=0.0, max_tokens=48)
+        assert all(r.get("decision") in ("stop", "continue") for r in out)
+        assert eng.sp_bypasses == 0
+        assert [k for k, _b in eng._prefix_cache if "\x1e" in k], \
+            "core entry never built - the sp core path was not exercised"
+        assert out == eng.batch_generate_json(
+            rows, temperature=0.0, max_tokens=48
+        )
         eng.shutdown()
 
     def test_batch_generate_json_dp2_tp2(self):

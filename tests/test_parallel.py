@@ -349,6 +349,56 @@ class TestSequenceParallelPrefill:
             b = np.asarray(b, np.float32)[:, :L]
             np.testing.assert_allclose(a, b, rtol=5e-2, atol=6e-2)
 
+    def test_suffix_via_ring_chunk_matches_prefill_with_prefix(self):
+        """The cached-prefix suffix path under sp: the suffix served as
+        ONE ring chunk (prefill_chunk_at, whole-sharded-cache mask) must
+        match prefill_with_prefix — same final logits and suffix cache —
+        including rows with DIFFERENT cached-prefix lengths."""
+        from bcg_tpu.models.transformer import (
+            init_kv_cache, prefill, prefill_chunk_at, prefill_with_prefix,
+        )
+
+        spec = spec_for_model("bcg-tpu/tiny-test")
+        params = init_params(spec, jax.random.PRNGKey(0))
+        mesh = build_mesh(dp=1, tp=1, sp=4)
+        B, P, Ls, S = 2, 32, 32, 96
+        key = jax.random.PRNGKey(4)
+        kp, ks = jax.random.split(key)
+        # Per-row prefix lengths 32 and 20 (row 1 left-padded).
+        plens = jnp.array([32, 20])
+        prefix_valid = jnp.arange(P)[None, :] >= (P - plens)[:, None]
+        ptoks = jnp.where(
+            prefix_valid,
+            jax.random.randint(kp, (B, P), 0, spec.vocab_size), 0,
+        )
+        suffix = jax.random.randint(ks, (B, Ls), 0, spec.vocab_size)
+        sv = jnp.ones((B, Ls), bool)
+
+        def with_prefix_cache(f):
+            cache = init_kv_cache(spec, B, S)
+            _, cache = prefill(params, spec, ptoks, prefix_valid, cache)
+            return f(cache)
+
+        ref_logits, ref_cache = with_prefix_cache(lambda c: prefill_with_prefix(
+            params, spec, suffix, sv, c, prefix_valid, plens,
+        ))
+        sp_logits, sp_cache = with_prefix_cache(lambda c: prefill_chunk_at(
+            params, spec, suffix, sv, c, prefix_valid,
+            plens.astype(jnp.int32), jnp.int32(P), ring=(mesh, "sp"),
+        ))
+        np.testing.assert_allclose(
+            np.asarray(sp_logits, np.float32),
+            np.asarray(ref_logits, np.float32), rtol=5e-2, atol=6e-2,
+        )
+        assert (np.argmax(np.asarray(sp_logits), -1)
+                == np.argmax(np.asarray(ref_logits), -1)).all()
+        for a, b in zip(jax.tree.leaves(sp_cache), jax.tree.leaves(ref_cache)):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32)[:, P:P + Ls],
+                np.asarray(b, np.float32)[:, P:P + Ls],
+                rtol=5e-2, atol=6e-2,
+            )
+
     def test_indivisible_length_raises(self):
         from bcg_tpu.models.transformer import init_kv_cache, prefill_sp
 
