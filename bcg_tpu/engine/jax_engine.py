@@ -726,23 +726,10 @@ class JaxEngine(InferenceEngine):
             self.spec, 1, Pb, quantized=self.kv_quantized,
             stacked=self.scan_layers,
         )
-        if self._prefill_sp is not None and Pb % self._sp_devices == 0:
-            # Entry builds shard too (every rung ladder value is a
-            # multiple of 128, so this branch is the one that runs).
-            _, kv = self._prefill_sp(
-                self.params, tokens=jnp.asarray(tokens),
-                valid=jnp.asarray(valid), cache=cache,
-            )
-        else:
-            if self._prefill_sp is not None:
-                self._note_sp_bypass(
-                    f"prefix bucket {Pb} not divisible by "
-                    f"sp={self._sp_devices} (off-ladder clamp rung)"
-                )
-            _, kv = self._prefill(
-                self.params, tokens=jnp.asarray(tokens),
-                valid=jnp.asarray(valid), cache=cache,
-            )
+        # _prefill_possibly_chunked owns the sp-ring-vs-replicated
+        # dispatch (counted fallback for unaligned clamp rungs) — one
+        # copy of that logic for batches, entry builds, and core-extend.
+        _, kv = self._prefill_possibly_chunked(tokens, valid, Pb, cache)
         # Entry prefills run inside _decode_batch's t0->t1 window, so
         # their (padded) positions must count toward prefill_tokens or
         # miss-heavy windows understate MFU (advisor round-2).
@@ -889,6 +876,11 @@ class JaxEngine(InferenceEngine):
             (b for b in self._suffix_buckets if b >= len(core_toks)),
             len(core_toks),
         )
+        if self._sp_devices > 1:
+            # sp-align the off-ladder fallback UP (ladder rungs already
+            # divide): the combined entry cache (P1b + Cb) must divide
+            # sp for the ring core-extend; extra slots are left-pads.
+            Cb += (-Cb) % self._sp_devices
         # Level 1: the system prefix at its own natural rung — bounded so
         # the combined entry (P1b + Cb) still leaves suffix room below.
         p1_len = self._prefix_len(prefix)
@@ -899,6 +891,13 @@ class JaxEngine(InferenceEngine):
             # the limit (same rationale as _prepare_prefixed_batch).
             p1_limit if 0 < p1_len <= p1_limit else None,
         )
+        if P1_rung is not None and self._sp_devices > 1:
+            # sp-align clamp rungs down when the prefix still fits (same
+            # keep-unaligned-rather-than-abandon rationale as
+            # _prepare_prefixed_batch's clamp alignment).
+            aligned = P1_rung - P1_rung % self._sp_devices
+            if 0 < p1_len <= aligned:
+                P1_rung = aligned
         if P1_rung is None or p1_len == 0:
             return None
         e1 = self._get_prefix_entry(prefix, limit, P1_rung)
@@ -1027,6 +1026,17 @@ class JaxEngine(InferenceEngine):
                 # prompt on every call costs far more.
                 limit - 64,
             )
+            # Clamp rungs sp-align DOWN when the prefix still fits
+            # (ladder rungs already divide): ring prefill shards the
+            # bucket's token dim, and an odd clamp like limit-64=1683
+            # would otherwise bypass sp for every entry at that rung.
+            # A prefix that only fits the UNALIGNED clamp keeps it —
+            # cached via the counted replicated fallback, which beats
+            # abandoning the prefix cache (full re-prefill every call).
+            if self._sp_devices > 1:
+                aligned = P_rung - P_rung % self._sp_devices
+                if max_len <= aligned:
+                    P_rung = aligned
         entries: Dict[Tuple[str, str], Dict[str, Any]] = {}
         # _get_*_entry registers each resolved key in _prefix_active
         # (protecting the batch's working set from its own evictions),
@@ -1473,18 +1483,18 @@ class JaxEngine(InferenceEngine):
                     prefix_lens=jnp.asarray(prefix_lens),
                 )
             if self._prefill_sp is not None:
-                # _encode_leftpad sp-aligns every prompt window, so an
-                # indivisible L here is an engine bug, not a fallback
-                # case — fail loudly rather than silently serve the
-                # replicated path (the no-silent-disengagement policy).
-                assert L % self._sp_devices == 0, (
-                    f"prompt window L={L} not sp-aligned "
-                    f"(sp={self._sp_devices}) — _encode_leftpad broke "
-                    "its alignment guarantee"
-                )
-                return self._prefill_sp(
-                    self.params, tokens=jnp.asarray(tokens),
-                    valid=jnp.asarray(valid), cache=cache,
+                if L % self._sp_devices == 0:
+                    return self._prefill_sp(
+                        self.params, tokens=jnp.asarray(tokens),
+                        valid=jnp.asarray(valid), cache=cache,
+                    )
+                # Batch windows are sp-aligned by _encode_leftpad;
+                # reaching here means an off-ladder ENTRY bucket (a
+                # clamp rung whose prefix only fits unaligned) — serve
+                # replicated, counted + warned (no-silent-disengagement).
+                self._note_sp_bypass(
+                    f"prompt window L={L} not divisible by "
+                    f"sp={self._sp_devices} (off-ladder entry bucket)"
                 )
             return self._prefill(
                 self.params, tokens=jnp.asarray(tokens),
