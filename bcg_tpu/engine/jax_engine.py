@@ -892,12 +892,15 @@ class JaxEngine(InferenceEngine):
             p1_limit if 0 < p1_len <= p1_limit else None,
         )
         if P1_rung is not None and self._sp_devices > 1:
-            # sp-align clamp rungs down when the prefix still fits (same
-            # keep-unaligned-rather-than-abandon rationale as
-            # _prepare_prefixed_batch's clamp alignment).
+            # sp-align clamp rungs by construction: down when the prefix
+            # still fits, else UP to the next sp multiple (same pad-the-
+            # entry rationale as _prepare_prefixed_batch's clamp
+            # alignment) — no reachable rung is left unaligned.
             aligned = P1_rung - P1_rung % self._sp_devices
             if 0 < p1_len <= aligned:
                 P1_rung = aligned
+            elif P1_rung % self._sp_devices:
+                P1_rung += (-P1_rung) % self._sp_devices
         if P1_rung is None or p1_len == 0:
             return None
         e1 = self._get_prefix_entry(prefix, limit, P1_rung)
@@ -905,7 +908,10 @@ class JaxEngine(InferenceEngine):
             return None
         P1b = e1["bucket"]
         Pb = P1b + Cb
-        if Pb > limit - 64:
+        # sp up-alignment may overshoot the 64-token slack by < sp; the
+        # batch assembler's limits_s guard still enforces real suffix
+        # room (at sp=1 this reduces to the original Pb > limit - 64).
+        if Pb >= limit - 64 + max(1, self._sp_devices):
             return None
         # Extend: prefill the core against the level-1 KV (the same
         # suffix-prefill jit every prefix-cached batch uses).
@@ -1026,17 +1032,20 @@ class JaxEngine(InferenceEngine):
                 # prompt on every call costs far more.
                 limit - 64,
             )
-            # Clamp rungs sp-align DOWN when the prefix still fits
-            # (ladder rungs already divide): ring prefill shards the
-            # bucket's token dim, and an odd clamp like limit-64=1683
-            # would otherwise bypass sp for every entry at that rung.
-            # A prefix that only fits the UNALIGNED clamp keeps it —
-            # cached via the counted replicated fallback, which beats
-            # abandoning the prefix cache (full re-prefill every call).
+            # Clamp rungs sp-align by construction (ladder rungs already
+            # divide): ring prefill shards the bucket's token dim, and an
+            # odd clamp like limit-64=1683 would otherwise bypass sp for
+            # every entry at that rung.  Align DOWN when the prefix still
+            # fits; a prefix that only fits the unaligned clamp gets the
+            # next sp multiple UP — < sp extra pad slots eating into the
+            # 64-token slack, which the limits_s guard below still
+            # polices.  Every reachable rung is therefore sp-divisible.
             if self._sp_devices > 1:
                 aligned = P_rung - P_rung % self._sp_devices
                 if max_len <= aligned:
                     P_rung = aligned
+                elif P_rung % self._sp_devices:
+                    P_rung += (-P_rung) % self._sp_devices
         entries: Dict[Tuple[str, str], Dict[str, Any]] = {}
         # _get_*_entry registers each resolved key in _prefix_active
         # (protecting the batch's working set from its own evictions),

@@ -54,7 +54,10 @@ def _block_attend(q, k, v, q_pos, k_pos, scale, causal, kv_valid=None):
             kv_valid[:, None, None, None, :], logits, -jnp.inf
         )
     m = jnp.max(logits, axis=-1)  # [B,Hkv,G,Tq]
-    # Guard fully-masked rows (no valid kv yet): exp(-inf - -inf) -> 0.
+    # Fully-masked rows (no valid kv yet) keep m = -inf so the caller's
+    # running-max merge ignores them; a 0.0 sentinel there would inflate
+    # the merged max and underflow exp() whenever every valid logit is
+    # strongly negative.  The local exp still needs a finite reference.
     safe_m = jnp.where(jnp.isfinite(m), m, 0.0)
     p = jnp.exp(logits - safe_m[..., None])
     p = jnp.where(jnp.isfinite(logits), p, 0.0)
@@ -63,7 +66,7 @@ def _block_attend(q, k, v, q_pos, k_pos, scale, causal, kv_valid=None):
         "bhgts,bshd->bthgd", p.astype(v.dtype), v,
         preferred_element_type=jnp.float32,
     )
-    return safe_m, l, acc
+    return m, l, acc
 
 
 def _ring_body(axis_name: str, sp: int, causal: bool, scale: float,
@@ -95,9 +98,13 @@ def _ring_body(axis_name: str, sp: int, causal: bool, scale: float,
         bm, bl, bacc = _block_attend(
             q, k, v, q_pos, k_pos, scale, causal, kv_valid=kvv,
         )
+        # m / bm are -inf for rows with no valid kv so far; reference
+        # the exps against a finite max and zero the -inf sides (their
+        # l/acc are already 0) instead of evaluating exp(-inf - -inf).
         new_m = jnp.maximum(m, bm)
-        alpha = jnp.exp(m - new_m)
-        beta = jnp.exp(bm - new_m)
+        safe_new = jnp.where(jnp.isfinite(new_m), new_m, 0.0)
+        alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_new), 0.0)
+        beta = jnp.where(jnp.isfinite(bm), jnp.exp(bm - safe_new), 0.0)
         l = l * alpha + bl * beta
         acc = acc * alpha.transpose(0, 3, 1, 2)[..., None] + \
             bacc * beta.transpose(0, 3, 1, 2)[..., None]
@@ -217,9 +224,16 @@ def sp_chunk_decode_attention(
             preferred_element_type=jnp.float32,
         )
         # Merge partials across the cache slices: global running max,
-        # then rescale each slice's exp-sum/accumulator into it.
-        m_glob = jax.lax.pmax(safe_m, axis_name)
-        corr = jnp.exp(safe_m - m_glob)               # [b, K, hkv, g]
+        # then rescale each slice's exp-sum/accumulator into it.  pmax
+        # the RAW per-slice max — a fully-masked slice contributes -inf,
+        # not a 0.0 sentinel that would inflate the global max and
+        # underflow exp() when every valid logit is strongly negative
+        # (short left-padded rows on large sp leave most slices empty).
+        m_glob_raw = jax.lax.pmax(m_loc, axis_name)
+        m_glob = jnp.where(jnp.isfinite(m_glob_raw), m_glob_raw, 0.0)
+        corr = jnp.where(                              # [b, K, hkv, g]
+            jnp.isfinite(m_loc), jnp.exp(m_loc - m_glob), 0.0
+        )
         l = jax.lax.psum(l_loc * corr, axis_name)
         acc = jax.lax.psum(acc_loc * corr[..., None], axis_name)
         out = acc / jnp.maximum(l[..., None], 1e-30)

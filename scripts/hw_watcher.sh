@@ -1,5 +1,5 @@
 #!/bin/bash
-# Hardware-recovery watcher for the round-4 validation queue.
+# Hardware-recovery watcher for the hardware validation queue.
 #
 # The axon-tunneled TPU comes and goes (see BENCH_NOTES outage
 # timelines).  This script probes the chip with a real (non-toy)
@@ -12,7 +12,7 @@
 # in the log instead of wedging the queue.
 set -u
 cd /root/repo
-OUT=results/hw_r4
+OUT=${HW_WATCHER_OUT:-results/hw_r5}
 declare -A TMO
 LOG=$OUT/watcher.log
 mkdir -p "$OUT"
@@ -245,7 +245,10 @@ all_done() {
 # Hard deadline (epoch seconds; env-overridable): the watcher must be
 # gone before the round driver runs its own bench — two engines
 # contending for one 16 GB chip would OOM the driver's recorded number.
-DEADLINE=${HW_WATCHER_DEADLINE:-1785508800}  # 2026-07-31 14:40 UTC
+# Default: 6 h from launch — a stale hardcoded epoch once made the
+# watcher exit on its first loop iteration.  Set HW_WATCHER_DEADLINE
+# explicitly to end just before the driver's bench window.
+DEADLINE=${HW_WATCHER_DEADLINE:-$(( $(date -u +%s) + 21600 ))}
 
 log "watcher started (pid $$)"
 while true; do

@@ -718,6 +718,63 @@ class TestEngineUnderMesh:
         )
         eng.shutdown()
 
+    def test_near_cap_clamp_prefix_sp_aligns_up(self):
+        """A system prefix that only fits the UNALIGNED clamp rung
+        (limit - 64, with no ladder rung left below the limit) must be
+        cached at the next sp multiple UP — padded entry, not the
+        counted replicated fallback.  Closes the last off-ladder bypass
+        class by construction (VERDICT r4 #4)."""
+        from bcg_tpu.engine.chat_template import format_chat_parts
+
+        eng = self._engine(sequence_parallel_size=4, prefix_caching=True,
+                           max_model_len=1024)
+        # ByteTokenizer: 1 ASCII char = 1 token.  limit = 1024-96-1 =
+        # 927; clamp = 863; sp=4 aligns down to 860 — a prefix of 862
+        # tokens fits ONLY the unaligned clamp, forcing the align-UP
+        # rung (864).
+        probe, _ = format_chat_parts(
+            "bcg-tpu/tiny-test", "", "u", eng.config.disable_qwen3_thinking)
+        overhead = len(eng.tokenizer.encode(probe))
+        system = "R" * (862 - overhead)
+        prefix, _ = format_chat_parts(
+            "bcg-tpu/tiny-test", system, "u", eng.config.disable_qwen3_thinking)
+        assert len(eng.tokenizer.encode(prefix)) == 862
+        out = eng.batch_generate_json(
+            [(system, "Pick a value.", DECISION_SCHEMA)],
+            temperature=0.0, max_tokens=96,
+        )
+        assert "error" not in out[0], out[0]
+        assert eng.sp_bypasses == 0
+        assert eng.prefix_fallbacks == 0
+        buckets = [b for (_p, b) in eng._prefix_cache]
+        assert buckets and all(b % 4 == 0 for b in buckets)
+        assert any(b >= 862 for b in buckets)
+        eng.shutdown()
+
+    def test_randomized_prompt_length_sweep_no_bypasses(self):
+        """Seeded random prompt lengths spanning ladder rungs plus the
+        near-cap clamp region: NO reachable shape may bypass sp —
+        the flipped all-shapes assertion from VERDICT r4 #4."""
+        import numpy as np
+
+        eng = self._engine(sequence_parallel_size=2, prefix_caching=True,
+                           max_model_len=1024)
+        rng = np.random.RandomState(42)
+        # Two random in-ladder lengths (cheap: shared bucket compiles)
+        # plus both sides of the clamp boundary at limit-64 = 863.
+        lengths = sorted(set(
+            [int(x) for x in rng.randint(40, 700, size=2)] + [861, 863]
+        ))
+        for n in lengths:
+            system = "R" * n
+            out = eng.batch_generate_json(
+                [(system, "Pick a value.", DECISION_SCHEMA)],
+                temperature=0.0, max_tokens=96,
+            )
+            assert "error" not in out[0], (n, out[0])
+        assert eng.sp_bypasses == 0, f"bypass at one of {lengths}"
+        eng.shutdown()
+
     def test_shared_core_rows_under_sp(self):
         """(system, (core, tail)) rows with sp=2: the two-level core
         entry build routes through the ring-capable chunk jit

@@ -132,6 +132,34 @@ class TestRingAttention:
         assert not np.isnan(r).any()
         np.testing.assert_array_equal(r[~vmask], 0.0)
 
+    def test_strongly_negative_logits_survive_empty_blocks(self):
+        """Underflow regression: with heavy left-padding most ring steps
+        see a fully-masked kv block.  A 0.0 sentinel max from those
+        blocks would inflate the running max, underflowing exp() when
+        every VALID logit is below ~-87; the merge must reference only
+        finite block maxima."""
+        sp = 4
+        mesh = build_mesh(dp=1, tp=1, sp=sp)
+        B, T, H, Hkv, Dh = 2, 32, 2, 2, 16
+        # q·k * scale ≈ -25*16/4 = -100 on every valid pair.
+        q = jnp.full((B, T, H, Dh), 5.0, jnp.float32)
+        k = jnp.full((B, T, Hkv, Dh), -5.0, jnp.float32)
+        kv0 = jax.random.normal(jax.random.PRNGKey(9), (B, T, Hkv, Dh))
+        v = kv0.astype(jnp.float32)
+        pad = jnp.array([28, 30])  # only the last shard holds valid kv
+        valid = jnp.arange(T)[None, :] >= pad[:, None]
+        ring = ring_attention(q, k, v, mesh, axis_name="sp", causal=True,
+                              kv_valid=valid)
+        causal = jnp.tril(jnp.ones((T, T), bool))[None]
+        mask = causal & valid[:, None, :] & valid[:, :, None]
+        full = _xla_attention(q, k, v, mask, 1.0 / np.sqrt(Dh))
+        r, f = np.asarray(ring), np.asarray(full)
+        vmask = np.asarray(valid)
+        # All valid logits equal → softmax = running mean of valid v;
+        # any underflow collapses the output to 0 instead.
+        assert np.abs(r[vmask]).max() > 0.1
+        np.testing.assert_allclose(r[vmask], f[vmask], rtol=2e-4, atol=2e-4)
+
 
 class TestSpDecodeAttention:
     """Flash-decoding over a sequence-sharded cache: partials merge via
@@ -163,6 +191,33 @@ class TestSpDecodeAttention:
         scale = 1.0 / np.sqrt(Dh)
         out = sp_decode_attention(q, k, v, mask, mesh, scale=scale)
         ref = self._ref(q, k, v, mask, scale)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_strongly_negative_logits_survive_empty_shards(self):
+        """Underflow regression (advisor r4): a short left-padded row on
+        large sp leaves most cache shards fully masked.  pmax of a 0.0
+        sentinel from the empty shards inflates the global max; when
+        every valid logit is below ~-87 the f32 exp underflows and the
+        output collapses to 0 instead of the true softmax average."""
+        from bcg_tpu.ops.ring_attention import sp_decode_attention
+
+        sp = 8
+        mesh = build_mesh(dp=1, tp=1, sp=sp)
+        B, S, H, Hkv, Dh = 2, 64, 4, 2, 16
+        # q·k * scale ≈ -100 on every valid slot (all logits equal).
+        q = jnp.full((B, H, Dh), 5.0, jnp.float32)
+        k = jnp.full((B, S, Hkv, Dh), -5.0, jnp.float32)
+        v = jax.random.normal(
+            jax.random.PRNGKey(11), (B, S, Hkv, Dh), jnp.float32
+        )
+        # Valid slots confined to the LAST shard (slots 56..) — the
+        # other 7 shards are empty and must not poison the merge.
+        mask = jnp.arange(S)[None, :] >= jnp.array([56, 62])[:, None]
+        scale = 1.0 / np.sqrt(Dh)
+        out = sp_decode_attention(q, k, v, mask, mesh, scale=scale)
+        ref = self._ref(q, k, v, mask, scale)
+        assert np.abs(np.asarray(out)).max() > 0.1
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-4, atol=2e-4)
 
