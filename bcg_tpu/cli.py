@@ -49,6 +49,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tensor-parallel", type=int, default=None, help="TP mesh axis size")
     p.add_argument("--sequence-parallel", type=int, default=None,
                    help="SP mesh axis size (ring-attention long-context prefill)")
+    p.add_argument("--data-parallel", type=int, default=None,
+                   help="DP mesh axis size (agent parallelism: game batches "
+                        "shard one-row-per-device-slice; BASELINE config 4's "
+                        "one-agent-per-chip layout when it equals the agent "
+                        "count)")
     p.add_argument("--quantization", type=str, default=None, choices=["int8", "int4"],
                    help="Weight quantization: int8 = dynamic W8A8 (halves decode "
                         "weight traffic); int4 = grouped W4A16 (capacity: fits "
@@ -125,6 +130,10 @@ def config_from_args(args) -> BCGConfig:
     if args.sequence_parallel:
         engine = dataclasses.replace(
             engine, sequence_parallel_size=args.sequence_parallel
+        )
+    if args.data_parallel:
+        engine = dataclasses.replace(
+            engine, data_parallel_size=args.data_parallel
         )
     if args.quantization:
         engine = dataclasses.replace(engine, quantization=args.quantization)

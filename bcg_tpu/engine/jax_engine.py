@@ -159,26 +159,39 @@ def _pad_batch(real_B: int) -> int:
     return real_B if real_B >= 8 else 1 << (real_B - 1).bit_length()
 
 
-def _chunk_size(cap: int) -> int:
-    """Largest chunk whose PADDED batch (_pad_batch) stays within ``cap``
-    — max_num_seqs bounds allocated KV rows, so padding must not
-    re-inflate a chunk past it (cap 5 would pad to 8 otherwise)."""
-    if cap >= 8:
-        return cap
-    return 1 << (cap.bit_length() - 1)  # largest power of two <= cap
+def _aligned_pad_batch(n: int, multiple: int) -> int:
+    """Final padded batch size: power-of-two bucketing (_pad_batch) then
+    alignment up to the dp ``multiple``."""
+    B = _pad_batch(n)
+    return B + (-B) % multiple
 
 
-def _pad_rows(*lists):
+def _chunk_size(cap: int, multiple: int = 1) -> int:
+    """Largest chunk whose PADDED batch (:func:`_aligned_pad_batch`)
+    stays within ``cap`` — max_num_seqs / the HBM provisioner bound
+    allocated KV rows, so neither power-of-two padding (cap 5 would pad
+    to 8) nor dp alignment (cap 12 at dp 8 would pad to 16) may
+    re-inflate a chunk past them.  Requires ``multiple <= cap`` (the
+    caller drops dp alignment otherwise)."""
+    return max(
+        s for s in range(1, cap + 1) if _aligned_pad_batch(s, multiple) <= cap
+    )
+
+
+def _pad_rows(*lists, multiple: int = 1):
     """Pad parallel per-sequence lists to the bucketed batch size by
     repeating row 0 (results for padding rows are discarded).  Small
     batches (retry sub-batches, sequential fallbacks) pad to a power of
     two so they share compiled decode loops instead of each paying a
     tens-of-seconds remote compile; the main game batch (all agents, a
     stable size every round) runs exact — decode is KV-bandwidth-bound,
-    so padding IT would cost real HBM traffic.  Returns
-    (real_B, B, *padded_lists)."""
+    so padding IT would cost real HBM traffic.  ``multiple`` (the
+    engine's dp degree) further aligns the padded size so the batch axis
+    shards evenly over the mesh's ``dp`` axis: sharding N padding rows
+    over dp devices costs LESS per-device traffic than replicating the
+    unpadded batch to all of them.  Returns (real_B, B, *padded_lists)."""
     real_B = len(lists[0])
-    B = _pad_batch(real_B)
+    B = _aligned_pad_batch(real_B, multiple)
     return (real_B, B) + tuple(l + [l[0]] * (B - real_B) for l in lists)
 
 
@@ -241,6 +254,17 @@ class JaxEngine(InferenceEngine):
         # int8 kernels fail hardware lowering, serve through the dequant
         # fallback (slower, warned below) instead of crashing.
         int8_kernel_off = env_flag("BCG_TPU_DISABLE_INT8_DECODE_KERNEL")
+        # GQA group-width guard: the kernels are hardware-validated at
+        # power-of-two groups (1B group 2, 8B group 4 — probe cases);
+        # the 14B preset's group 5 (H=40, Hkv=8) crashed the remote
+        # Mosaic compile outright (tpu_compile_helper exit 1, 2026-08-01)
+        # with no recoverable error text, so non-power-of-two groups
+        # take the XLA dequant fallback BY CONSTRUCTION instead of
+        # discovering the crash minutes into a 14B boot.
+        group = self.spec.num_heads // max(self.spec.num_kv_heads, 1)
+        group_ok = group & (group - 1) == 0 and group <= 8
+        if not group_ok:
+            int8_kernel_off = True
         if self.kv_quantized and on_tpu_aligned and not int8_kernel_off:
             self.decode_attention_impl = "pallas"
         else:
@@ -252,7 +276,10 @@ class JaxEngine(InferenceEngine):
 
             warnings.warn(
                 "int8 KV cache without the Pallas decode kernel ("
-                + ("BCG_TPU_DISABLE_INT8_DECODE_KERNEL is set"
+                + ("GQA group width "
+                   f"{group} outside the kernel-validated set"
+                   if not group_ok
+                   else "BCG_TPU_DISABLE_INT8_DECODE_KERNEL is set"
                    if int8_kernel_off
                    else "non-TPU backend or head_dim not a multiple of 128")
                 + "): the fallback dequantizes the whole cache per step, "
@@ -481,6 +508,14 @@ class JaxEngine(InferenceEngine):
         # a disabled cache for a whole round once.
         self.sp_bypasses = 0
         self._sp_bypass_warned = False
+        # Calls that fell back from a configured data-parallel (dp)
+        # batch sharding — only reachable for a batch whose padded size
+        # doesn't divide dp, which _pad_rows(multiple=dp) rules out for
+        # every engine-built batch; counted + warned-once like sp.
+        # dp_batches counts batches that actually ran dp-sharded.
+        self.dp_bypasses = 0
+        self._dp_bypass_warned = False
+        self.dp_batches = 0
         # True once a decode loop was built with the sp-sharded-cache
         # attention (set in _get_decode_loop).  Truthful by construction:
         # cache allocation is sp-aligned (_kv_align) and an indivisible
@@ -521,6 +556,15 @@ class JaxEngine(InferenceEngine):
         # activations shard O(L/sp) per chip.
         self._prefill_sp = None
         self._sp_devices = mesh.shape.get("sp", 1) if mesh is not None else 1
+        # Data parallelism (agent parallelism): batch rows shard over the
+        # mesh's `dp` axis — one agent per device slice when the game's
+        # agent count equals dp (BASELINE config 4's one-agent-per-chip
+        # scale sweep).  Weights replicate over dp (parallel/sharding.py);
+        # batch arrays and the KV cache are placed with a "dp"-first
+        # NamedSharding (_put_batch/_put_cache) so XLA partitions every
+        # prefill/decode along the batch axis; the ring/sp shard_maps
+        # already carry dp in their in_specs (ops/ring_attention.py).
+        self._dp_devices = mesh.shape.get("dp", 1) if mesh is not None else 1
         if self._sp_devices > 1:
             from bcg_tpu.models.transformer import prefill_sp
 
@@ -540,11 +584,38 @@ class JaxEngine(InferenceEngine):
             donate_argnames=("cache",),
         )
         self._decode_loops: Dict[Tuple, Any] = {}
-        self._assemble_cache = jax.jit(
+        # (B, S) -> jitted sharded-zero cache initializer (see
+        # _init_cache_sharded; memoized so each batch shape compiles once).
+        self._cache_init_jits: Dict[Tuple[int, int], Any] = {}
+        _assemble_fn = (
             self._assemble_cache_stacked_fn
             if self.scan_layers
-            else self._assemble_cache_fn,
-            static_argnames=("tail",),
+            else self._assemble_cache_fn
+        )
+        if mesh is not None and mesh.size > 1:
+            # Constrain the assembled cache to the mesh layout AT TRACE
+            # TIME so GSPMD produces it directly sharded — assembling
+            # replicated and resharding after would stage the full
+            # unsharded cache on one device first, the same transient
+            # spike _init_cache_sharded's out_shardings avoid for fresh
+            # caches.
+            from bcg_tpu.parallel.sharding import kv_cache_tree_sharding
+
+            _base_assemble = _assemble_fn
+
+            def _assemble_fn(entry_kvs, gid, tail):
+                cache = _base_assemble(entry_kvs, gid, tail=tail)
+                return jax.tree.map(
+                    jax.lax.with_sharding_constraint,
+                    cache,
+                    kv_cache_tree_sharding(
+                        mesh, cache, quantized=self.kv_quantized,
+                        stacked=self.scan_layers,
+                    ),
+                )
+
+        self._assemble_cache = jax.jit(
+            _assemble_fn, static_argnames=("tail",)
         )
         # Prefix caching: the per-role system-prompt segment is static for
         # a whole run, so its KV is prefilled once and reused by every
@@ -1398,8 +1469,11 @@ class JaxEngine(InferenceEngine):
         derived = self._provisioned_row_cap(parts, budgets)
         if derived is not None:
             cap = min(cap, derived) if cap else derived
-        if cap and _pad_batch(n) > cap:
-            step = _chunk_size(cap)
+        mult = self._dp_mult(cap)
+        if cap and _aligned_pad_batch(n, mult) > cap:
+            if derived is not None and derived <= cap:
+                self.provision_chunk_events += 1
+            step = _chunk_size(cap, mult)
             out: List[str] = []
             for i in range(0, n, step):
                 out.extend(self._run_guided(
@@ -1408,7 +1482,7 @@ class JaxEngine(InferenceEngine):
                 ))
             return out
         real_B, B, parts, schemas, temps, budgets = _pad_rows(
-            parts, schemas, temps, budgets
+            parts, schemas, temps, budgets, multiple=mult
         )
         guides = [
             compile_schema(
@@ -1438,6 +1512,72 @@ class JaxEngine(InferenceEngine):
                 stacklevel=3,
             )
             self._sp_bypass_warned = True
+
+    def _note_dp_bypass(self, reason: str) -> None:
+        """Count (and warn once about) a batch that fell back from the
+        configured data-parallel sharding.  Unreachable for engine-built
+        batches (_pad_rows aligns to dp); kept loud for the same reason
+        as _note_sp_bypass — silent disengagement of a configured
+        optimization once hid a disabled cache for a whole round."""
+        self.dp_bypasses += 1
+        if not self._dp_bypass_warned:
+            import warnings
+
+            warnings.warn(
+                f"data-parallel batch sharding bypassed: {reason}; further "
+                "bypasses are counted in engine.dp_bypasses",
+                stacklevel=3,
+            )
+            self._dp_bypass_warned = True
+
+    def _dp_mult(self, cap) -> int:
+        """dp batch-padding multiple compatible with a row cap: when the
+        cap is tighter than dp itself, dp cannot engage for this call
+        (the batch runs replicated; _decode_batch counts the bypass)."""
+        return self._dp_devices if not cap or self._dp_devices <= cap else 1
+
+    def _put_batch(self, x):
+        """Device-place a batch-major array sharded over the mesh's `dp`
+        axis (replicated over tp/sp — those partition weights and the
+        sequence dim).  Host numpy arrays transfer directly shard-wise
+        (each device receives only its slice — no full copy staged on
+        one device first).  Falls back to plain placement when dp is off
+        or the axis doesn't divide (single-row prefix-entry builds)."""
+        if (
+            self._dp_devices > 1
+            and x.shape[0] % self._dp_devices == 0
+        ):
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            spec = [None] * x.ndim
+            spec[0] = "dp"
+            return jax.device_put(x, NamedSharding(self.mesh, P(*spec)))
+        return jnp.asarray(x)
+
+    def _init_cache_sharded(self, B: int, S: int):
+        """Allocate a fresh decode cache ALREADY sharded over the mesh
+        (dp on batch, sp on sequence, tp on kv-heads where divisible —
+        parallel/sharding.py::kv_cache_tree_sharding, the same layout
+        the memory guards' divide-by-mesh-size arithmetic assumes).
+        Jitted zero-init with out_shardings: no device ever materializes
+        more than its shard, where init-then-reshard would stage the
+        FULL unsharded cache on one device first — a transient dp× spike
+        on exactly the large-batch configs dp exists to fit."""
+        kw = dict(quantized=self.kv_quantized, stacked=self.scan_layers)
+        if self.mesh is None or self._mesh_devices <= 1:
+            return init_kv_cache(self.spec, B, S, **kw)
+        key = (B, S)
+        mk = self._cache_init_jits.get(key)
+        if mk is None:
+            from bcg_tpu.parallel.sharding import kv_cache_tree_sharding
+
+            init = partial(init_kv_cache, self.spec, B, S, **kw)
+            outs = kv_cache_tree_sharding(
+                self.mesh, jax.eval_shape(init), **kw
+            )
+            mk = jax.jit(init, out_shardings=outs)
+            self._cache_init_jits[key] = mk
+        return mk()
 
     def _prefill_possibly_chunked(self, tokens, valid, L: int, cache,
                                   prefix_valid=None, prefix_lens=None):
@@ -1473,10 +1613,12 @@ class JaxEngine(InferenceEngine):
                     # semantics as prefill_with_prefix (identical RoPE
                     # offsets and mask), sharded instead of replicated.
                     return self._prefill_chunk_at(
-                        self.params, tokens=jnp.asarray(tokens),
-                        valid=jnp.asarray(valid), cache=cache,
-                        hist_valid=jnp.asarray(prefix_valid),
-                        pos_offset=jnp.asarray(prefix_lens, dtype=jnp.int32),
+                        self.params, tokens=self._put_batch(tokens),
+                        valid=self._put_batch(valid), cache=cache,
+                        hist_valid=self._put_batch(prefix_valid),
+                        pos_offset=self._put_batch(
+                            np.asarray(prefix_lens, np.int32)
+                        ),
                         write_pos=jnp.int32(P),
                     )
                 if self._prefill_sp is not None:
@@ -1486,16 +1628,16 @@ class JaxEngine(InferenceEngine):
                         "(off-ladder clamp shape)"
                     )
                 return self._prefill_suffix(
-                    self.params, tokens=jnp.asarray(tokens),
-                    valid=jnp.asarray(valid), cache=cache,
-                    prefix_valid=jnp.asarray(prefix_valid),
-                    prefix_lens=jnp.asarray(prefix_lens),
+                    self.params, tokens=self._put_batch(tokens),
+                    valid=self._put_batch(valid), cache=cache,
+                    prefix_valid=self._put_batch(prefix_valid),
+                    prefix_lens=self._put_batch(prefix_lens),
                 )
             if self._prefill_sp is not None:
                 if L % self._sp_devices == 0:
                     return self._prefill_sp(
-                        self.params, tokens=jnp.asarray(tokens),
-                        valid=jnp.asarray(valid), cache=cache,
+                        self.params, tokens=self._put_batch(tokens),
+                        valid=self._put_batch(valid), cache=cache,
                     )
                 # Batch windows are sp-aligned by _encode_leftpad;
                 # reaching here means an off-ladder ENTRY bucket (a
@@ -1506,8 +1648,8 @@ class JaxEngine(InferenceEngine):
                     f"sp={self._sp_devices} (off-ladder entry bucket)"
                 )
             return self._prefill(
-                self.params, tokens=jnp.asarray(tokens),
-                valid=jnp.asarray(valid), cache=cache,
+                self.params, tokens=self._put_batch(tokens),
+                valid=self._put_batch(valid), cache=cache,
             )
         # Chunked prefill under sp is ring-capable (the chunk jit carries
         # ring=): no bypass to note here.
@@ -1535,11 +1677,11 @@ class JaxEngine(InferenceEngine):
             pos_off = base_lens + valid[:, :start].sum(axis=1)
             first_logits, cache = self._prefill_chunk_at(
                 self.params,
-                tokens=jnp.asarray(tokens[:, start:start + Ct]),
-                valid=jnp.asarray(valid[:, start:start + Ct]),
+                tokens=self._put_batch(tokens[:, start:start + Ct]),
+                valid=self._put_batch(valid[:, start:start + Ct]),
                 cache=cache,
-                hist_valid=jnp.asarray(hist),
-                pos_offset=jnp.asarray(pos_off.astype(np.int32)),
+                hist_valid=self._put_batch(hist),
+                pos_offset=self._put_batch(pos_off.astype(np.int32)),
                 write_pos=jnp.int32(P + start),
             )
         return first_logits, cache
@@ -1556,6 +1698,15 @@ class JaxEngine(InferenceEngine):
         otherwise the joined full prompts take the plain path."""
         B = len(parts)
         max_new = max(budgets)
+        if self._dp_devices > 1:
+            if B % self._dp_devices:
+                # Unreachable for engine-built batches (_pad_rows aligns
+                # to dp); loud, not silent, if a future path regresses.
+                self._note_dp_bypass(
+                    f"batch size {B} not divisible by dp={self._dp_devices}"
+                )
+            else:
+                self.dp_batches += 1
         # Fast-forward only pays off when the automaton HAS forced chains;
         # the free path's permissive automaton has none, so it would buy
         # 4x decode cache and padded chunks for zero skipped steps.
@@ -1583,6 +1734,10 @@ class JaxEngine(InferenceEngine):
                     )
                     self._prefix_fallback_warned = True
         if prepped is not None:
+            # The assembled cache arrives ALREADY sharded onto the mesh
+            # layout (_assemble_cache's with_sharding_constraint wrapper,
+            # the same kv_cache_tree_sharding specs _init_cache_sharded
+            # uses for fresh caches).
             tokens, valid, Ls, cache, prefix_valid, prefix_lens, P, S = prepped
             first_logits, cache = self._prefill_possibly_chunked(
                 tokens, valid, Ls, cache,
@@ -1598,10 +1753,7 @@ class JaxEngine(InferenceEngine):
             tokens, valid, L = self._prepare_batch(full_prompts, budgets)
             S = L + decode_slots
             S += (-S) % self._kv_align  # see _kv_align
-            cache = init_kv_cache(
-                self.spec, B, S, quantized=self.kv_quantized,
-                stacked=self.scan_layers,
-            )
+            cache = self._init_cache_sharded(B, S)
             first_logits, cache = self._prefill_possibly_chunked(
                 tokens, valid, L, cache
             )
@@ -1618,22 +1770,28 @@ class JaxEngine(InferenceEngine):
         if use_ff:
             loop = self._get_ff_decode_loop(sig_prefix + (B, L), max_new, top_p)
             out, (_, steps), _cache_out = loop(
-                self.params, cache, first_logits, jnp.asarray(valid_mask),
-                jnp.asarray(prompt_lens), L,
+                self.params, cache, first_logits,
+                self._put_batch(valid_mask),
+                self._put_batch(prompt_lens), L,
                 batch.tables, batch.accepting, batch.min_budget,
-                batch.dfa_ids, batch.init_states,
+                self._put_batch(batch.dfa_ids),
+                self._put_batch(batch.init_states),
                 batch.chain_tok, batch.chain_len, batch.chain_next,
-                jnp.asarray(temps, jnp.float32), jnp.asarray(budgets, jnp.int32),
+                self._put_batch(np.asarray(temps, np.float32)),
+                self._put_batch(np.asarray(budgets, np.int32)),
                 sub,
             )
         else:
             loop = self._get_decode_loop(sig_prefix + (B, L), max_new, top_p)
             out, (_, steps), _cache_out = loop(
-                self.params, cache, first_logits, jnp.asarray(valid_mask),
-                jnp.asarray(prompt_lens), L,
+                self.params, cache, first_logits,
+                self._put_batch(valid_mask),
+                self._put_batch(prompt_lens), L,
                 batch.tables, batch.accepting, batch.min_budget,
-                batch.dfa_ids, batch.init_states,
-                jnp.asarray(temps, jnp.float32), jnp.asarray(budgets, jnp.int32),
+                self._put_batch(batch.dfa_ids),
+                self._put_batch(batch.init_states),
+                self._put_batch(np.asarray(temps, np.float32)),
+                self._put_batch(np.asarray(budgets, np.int32)),
                 sub,
             )
         del _cache_out  # dropped immediately; exists only for aliasing
@@ -1711,7 +1869,7 @@ class JaxEngine(InferenceEngine):
             per_row = S * slot * spec.num_layers / self._mesh_devices
             return max(1, int(budget // per_row)) if per_row > 0 else None
 
-        B_pad = _pad_batch(len(parts))
+        B_pad = _aligned_pad_batch(len(parts), self._dp_devices)
         # Cheap pre-check at the WORST-CASE prompt window: if even that
         # fits the whole padded batch, skip the per-row tokenization
         # below (~1.4 ms/row on HF tokenizers — real host time on every
@@ -1726,7 +1884,10 @@ class JaxEngine(InferenceEngine):
         cap = cap_for(min(L, limit) + decode_res)
         if cap is None or cap >= B_pad:
             return None
-        self.provision_chunk_events += 1
+        # The caller (_run_guided/_run_free) re-derives the dp padding
+        # multiple against this cap and counts provision_chunk_events
+        # only when the cap actually forces a chunk split — a cap that
+        # merely disables dp alignment is not a chunk event.
         return cap
 
     def _check_kv_budget(self, B: int, budgets: List[int],
@@ -1854,8 +2015,11 @@ class JaxEngine(InferenceEngine):
         derived = self._provisioned_row_cap(parts, budgets)
         if derived is not None:
             cap = min(cap, derived) if cap else derived
-        if cap and _pad_batch(n) > cap:
-            step = _chunk_size(cap)
+        mult = self._dp_mult(cap)
+        if cap and _aligned_pad_batch(n, mult) > cap:
+            if derived is not None and derived <= cap:
+                self.provision_chunk_events += 1
+            step = _chunk_size(cap, mult)
             out: List[str] = []
             for i in range(0, n, step):
                 out.extend(self._run_free(
@@ -1863,7 +2027,9 @@ class JaxEngine(InferenceEngine):
                     budgets[i:i + step], top_p,
                 ))
             return out
-        real_B, B, parts, temps, budgets = _pad_rows(parts, temps, budgets)
+        real_B, B, parts, temps, budgets = _pad_rows(
+            parts, temps, budgets, multiple=mult
+        )
         batch = GuidedBatch.permissive(B, self.spec.vocab_size)
         texts = self._decode_batch(
             parts, batch, ("free", 1, self.spec.vocab_size), real_B,

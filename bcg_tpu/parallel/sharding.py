@@ -134,3 +134,39 @@ def kv_scale_sharding(mesh: Mesh) -> NamedSharding:
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """[B, ...] activations: batch over dp."""
     return NamedSharding(mesh, P("dp"))
+
+
+def kv_cache_tree_sharding(mesh: Mesh, cache_shapes, quantized: bool = False,
+                           stacked: bool = False):
+    """Per-leaf shardings for an ``init_kv_cache``-shaped pytree.
+
+    Applies :func:`kv_cache_sharding` / :func:`kv_scale_sharding`'s axis
+    layout with per-axis divisibility guards (an axis whose size doesn't
+    divide its mesh dimension is replicated instead — mirroring
+    ``ops/ring_attention.py``'s dp_ax/tp_ax guards), and a leading
+    ``None`` under scan-over-layers stacking.  ``cache_shapes`` is the
+    cache itself or a ``jax.eval_shape`` result — only ``.shape`` and
+    ``.ndim`` of the leaves are read.  Centralizing this here keeps the
+    engine's cache placement and the memory guards (which divide
+    per-row bytes by the FULL mesh size) from drifting apart.
+    """
+    lead = (None,) if stacked else ()
+    if quantized:
+        kv = lead + ("dp", "tp", "sp", None)      # [B, Hkv, S, Dh] int8
+        scale = lead + ("dp", "tp", "sp")         # [B, Hkv, S]
+    else:
+        kv = lead + ("dp", "sp", "tp", None)      # [B, S, Hkv, Dh]
+        scale = None
+
+    def place(leaf):
+        axes = kv if leaf.ndim == len(kv) else scale
+        spec = tuple(
+            ax
+            if ax is not None and leaf.shape[i] % mesh.shape.get(ax, 1) == 0
+            and mesh.shape.get(ax, 1) > 1
+            else None
+            for i, ax in enumerate(axes)
+        )
+        return NamedSharding(mesh, P(*spec))
+
+    return jax.tree.map(place, cache_shapes)

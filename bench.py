@@ -149,6 +149,49 @@ def _error_result(exc: BaseException, retried: bool) -> dict:
     }
 
 
+# Engines built by attempts that later FAILED: the retry must free their
+# device state before building a second engine (see _teardown_live_engines;
+# an un-torn-down 14B first attempt OOM'd the retry's init on 2026-08-01).
+_LIVE_ENGINES: list = []
+
+
+def _teardown_live_engines() -> None:
+    """Free a failed attempt's device state (weights, prefix KV, cached
+    decode loops) and WAIT for the allocator to reflect it.  On the
+    remote-attached chip frees complete asynchronously — an immediate
+    rebuild of an 8B/14B engine races them into RESOURCE_EXHAUSTED even
+    after the host-side references are gone."""
+    import gc
+
+    while _LIVE_ENGINES:
+        eng = _LIVE_ENGINES.pop()
+        try:
+            eng.shutdown()
+        except Exception:
+            pass
+    gc.collect()
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        limit = (dev.memory_stats() or {}).get("bytes_limit")
+    except Exception:
+        return
+    if not limit:
+        return
+    deadline = time.time() + 90
+    while time.time() < deadline:
+        try:
+            used = (dev.memory_stats() or {}).get("bytes_in_use", 0)
+        except Exception:
+            return
+        if used < 0.2 * limit:
+            return
+        time.sleep(3)
+    _progress("teardown wait expired with device memory still high "
+              "(retry may OOM)")
+
+
 def _run_attempt(cfg, model: str, backend: str, concurrency: int,
                  warmup_rounds: int, measured_rounds: int) -> dict:
     """One full bench attempt: build sim, warm up, measure, return the
@@ -163,6 +206,7 @@ def _run_attempt(cfg, model: str, backend: str, concurrency: int,
     _progress(f"engine built in {time.perf_counter() - t_boot0:.1f}s")
     n_agents = cfg.game.num_honest + cfg.game.num_byzantine
     engine = sim.engine  # reuse across games: compiled loops persist
+    _LIVE_ENGINES.append(engine)
 
     if backend == "fake":
         platform = "none"  # fake engine never touches a device
@@ -609,6 +653,10 @@ def main() -> None:
             import gc
 
             gc.collect()
+            # Shut the failed attempt's engine down and wait for the
+            # device allocator to drain before rebuilding (frees are
+            # async on the remote-attached chip).
+            _teardown_live_engines()
             try:
                 result = _run_attempt(
                     cfg, model, backend, concurrency,

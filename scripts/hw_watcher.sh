@@ -60,7 +60,7 @@ step_spec() {
       CMD=(env BENCH_ROUNDS=3 python bench.py);;
     int8_probe)
       TMOS=1200; PAT='int8-decode-probe OK'
-      CMD=(env PYTHONPATH=/root/repo python scripts/probe_int8_decode.py);;
+      CMD=(env PYTHONPATH=/root/repo${PYTHONPATH:+:$PYTHONPATH} python scripts/probe_int8_decode.py);;
     bench_int8kv)
       TMOS=1500; PAT='"value"'
       CMD=(env BENCH_ROUNDS=3 BENCH_KV_DTYPE=int8
@@ -73,7 +73,7 @@ step_spec() {
       CMD=(env BENCH_ROUNDS=3 BENCH_CONCURRENCY=2 python bench.py);;
     art_convert)
       TMOS=1200; PAT='saved int8 artifact'
-      CMD=(env PYTHONPATH=/root/repo python -m bcg_tpu.models.artifact
+      CMD=(env PYTHONPATH=/root/repo${PYTHONPATH:+:$PYTHONPATH} python -m bcg_tpu.models.artifact
            --model bcg-hf/bench-1b --mode int8
            --out checkpoints_q/bcg-hf--bench-1b);;
     bench_artifact)
@@ -91,17 +91,17 @@ step_spec() {
       CMD=(env BENCH_ROUNDS=3 BCG_TPU_W8A16_PREFILL=512 python bench.py);;
     mb_prefill)
       TMOS=2400; PAT='rmsnorm'
-      CMD=(env PYTHONPATH=/root/repo python scripts/microbench_prefill.py);;
+      CMD=(env PYTHONPATH=/root/repo${PYTHONPATH:+:$PYTHONPATH} python scripts/microbench_prefill.py);;
     mb_decode)
       TMOS=2400; PAT='in-loop'
-      CMD=(env PYTHONPATH=/root/repo python scripts/microbench_decode_attention.py);;
+      CMD=(env PYTHONPATH=/root/repo${PYTHONPATH:+:$PYTHONPATH} python scripts/microbench_decode_attention.py);;
     bench_8b)
       TMOS=4500; PAT='"value"'
       CMD=(env BENCH_ROUNDS=3 BENCH_MODEL=bcg-tpu/bench-8b
            ${INT8_FALLBACK[@]+"${INT8_FALLBACK[@]}"} python bench.py);;
     w4_probe)
       TMOS=1200; PAT='w4-kernel-probe OK'
-      CMD=(env PYTHONPATH=/root/repo python scripts/probe_w4_kernel.py);;
+      CMD=(env PYTHONPATH=/root/repo${PYTHONPATH:+:$PYTHONPATH} python scripts/probe_w4_kernel.py);;
     bench_14b)
       TMOS=5400; PAT='"value"'
       W4_FALLBACK=()

@@ -34,6 +34,14 @@ from bcg_tpu.ops.decode_attention import (
 CASES = [
     ("1b-shapes", 10, 16, 8, 128, 2048),
     ("8b-shapes", 10, 32, 8, 128, 4096),
+    # 14B (H=40, Hkv=8 -> GQA group 5) is deliberately ABSENT: its
+    # remote Mosaic compile crashes the tpu_compile_helper outright
+    # (exit 1 / hang, observed 2026-08-01), so running it here would
+    # poison the probe's verdict — and the watcher would then disable
+    # the kernel for the VALIDATED group-2/4 configs too.  The engine
+    # excludes non-power-of-two groups from the kernel path by
+    # construction (jax_engine GQA group guard); 14B serves decode
+    # through the XLA dequant fallback until the Mosaic issue is fixed.
     ("block512-path", 10, 32, 8, 128, 3584),
 ]
 

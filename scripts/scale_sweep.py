@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""One-agent-per-chip scale sweep through the REAL serving stack.
+
+BASELINE config 4's shape ("Scale sweep: 16/32/64 agents, one-agent-per-
+chip on v5e-64"): N agents play a full Byzantine Consensus Game through
+``BCGSimulation`` -> ``JaxEngine(dp=N)`` — every decision/vote batch is
+one [N, ...] device batch SHARDED one-row-per-chip over the mesh's `dp`
+axis (engine._put_batch), and the broadcast/receive phase is one
+``all_gather`` over the same mesh (--spmd-exchange path).  The reference
+runs its scale sweep by queueing agents through one vLLM server
+(vllm_agent.py batching); here agent parallelism IS the mesh layout.
+
+Hermetic run on a virtual device mesh (no TPU pod needed):
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=16 \
+        python scripts/scale_sweep.py --agents 16 --rounds 4
+
+Emits ONE JSON line: {agents, devices, dp, rounds, rounds_per_sec,
+decisions_per_sec, dp_batches, dp_bypasses, sp_bypasses, consensus}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--agents", type=int, default=16,
+                    help="total agents; byzantine count is agents//4")
+    ap.add_argument("--rounds", type=int, default=4, help="max game rounds")
+    ap.add_argument("--model", default="bcg-tpu/tiny-test")
+    ap.add_argument("--max-model-len", type=int, default=512)
+    ap.add_argument("--decide-tokens", type=int, default=48)
+    ap.add_argument("--vote-tokens", type=int, default=32)
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args()
+
+    # Honour a virtual-device request BEFORE backend init (this
+    # container's axon sitecustomize force-registers the TPU platform,
+    # so the env var alone is not enough — same dance as
+    # __graft_entry__.dryrun_multichip).
+    if "xla_force_host_platform_device_count" in os.environ.get("XLA_FLAGS", ""):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    n_dev = len(jax.devices())
+    dp = next(d for d in range(min(args.agents, n_dev), 0, -1)
+              if args.agents % d == 0)
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from bcg_tpu.config import BCGConfig
+    from bcg_tpu.runtime.orchestrator import BCGSimulation
+
+    base = BCGConfig()
+    n_byz = args.agents // 4
+    cfg = dataclasses.replace(
+        base,
+        game=dataclasses.replace(
+            base.game, num_honest=args.agents - n_byz, num_byzantine=n_byz,
+            max_rounds=args.rounds, seed=args.seed,
+        ),
+        network=dataclasses.replace(base.network, spmd_exchange=True),
+        engine=dataclasses.replace(
+            base.engine, backend="jax", model_name=args.model,
+            max_model_len=args.max_model_len, data_parallel_size=dp,
+        ),
+        llm=dataclasses.replace(
+            base.llm, max_tokens_decide=args.decide_tokens,
+            max_tokens_vote=args.vote_tokens,
+        ),
+        metrics=dataclasses.replace(
+            base.metrics, save_results=False, generate_plots=False,
+        ),
+    )
+    sim = BCGSimulation(config=cfg)
+    try:
+        stats = sim.run()
+    finally:
+        sim.close()
+    perf = sim.profiler.summary()
+    eng = sim.engine
+    row = {
+        "agents": args.agents,
+        "devices": n_dev,
+        "dp": dp,
+        "model": args.model,
+        "rounds": stats["total_rounds"],
+        "rounds_per_sec": round(perf["rounds_per_sec"], 4),
+        "decisions_per_sec": round(perf["decisions_per_sec"], 4),
+        "dp_batches": eng.dp_batches,
+        "dp_bypasses": eng.dp_bypasses,
+        "sp_bypasses": eng.sp_bypasses,
+        "spmd_mesh_dp": (sim._spmd_mesh.shape.get("dp")
+                         if sim._spmd_mesh is not None else None),
+        "consensus": stats["consensus_reached"],
+    }
+    print(json.dumps(row))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -89,6 +89,7 @@ def test_round_trip_exact(hf_checkpoint, monkeypatch, tmp_path, mode):
             _assert_leaf_equal(la[k], lb[k], f"layers.{i}.{k}")
 
 
+@pytest.mark.slow
 def test_convert_cli_and_engine_boot(hf_checkpoint, monkeypatch, tmp_path):
     monkeypatch.setenv("BCG_TPU_CHECKPOINT_DIR", os.path.dirname(hf_checkpoint))
     art = str(tmp_path / "art")

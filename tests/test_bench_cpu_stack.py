@@ -27,6 +27,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
            "interim local runs (default ON — this is the 8B-path "
            "insurance the driver's suite must keep)",
 )
+@pytest.mark.slow
 def test_bench_8b_flag_stack_on_cpu():
     env = dict(
         os.environ,

@@ -177,6 +177,7 @@ class TestConcurrentSimulations:
         assert max(inner.calls) > 4
 
 
+@pytest.mark.slow
 class TestConcurrentGamesUnderMesh:
     def test_two_games_share_a_tp2_engine(self):
         """BENCH_CONCURRENCY on a pod slice: two lockstep games merge
@@ -264,6 +265,7 @@ class TestRetryDesyncStress:
         assert all("consensus_reached" in o["metrics"] for o in outs)
 
 
+@pytest.mark.slow
 class TestRealEngineIntegration:
     def test_two_concurrent_games_on_jax_engine(self):
         """Full-stack check: two simulation threads share one REAL JaxEngine

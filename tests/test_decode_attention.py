@@ -141,6 +141,7 @@ def test_fully_masked_rows_finite():
     assert np.isfinite(np.asarray(out)).all()
 
 
+@pytest.mark.slow
 class TestInt8CacheEndToEnd:
     def test_decode_logits_close_to_bf16(self):
         import jax

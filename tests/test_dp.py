@@ -1,0 +1,197 @@
+"""Data-parallel (agent-parallel) engine batching.
+
+The mesh's `dp` axis shards game batches one-row-per-device-slice
+(BASELINE config 4's one-agent-per-chip scale sweep; the reference's
+agent parallelism is vLLM server-side batching, vllm_agent.py:417-455).
+Covers: _pad_rows dp alignment, _put_batch/_put_cache placement,
+dp=1-equivalence of results, dp x tp x sp composition, and — via a
+16-virtual-device subprocess — the full 16-agent game through
+JaxEngine(dp=16) + --spmd-exchange.
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+from bcg_tpu.config import BCGConfig
+from bcg_tpu.engine.interface import create_engine
+from bcg_tpu.engine.jax_engine import _pad_rows
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SCHEMA = {
+    "type": "object",
+    "properties": {"value": {"type": "integer"}},
+    "required": ["value"],
+}
+
+
+def _engine(dp=1, tp=1, sp=1, **kw):
+    base = BCGConfig()
+    return create_engine(dataclasses.replace(
+        base.engine, backend="jax", model_name="bcg-tpu/tiny-test",
+        max_model_len=512, data_parallel_size=dp,
+        tensor_parallel_size=tp, sequence_parallel_size=sp, **kw,
+    ))
+
+
+class TestPadRows:
+    def test_multiple_aligns_up(self):
+        real_B, B, rows = _pad_rows(["a", "b", "c"], multiple=4)
+        assert (real_B, B) == (3, 4)
+        assert rows == ["a", "b", "c", "a"]
+
+    def test_multiple_beyond_pow2(self):
+        # 3 rows pow2-pad to 4, then align to dp=16.
+        real_B, B, rows = _pad_rows(["a", "b", "c"], multiple=16)
+        assert (real_B, B) == (3, 16)
+        assert len(rows) == 16
+
+    def test_exact_multiple_untouched(self):
+        real_B, B, rows = _pad_rows(list("abcdefgh") * 2, multiple=16)
+        assert (real_B, B) == (16, 16)
+
+    def test_default_is_pow2_only(self):
+        real_B, B, rows = _pad_rows(["a", "b", "c"])
+        assert (real_B, B) == (3, 4)
+
+
+class TestPlacement:
+    def test_put_batch_shards_over_dp(self):
+        eng = _engine(dp=4)
+        x = eng._put_batch(np.zeros((8, 6), np.float32))
+        spec = x.sharding.spec
+        assert spec[0] == "dp"
+        assert all(s is None for s in spec[1:])
+
+    def test_put_batch_indivisible_falls_back(self):
+        eng = _engine(dp=4)
+        x = eng._put_batch(np.zeros((3, 6), np.float32))
+        # Replicated placement, no crash, no counter bump (single-row
+        # prefix-entry builds take this path by design).
+        assert eng.dp_bypasses == 0
+        np.testing.assert_array_equal(np.asarray(x), np.zeros((3, 6)))
+
+    def test_fresh_cache_allocated_dp_sharded(self):
+        eng = _engine(dp=4)
+        cache = eng._init_cache_sharded(4, 64)
+        leaf = cache[0]["k"]
+        assert leaf.sharding.spec[0] == "dp"
+
+    def test_cache_tree_sharding_layouts(self):
+        """kv_cache_tree_sharding is the ONE place the cache mesh layout
+        lives (engine fresh-cache init and the _assemble_cache
+        constraint both consume it): pin its per-layout specs."""
+        from jax.sharding import PartitionSpec as P
+
+        from bcg_tpu.models.transformer import init_kv_cache
+        from bcg_tpu.parallel.mesh import build_mesh
+        from bcg_tpu.parallel.sharding import kv_cache_tree_sharding
+
+        eng = _engine(dp=4)
+        mesh = build_mesh(dp=4, tp=1, sp=1)
+        spec = eng.spec
+        plain = kv_cache_tree_sharding(
+            mesh, jax.eval_shape(lambda: init_kv_cache(spec, 4, 64)))
+        assert plain[0]["k"].spec == P("dp", None, None, None)
+        stacked = kv_cache_tree_sharding(
+            mesh,
+            jax.eval_shape(lambda: init_kv_cache(spec, 4, 64, stacked=True)),
+            stacked=True)
+        assert stacked["k"].spec == P(None, "dp", None, None, None)
+        quant = kv_cache_tree_sharding(
+            mesh,
+            jax.eval_shape(
+                lambda: init_kv_cache(spec, 4, 64, quantized=True)),
+            quantized=True)
+        assert quant[0]["k"].spec == P("dp", None, None, None)
+        assert quant[0]["k_scale"].spec == P("dp", None, None)
+
+    def test_cache_tree_sharding_guards_indivisible_axes(self):
+        from jax.sharding import PartitionSpec as P
+
+        from bcg_tpu.models.transformer import init_kv_cache
+        from bcg_tpu.parallel.mesh import build_mesh
+        from bcg_tpu.parallel.sharding import kv_cache_tree_sharding
+
+        eng = _engine(dp=1)
+        mesh = build_mesh(dp=1, tp=2, sp=2)
+        spec = eng.spec
+        # S=66 not divisible by sp=2? 66 % 2 == 0 — use 65 for the
+        # indivisible case and Hkv vs tp=2 from the spec itself.
+        tree = kv_cache_tree_sharding(
+            mesh, jax.eval_shape(lambda: init_kv_cache(spec, 3, 65)))
+        sp_ax, tp_ax = tree[0]["k"].spec[1], tree[0]["k"].spec[2]
+        assert sp_ax is None  # 65 % 2 != 0 -> replicated, not crashed
+        assert tp_ax == ("tp" if spec.num_kv_heads % 2 == 0 else None)
+
+
+class TestDpGeneration:
+    def test_dp4_matches_dp1(self):
+        rows = [("sys", f"agent {i}: pick a value", SCHEMA) for i in range(4)]
+        eng4 = _engine(dp=4)
+        out4 = eng4.batch_generate_json(rows, temperature=0.0, max_tokens=24)
+        assert eng4.dp_batches >= 1
+        assert eng4.dp_bypasses == 0
+        eng1 = _engine(dp=1)
+        out1 = eng1.batch_generate_json(rows, temperature=0.0, max_tokens=24)
+        assert out4 == out1
+
+    def test_small_batch_pads_to_dp(self):
+        # 2 rows pad up to dp=4; results for real rows are unaffected.
+        rows = [("sys", f"agent {i}: value?", SCHEMA) for i in range(2)]
+        eng = _engine(dp=4)
+        out = eng.batch_generate_json(rows, temperature=0.0, max_tokens=24)
+        assert len(out) == 2
+        assert eng.dp_batches >= 1
+        assert eng.dp_bypasses == 0
+
+    def test_dp_tp_sp_composition(self):
+        # 8 virtual devices: dp=2 x tp=2 x sp=2 — the engine shards
+        # batch, heads, and sequence at once, and results still match
+        # the unsharded engine.
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 virtual devices")
+        rows = [("sys", f"agent {i}: pick a value", SCHEMA) for i in range(4)]
+        eng = _engine(dp=2, tp=2, sp=2)
+        out = eng.batch_generate_json(rows, temperature=0.0, max_tokens=24)
+        assert eng.dp_batches >= 1
+        assert eng.dp_bypasses == 0
+        assert eng.sp_bypasses == 0
+        eng1 = _engine(dp=1)
+        assert out == eng1.batch_generate_json(
+            rows, temperature=0.0, max_tokens=24
+        )
+
+
+@pytest.mark.slow
+class TestScaleSweep16:
+    def test_16_agents_one_per_chip(self):
+        """BASELINE config 4's shape, hermetically: 16 agents through
+        the REAL engine over a 16-virtual-device mesh, one agent per
+        device slice (dp=16), SPMD value exchange, full game."""
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=16"
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("BCG_TPU_SCAN_LAYERS", None)
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "scale_sweep.py"),
+             "--agents", "16", "--rounds", "2"],
+            capture_output=True, text=True, timeout=900, env=env, cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        row = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert row["devices"] == 16
+        assert row["dp"] == 16
+        assert row["spmd_mesh_dp"] == 16
+        assert row["rounds"] >= 1
+        assert row["dp_batches"] >= 2 * row["rounds"]  # decide + vote
+        assert row["dp_bypasses"] == 0
+        assert row["rounds_per_sec"] > 0

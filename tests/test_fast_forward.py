@@ -79,6 +79,7 @@ def _engines():
     )
 
 
+@pytest.mark.slow
 class TestGreedyEquivalence:
     def test_vote_and_decision_outputs_identical(self):
         std, ff = _engines()

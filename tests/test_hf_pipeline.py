@@ -252,6 +252,7 @@ def mistral_checkpoint(tmp_path_factory):
     return build_checkpoint(MISTRAL, out_dir=str(root / "bcg-hf--tiny-mistral"))
 
 
+@pytest.mark.slow
 class TestLlama3Family:
     def test_detection_template_and_seam(self, llama3_checkpoint):
         from bcg_tpu.engine.chat_template import (
@@ -288,6 +289,7 @@ class TestLlama3Family:
         _run_short_game(LLAMA3, n_honest=2, n_byz=1, max_rounds=1)
 
 
+@pytest.mark.slow
 class TestMistralSPFamily:
     def test_detection_and_template(self, mistral_checkpoint):
         from bcg_tpu.engine.chat_template import (

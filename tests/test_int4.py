@@ -18,6 +18,8 @@ v5e chip.  Properties tested:
 * int4 trees stack for scan-over-layers and shard over a tp mesh.
 """
 
+import pytest
+
 import dataclasses
 
 import jax
@@ -108,6 +110,7 @@ class TestDenseInt4:
         )
         np.testing.assert_allclose(kernel, oracle, rtol=2e-2, atol=2e-1)
 
+    @pytest.mark.slow
     def test_kernel_14b_serving_dims_interpret(self):
         """The exact (in, out) dims bench_14b serves through the kernel
         (Qwen3-14B w_gate/w_up: 5120 -> 17408; decode rows ~ 10 agents):
@@ -145,6 +148,7 @@ class TestDenseInt4:
 
 
 class TestInt4Model:
+    @pytest.mark.slow
     def test_logits_track_bf16(self):
         spec = spec_for_model("bcg-tpu/tiny-test")
         params = init_params(spec, jax.random.PRNGKey(0))
@@ -176,6 +180,7 @@ class TestInt4Model:
         assert qparams["embed"].dtype == jnp.bfloat16
 
 
+@pytest.mark.slow
 class TestInt4Engine:
     def test_guided_json_still_valid(self):
         engine = JaxEngine(EngineConfig(
@@ -193,6 +198,7 @@ class TestInt4Engine:
         engine.shutdown()
 
 
+@pytest.mark.slow
 class TestInt4Sharding:
     def test_shards_over_tp_mesh(self):
         from bcg_tpu.parallel.mesh import build_mesh
@@ -257,6 +263,7 @@ class TestVmemBudget:
                 assert working <= 14 * 1024 * 1024
 
 
+@pytest.mark.slow
 class TestStackedModeGuard:
     """Sharing a STACKED pre-quantized tree into an engine whose
     configured quantization mode differs must raise, exactly like the

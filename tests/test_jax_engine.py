@@ -44,6 +44,7 @@ DECISION_SCHEMA = {
 
 
 class TestMaxNumSeqs:
+    @pytest.mark.slow
     def test_oversized_batch_chunks(self, monkeypatch):
         engine = JaxEngine(EngineConfig(
             backend="jax", model_name="bcg-tpu/tiny-test", max_model_len=1024,
@@ -87,6 +88,7 @@ class TestHbmProvisioner:
         assert engine.provision_chunk_events == 0
         engine.shutdown()
 
+    @pytest.mark.slow
     def test_oversized_batch_chunks_under_tight_limit(self, monkeypatch):
         engine = self._engine()
         parts = [("sys ", "", f"user {i}") for i in range(4)]
@@ -100,7 +102,9 @@ class TestHbmProvisioner:
         )
         cap = engine._provisioned_row_cap(parts, [24] * 4)
         assert cap is not None and 1 <= cap < 4
-        assert engine.provision_chunk_events == 1
+        # The chunk-event counter bumps when the cap actually splits a
+        # batch (in _run_guided), not when the cap is merely derived.
+        assert engine.provision_chunk_events == 0
         # End to end: the oversized batch still answers every row.
         calls = []
         orig = engine._decode_batch
@@ -116,6 +120,8 @@ class TestHbmProvisioner:
         assert all(o.get("decision") in ("stop", "continue") for o in out)
         assert all(c <= cap for c in calls)
         assert len(calls) >= 2
+        assert engine.provision_chunk_events >= 1, \
+            "the provisioner-forced split must be counted"
         engine.shutdown()
 
 
@@ -205,6 +211,7 @@ class TestGuidedGeneration:
         assert res[0].get("decision") in ("stop", "continue") or "error" in res[0]
 
 
+@pytest.mark.slow
 class TestSimulationOnJaxEngine:
     @pytest.mark.parametrize("tp", [1, 2])
     def test_full_game_on_tiny_model(self, tp):
@@ -284,6 +291,7 @@ class TestGuaranteedParse:
         assert isinstance(out[0], dict)
 
 
+@pytest.mark.slow
 class TestChunkedPrefill:
     VOTE_SCHEMA = {
         "type": "object",
@@ -627,6 +635,7 @@ class TestEngineUnderMesh:
         )
         eng.shutdown()
 
+    @pytest.mark.slow
     def test_long_context_serving_via_sp(self):
         """An ~8K-byte-token prompt served end-to-end under sp=4: ring
         prefill shards the long prompt's activations, decode attends the

@@ -361,6 +361,7 @@ class TestSequenceParallelPrefill:
                 a, b = a[:, vmask], b[:, vmask]
             np.testing.assert_allclose(a, b, rtol=5e-2, atol=6e-2)
 
+    @pytest.mark.slow
     def test_chunked_ring_matches_one_pass_ring(self):
         """prefill_chunk_at's ring branch (chunk attends the WHOLE
         sp-sharded cache) must reproduce one-pass prefill_sp: same final

@@ -7,6 +7,8 @@ and the engine must produce identical-quality guided JSON with the
 feature on or off.
 """
 
+import pytest
+
 import dataclasses
 
 import jax
@@ -73,6 +75,7 @@ class TestSplitPrefillMatchesFull:
             atol=0.08 if quantized_kv else 0.02,
         )
 
+    @pytest.mark.slow
     def test_bf16_cache(self):
         self._run(quantized_kv=False)
 

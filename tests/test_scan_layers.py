@@ -83,6 +83,7 @@ def test_decode_step_equivalence(params, stacked):
     np.testing.assert_allclose(logits_l, logits_s, rtol=6e-2, atol=6e-2)
 
 
+@pytest.mark.slow
 def test_decode_chunk_equivalence(params, stacked):
     tokens, valid = _prompt()
     B, L = tokens.shape
@@ -156,6 +157,7 @@ def test_stacked_params_shard_on_mesh(stacked):
     assert spec_axes[-1] == "tp"
 
 
+@pytest.mark.slow
 def test_engine_greedy_equivalence_scan_vs_unrolled():
     """Whole-engine proof: guided greedy generation is identical with
     scan_layers on and off (same schema, same prompt, temperature 0)."""

@@ -167,6 +167,7 @@ class TestOrchestratorGating:
         assert stats_a["consensus_value"] == stats_b["consensus_value"]
 
 
+@pytest.mark.slow
 class TestEngineSharedCore:
     SCHEMA = {
         "type": "object",
