@@ -260,9 +260,14 @@ class JaxEngine(InferenceEngine):
         # Mosaic compile outright (tpu_compile_helper exit 1, 2026-08-01)
         # with no recoverable error text, so non-power-of-two groups
         # take the XLA dequant fallback BY CONSTRUCTION instead of
-        # discovering the crash minutes into a 14B boot.
+        # discovering the crash minutes into a 14B boot.  The wrappers
+        # now pad such groups to pow2_rows (ops/decode_attention.py);
+        # flip this guard to accept them once the probe's
+        # "14b-group5-padded" INFO case records an OK on hardware.
+        from bcg_tpu.ops.decode_attention import pow2_rows
+
         group = self.spec.num_heads // max(self.spec.num_kv_heads, 1)
-        group_ok = group & (group - 1) == 0 and group <= 8
+        group_ok = pow2_rows(group) == group and group <= 8
         if not group_ok:
             int8_kernel_off = True
         if self.kv_quantized and on_tpu_aligned and not int8_kernel_off:
@@ -508,11 +513,12 @@ class JaxEngine(InferenceEngine):
         # a disabled cache for a whole round once.
         self.sp_bypasses = 0
         self._sp_bypass_warned = False
-        # Calls that fell back from a configured data-parallel (dp)
-        # batch sharding — only reachable for a batch whose padded size
-        # doesn't divide dp, which _pad_rows(multiple=dp) rules out for
-        # every engine-built batch; counted + warned-once like sp.
-        # dp_batches counts batches that actually ran dp-sharded.
+        # Calls that fell back from configured data-parallel (dp) batch
+        # sharding — reachable when the concurrent-row cap
+        # (max_num_seqs / the HBM provisioner) is tighter than dp itself
+        # (_dp_mult drops the alignment; the batch runs replicated).  A
+        # config conflict worth surfacing, so it is counted + warned
+        # once like sp.  dp_batches counts batches that ran dp-sharded.
         self.dp_bypasses = 0
         self._dp_bypass_warned = False
         self.dp_batches = 0
@@ -1515,10 +1521,12 @@ class JaxEngine(InferenceEngine):
 
     def _note_dp_bypass(self, reason: str) -> None:
         """Count (and warn once about) a batch that fell back from the
-        configured data-parallel sharding.  Unreachable for engine-built
-        batches (_pad_rows aligns to dp); kept loud for the same reason
-        as _note_sp_bypass — silent disengagement of a configured
-        optimization once hid a disabled cache for a whole round."""
+        configured data-parallel sharding.  Reachable when the row cap
+        is tighter than dp (_dp_mult returns 1 and the batch runs
+        replicated) — a config conflict, not a sharding regression;
+        loud for the same reason as _note_sp_bypass: silent
+        disengagement of a configured optimization once hid a disabled
+        cache for a whole round."""
         self.dp_bypasses += 1
         if not self._dp_bypass_warned:
             import warnings
@@ -1547,11 +1555,9 @@ class JaxEngine(InferenceEngine):
             self._dp_devices > 1
             and x.shape[0] % self._dp_devices == 0
         ):
-            from jax.sharding import NamedSharding, PartitionSpec as P
+            from bcg_tpu.parallel.sharding import batch_sharding
 
-            spec = [None] * x.ndim
-            spec[0] = "dp"
-            return jax.device_put(x, NamedSharding(self.mesh, P(*spec)))
+            return jax.device_put(x, batch_sharding(self.mesh))
         return jnp.asarray(x)
 
     def _init_cache_sharded(self, B: int, S: int):
@@ -1700,8 +1706,9 @@ class JaxEngine(InferenceEngine):
         max_new = max(budgets)
         if self._dp_devices > 1:
             if B % self._dp_devices:
-                # Unreachable for engine-built batches (_pad_rows aligns
-                # to dp); loud, not silent, if a future path regresses.
+                # Reached when the row cap is tighter than dp (_dp_mult
+                # dropped the alignment) — or, loudly, if a future batch
+                # path forgets to align.
                 self._note_dp_bypass(
                     f"batch size {B} not divisible by dp={self._dp_devices}"
                 )

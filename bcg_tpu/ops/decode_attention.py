@@ -202,6 +202,15 @@ def _quantized_attention(qg, kp, vp, ksp, vsp, mp, scale, block_s, interpret):
     )(qg, kp, vp, ksp, vsp, mp)
 
 
+def pow2_rows(group: int) -> int:
+    """Query-row count the int8 kernels dispatch for a GQA group: the
+    group itself when it is a power of two, else the next power of two
+    (the wrappers zero-pad the extra rows and slice them away).  The
+    engine's kernel-dispatch guard and both wrapper pad sites share this
+    ONE definition so the validated-set rule cannot drift."""
+    return group if group & (group - 1) == 0 else 1 << group.bit_length()
+
+
 def _pad_s(x, block_s, axis=1, value=0):
     pad = (-x.shape[axis]) % block_s
     if pad == 0:
@@ -230,8 +239,17 @@ def decode_attention(
     if quantized:
         Hkv = k.shape[1]
         group = H // Hkv
+        # Non-power-of-two GQA groups (14B: H=40/Hkv=8 -> 5) pad their
+        # query rows up to the next power of two — the kernel then only
+        # ever sees the row counts the hardware probe validates (2/4/8),
+        # and the padded rows' outputs are sliced away.  Decode streams
+        # the CACHE, so extra q rows cost MXU work only, not HBM.
+        g2 = pow2_rows(group)
+        qg = q.reshape(B, Hkv, group, Dh)
+        if g2 != group:
+            qg = jnp.pad(qg, ((0, 0), (0, 0), (0, g2 - group), (0, 0)))
         out = _quantized_attention(
-            q.reshape(B, Hkv, group, Dh),
+            qg,
             _pad_s(k, block_s, axis=2),
             _pad_s(v, block_s, axis=2),
             _pad_s(k_scale, block_s, axis=2),
@@ -239,6 +257,8 @@ def decode_attention(
             _pad_s(mask, block_s, axis=1)[:, None, :],
             scale, block_s, interpret,
         )
+        if g2 != group:
+            out = out[:, :, :group]
         return out.reshape(B, H, Dh)
     S, Hkv = k.shape[1], k.shape[2]
     kp = _pad_s(k, block_s)
@@ -308,13 +328,17 @@ def chunk_decode_attention(
         # Pre-repeat the mask per query row (position-major: row
         # k*group+g = mask[k]) and lay q out [B, Hkv, K*group, Dh] to
         # match — no in-kernel repeat (Mosaic lowering of repeats is not
-        # relied upon anywhere).
-        mp = jnp.repeat(_pad_s(mask, block_s, axis=2), group, axis=1)
-        qg = (
-            q.reshape(B, K, Hkv, group, Dh)
-            .transpose(0, 2, 1, 3, 4)
-            .reshape(B, Hkv, K * group, Dh)
-        )
+        # relied upon anywhere).  Non-power-of-two groups pad to the
+        # next power of two (see decode_attention); padded rows reuse
+        # their chunk's mask and are sliced away below.
+        g2 = pow2_rows(group)
+        mp = jnp.repeat(_pad_s(mask, block_s, axis=2), g2, axis=1)
+        qg = q.reshape(B, K, Hkv, group, Dh)
+        if g2 != group:
+            qg = jnp.pad(
+                qg, ((0, 0), (0, 0), (0, 0), (0, g2 - group), (0, 0))
+            )
+        qg = qg.transpose(0, 2, 1, 3, 4).reshape(B, Hkv, K * g2, Dh)
         out = _quantized_attention(
             qg,
             _pad_s(k, block_s, axis=2),
@@ -323,8 +347,11 @@ def chunk_decode_attention(
             _pad_s(v_scale, block_s, axis=2),
             mp, scale, block_s, interpret,
         )
+        out = out.reshape(B, Hkv, K, g2, Dh)
+        if g2 != group:
+            out = out[:, :, :, :group]
         return (
-            out.reshape(B, Hkv, K, group, Dh)
+            out
             .transpose(0, 2, 1, 3, 4)
             .reshape(B, K, H, Dh)
         )

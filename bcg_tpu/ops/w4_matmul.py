@@ -42,7 +42,18 @@ def _w4_kernel(x_ref, q4_ref, gs_ref, o_ref, *, group, num_groups):
     """
     P = q4_ref.shape[0]
 
-    def body(j, acc):
+    # STATIC Python unroll over g-row groups: the earlier fori_loop
+    # carried a traced index into every slice, making them dynamic —
+    # including 1-sublane-row bf16 slices of gs_ref, which the remote
+    # Mosaic compiler crashed on (tpu_compile_helper exit 1) at every
+    # real shape while the single-group tiny case passed.  Static
+    # offsets (all multiples of the 128-row group) lower cleanly; the
+    # unrolled program is ~num_groups x 12 ops (<= ~900 at the 14B
+    # w_down strip), well within Mosaic program limits, and the
+    # in-kernel contraction still amortizes per-program overhead the
+    # way the fori version did.
+    acc = jnp.zeros(o_ref.shape, jnp.float32)
+    for j in range(num_groups):
         packed = q4_ref[pl.ds(j * group, group), :]
         # int32 shifts sign-extend reliably on the VPU; int8 shift
         # lowering is spottier across Mosaic versions.
@@ -63,10 +74,7 @@ def _w4_kernel(x_ref, q4_ref, gs_ref, o_ref, *, group, num_groups):
             x_high, w_high, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return acc
-
-    acc = jnp.zeros(o_ref.shape, jnp.float32)
-    o_ref[...] = jax.lax.fori_loop(0, num_groups, body, acc)
+    o_ref[...] = acc
 
 
 def _row_block(M: int, block_m: int) -> int:

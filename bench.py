@@ -603,6 +603,12 @@ def main() -> None:
                 else quant_env
             ),
             kv_cache_dtype=kv_dtype,
+            # BENCH_ATTENTION_IMPL=xla|pallas|auto: prefill-attention
+            # kernel override — the bisect knob for remote Mosaic
+            # compile failures at new model geometries (a 14B prefill
+            # compile crashed the helper on 2026-08-01; xla isolates
+            # whether the flash kernel is the crasher).
+            attention_impl=os.environ.get("BENCH_ATTENTION_IMPL", "auto"),
             decode_fast_forward=_env_flag("BENCH_FAST_FORWARD", True),
             guided_compact_json=_env_flag("BENCH_COMPACT_JSON", True),
             # Off by default for the large size class: weights + KV

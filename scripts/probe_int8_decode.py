@@ -34,15 +34,20 @@ from bcg_tpu.ops.decode_attention import (
 CASES = [
     ("1b-shapes", 10, 16, 8, 128, 2048),
     ("8b-shapes", 10, 32, 8, 128, 4096),
-    # 14B (H=40, Hkv=8 -> GQA group 5) is deliberately ABSENT: its
-    # remote Mosaic compile crashes the tpu_compile_helper outright
-    # (exit 1 / hang, observed 2026-08-01), so running it here would
-    # poison the probe's verdict — and the watcher would then disable
-    # the kernel for the VALIDATED group-2/4 configs too.  The engine
-    # excludes non-power-of-two groups from the kernel path by
-    # construction (jax_engine GQA group guard); 14B serves decode
-    # through the XLA dequant fallback until the Mosaic issue is fixed.
     ("block512-path", 10, 32, 8, 128, 3584),
+]
+
+# INFORMATIONAL cases: validated-if-they-pass, but failures do NOT gate
+# the probe's verdict — the watcher's INT8_FALLBACK must never disable
+# the kernel for the VALIDATED group-2/4 configs because an
+# experimental geometry regressed.  14B (H=40, Hkv=8 -> GQA group 5):
+# the wrapper now pads query rows to the next power of two
+# (ops/decode_attention.py), so the kernel sees rows=8 — a validated
+# count — but the padded dispatch itself has not run on hardware yet;
+# the engine's GQA group guard keeps 14B on the XLA dequant fallback
+# until this case records an OK.
+INFO_CASES = [
+    ("14b-group5-padded", 10, 40, 8, 128, 4096),
 ]
 
 
@@ -74,7 +79,8 @@ def main() -> None:
         raise SystemExit(1)
     rng = np.random.default_rng(0)
     ok = True
-    for name, B, H, Hkv, Dh, S in CASES:
+    for name, B, H, Hkv, Dh, S in CASES + INFO_CASES:
+        gating = (name, B, H, Hkv, Dh, S) in CASES
         q = jnp.asarray(rng.standard_normal((B, H, Dh)) * 0.3, jnp.bfloat16)
         k_bf = jnp.asarray(rng.standard_normal((B, Hkv, S, Dh)) * 0.3, jnp.float32)
         v_bf = jnp.asarray(rng.standard_normal((B, Hkv, S, Dh)) * 0.3, jnp.float32)
@@ -115,13 +121,18 @@ def main() -> None:
                 denom = float(np.max(np.abs(ref))) + 1e-9
                 rel = err / denom
                 good = rel < 5e-2  # bf16 q + f32-accum reorder tolerance
-                if not good:
+                if not good and gating:
                     ok = False
+                tag = "OK" if good else "MISMATCH"
+                if not gating:
+                    tag = "info-" + tag
                 print(f"  {name}/{kind:<6s} max|d|={err:.4f} rel={rel:.3e} "
-                      f"{'OK' if good else 'MISMATCH'}")
+                      f"{tag}")
             except Exception as exc:  # noqa: BLE001 — a probe reports, not crashes
-                ok = False
-                print(f"  {name}/{kind:<6s} FAILED: "
+                if gating:
+                    ok = False
+                print(f"  {name}/{kind:<6s} "
+                      f"{'FAILED' if gating else 'info-FAILED'}: "
                       f"{type(exc).__name__}: {str(exc)[:200]}")
     print("int8-decode-probe OK" if ok else "int8-decode-probe FAILED")
     raise SystemExit(0 if ok else 1)

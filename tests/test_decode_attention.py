@@ -232,3 +232,41 @@ class TestServing8BShapes:
                                      block_s=None, interpret=True)
         err = np.abs(np.asarray(out) - np.asarray(ref)).max()
         assert err < 0.05, err
+
+    def test_int8_14b_group5_pads_rows(self):
+        """14B dims (H=40, Hkv=8 -> GQA group 5): the wrapper pads the
+        query-row axis to the next power of two so the kernel only sees
+        probe-validated row counts; outputs must still match the
+        unpadded reference exactly (padded rows sliced away)."""
+        B, S, H, Hkv, Dh = 2, 2048, 40, 8, 128
+        q, k, v, mask = _case(jax.random.PRNGKey(13), B, S, H, Hkv, Dh)
+        scale = 1.0 / np.sqrt(Dh)
+        ref = _reference(q, k, v, mask, scale)
+        kq, ks = quantize_kv(k)
+        vq, vs = quantize_kv(v)
+        out = decode_attention(q, kq.transpose(0, 2, 1, 3),
+                               vq.transpose(0, 2, 1, 3), mask, scale,
+                               k_scale=ks.transpose(0, 2, 1),
+                               v_scale=vs.transpose(0, 2, 1),
+                               block_s=None, interpret=True)
+        assert out.shape == (B, H, Dh)
+        err = np.abs(np.asarray(out) - np.asarray(ref)).max()
+        assert err < 0.05, err
+
+    def test_chunk_int8_14b_group5_pads_rows(self):
+        from bcg_tpu.ops.decode_attention import chunk_decode_attention
+
+        B, K, S, H, Hkv, Dh = 2, 4, 2048, 40, 8, 128
+        q, k, v, mask = _chunk_case(jax.random.PRNGKey(14), B, K, S, H, Hkv, Dh)
+        scale = 1.0 / np.sqrt(Dh)
+        ref = _xla_attention(q, k, v, mask, scale)
+        kq, ks = quantize_kv(k)
+        vq, vs = quantize_kv(v)
+        out = chunk_decode_attention(q, kq.transpose(0, 2, 1, 3),
+                                     vq.transpose(0, 2, 1, 3), mask, scale,
+                                     k_scale=ks.transpose(0, 2, 1),
+                                     v_scale=vs.transpose(0, 2, 1),
+                                     block_s=None, interpret=True)
+        assert out.shape == (B, K, H, Dh)
+        err = np.abs(np.asarray(out) - np.asarray(ref)).max()
+        assert err < 0.05, err
