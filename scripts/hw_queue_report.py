@@ -33,7 +33,19 @@ def _load(path: str):
 
 
 def main() -> None:
-    out_dir = sys.argv[1] if len(sys.argv) > 1 else "results/hw_r4"
+    if len(sys.argv) > 1:
+        out_dir = sys.argv[1]
+    else:
+        # Newest round by NUMERIC suffix, directories only (lexicographic
+        # max would pick hw_r9 over hw_r10, or a stray hw_r5.tar file).
+        rounds = [
+            d for d in glob.glob("results/hw_r*")
+            if os.path.isdir(d) and d.rsplit("hw_r", 1)[1].isdigit()
+        ]
+        out_dir = (
+            max(rounds, key=lambda d: int(d.rsplit("hw_r", 1)[1]))
+            if rounds else "results/hw_r4"
+        )
     names = sorted(
         os.path.basename(p)[:-5]
         for p in glob.glob(os.path.join(out_dir, "*.json"))
@@ -46,9 +58,21 @@ def main() -> None:
         if skip:
             skipped.append(name)
             continue
-        if not done or data is None:
+        if (
+            not isinstance(data, dict)
+            or (not done and "value" not in data and "aggregate" not in data)
+        ):
             pending.append(name)
             continue
+        if not done and data.get("error"):
+            # Failed attempt awaiting retry: a 0.0-value error JSON is
+            # not a measurement.
+            pending.append(f"{name} (failed: {str(data['error'])[:60]})")
+            continue
+        if not done:
+            # Parseable result without a stamp (e.g. a manually-renamed
+            # A/B arm like bench_int8kv_nokernel): report it, marked.
+            name += " (unstamped)"
         if "aggregate" in data:
             parity_blocks.append((name, data))
             continue

@@ -25,8 +25,8 @@ mkdir -p "$OUT"
 # A/Bs, with the long parity sweeps last — a short healthy window must
 # not be spent on minor A/Bs while the flagship claims starve.
 STEPS="bench_default int8_probe bench_int8kv bench_8b w4_probe bench_14b \
-bench_hf1b mb_prefill bench_w8a16 bench_bf16w bench_finesuffix \
-bench_conc2 art_convert bench_artifact mb_decode \
+bench_hf1b mb_prefill bench_w8a16 bench_8b_unroll bench_bf16w \
+bench_finesuffix bench_conc2 art_convert bench_artifact mb_decode \
 parity_q1-baseline parity_q1-full parity_q2"
 
 log() { echo "$(date -u +%H:%M:%S) $*" >> "$LOG"; }
@@ -98,6 +98,17 @@ step_spec() {
     bench_8b)
       TMOS=4500; PAT='"value"'
       CMD=(env BENCH_ROUNDS=3 BENCH_MODEL=bcg-tpu/bench-8b
+           ${INT8_FALLBACK[@]+"${INT8_FALLBACK[@]}"} python bench.py);;
+    bench_8b_unroll)
+      # Decode-overlap A/B: 8B decode measured 43% of the HBM roof vs
+      # 87.5% at 1B; scan-over-layers (forced ON for the large class to
+      # make the remote compile tractable) is the prime suspect — the
+      # unrolled form keeps better cache-update aliasing in the decode
+      # loop.  With the persistent compile cache warm from bench_8b the
+      # unrolled compile may now be affordable.
+      TMOS=4500; PAT='"value"'
+      CMD=(env BENCH_ROUNDS=3 BENCH_MODEL=bcg-tpu/bench-8b
+           BENCH_SCAN_LAYERS=0
            ${INT8_FALLBACK[@]+"${INT8_FALLBACK[@]}"} python bench.py);;
     w4_probe)
       TMOS=1200; PAT='w4-kernel-probe OK'

@@ -559,6 +559,34 @@ class TestEngineUnderMesh:
         assert out[1]["decision"] in ("stop", "continue")
         eng.shutdown()
 
+    @pytest.mark.slow
+    def test_maximal_composition_dp_tp_sp_quant_scan_int8kv(self):
+        """Every serving axis at once on the full 8-device virtual mesh:
+        int4 weights x int8 KV cache x scan-over-layers x dp=2 x tp=2 x
+        sp=2.  The quantized cache tree-shards over all three axes
+        (kv_cache_tree_sharding), batches dp-align and dp-place, ring
+        prefill + sp decode run inside the scan loop over physically
+        tp-split int4 leaves — the widest configuration any pod-slice
+        deployment of the 14B/32B presets would boot."""
+        eng = self._engine(
+            data_parallel_size=2, tensor_parallel_size=2,
+            sequence_parallel_size=2, quantization="int4",
+            kv_cache_dtype="int8", scan_layers=True, prefix_caching=False,
+        )
+        assert eng.mesh.shape == {"dp": 2, "tp": 2, "sp": 2}
+        out = eng.batch_generate_json(
+            [("You are honest.", "Pick a value.", DECISION_SCHEMA),
+             ("You vote.", "Stop or continue?", VOTE_SCHEMA)],
+            temperature=0.0, max_tokens=96,
+        )
+        assert eng.dp_batches >= 1 and eng.dp_bypasses == 0
+        assert eng.sp_bypasses == 0
+        for o in out:
+            assert "error" not in o, o
+        assert 0 <= out[0]["value"] <= 50
+        assert out[1]["decision"] in ("stop", "continue")
+        eng.shutdown()
+
     @pytest.mark.parametrize("quant", ["int8", "int4"])
     def test_quantized_scan_tp2_end_to_end(self, quant):
         """The pod-slice serving configuration for the reference's
