@@ -268,6 +268,12 @@ class JaxEngine(InferenceEngine):
 
         group = self.spec.num_heads // max(self.spec.num_kv_heads, 1)
         group_ok = pow2_rows(group) == group and group <= 8
+        if env_flag("BCG_TPU_ALLOW_PADDED_GROUP_KERNEL"):
+            # Hardware-A/B escape: accept non-power-of-two groups via
+            # the wrappers' row padding once the probe's
+            # "14b-group5-padded" INFO case records an OK — flips the
+            # kernel on without a code change.
+            group_ok = pow2_rows(group) <= 8
         if not group_ok:
             int8_kernel_off = True
         if self.kv_quantized and on_tpu_aligned and not int8_kernel_off:

@@ -27,7 +27,7 @@ mkdir -p "$OUT"
 STEPS="bench_default int8_probe bench_int8kv bench_8b w4_probe bench_14b \
 bench_hf1b mb_prefill bench_w8a16 bench_8b_unroll bench_bf16w \
 bench_finesuffix bench_conc2 art_convert bench_artifact mb_decode \
-parity_q1-baseline parity_q1-full parity_q2"
+bench_14b_kernel parity_q1-baseline parity_q1-full parity_q2"
 
 log() { echo "$(date -u +%H:%M:%S) $*" >> "$LOG"; }
 
@@ -50,6 +50,11 @@ step_spec() {
   INT8_FALLBACK=()
   if [ -e "$OUT/int8_probe.skip" ]; then
     INT8_FALLBACK=(BCG_TPU_DISABLE_INT8_DECODE_KERNEL=1)
+  fi
+  # W4 kernel fallback is shared by bench_14b and bench_14b_kernel.
+  W4_FALLBACK=()
+  if [ -e "$OUT/w4_probe.skip" ]; then
+    W4_FALLBACK=(BCG_TPU_DISABLE_W4_KERNEL=1)
   fi
   case $1 in
     bench_default)
@@ -115,12 +120,6 @@ step_spec() {
       CMD=(env PYTHONPATH=/root/repo${PYTHONPATH:+:$PYTHONPATH} python scripts/probe_w4_kernel.py);;
     bench_14b)
       TMOS=5400; PAT='"value"'
-      W4_FALLBACK=()
-      if [ -e "$OUT/w4_probe.skip" ]; then
-        # Kernel failed its hardware probe: serve 14B through the XLA
-        # dequant fallback instead of crashing on the same lowering bug.
-        W4_FALLBACK=(BCG_TPU_DISABLE_W4_KERNEL=1)
-      fi
       # Last-chance attempt (one failure already recorded): drop every
       # Pallas kernel — BENCH_ATTENTION_IMPL=xla takes the flash prefill
       # out of the picture too, so a kernel-specific remote Mosaic crash
@@ -134,6 +133,15 @@ step_spec() {
            ${W4_FALLBACK[@]+"${W4_FALLBACK[@]}"}
            ${INT8_FALLBACK[@]+"${INT8_FALLBACK[@]}"}
            ${XLA_LAST[@]+"${XLA_LAST[@]}"} python bench.py);;
+    bench_14b_kernel)
+      # Kernel-ON 14B arm: only meaningful once the padded group-5
+      # dispatch has hardware evidence (the probe's non-gating
+      # "14b-group5-padded" INFO case) and the fallback 14B number
+      # exists to compare against.  Guarded by run_step's pre-check.
+      TMOS=5400; PAT='"value"'
+      CMD=(env BENCH_ROUNDS=2 BENCH_MODEL=bcg-tpu/bench-14b
+           BCG_TPU_ALLOW_PADDED_GROUP_KERNEL=1
+           ${W4_FALLBACK[@]+"${W4_FALLBACK[@]}"} python bench.py);;
     parity_*)
       TMOS=5400; PAT='"aggregate"'
       CMD=(python -m bcg_tpu.experiments "${1#parity_}" --backend jax
@@ -155,6 +163,18 @@ run_step() {
     if [ ! -f checkpoints_q/bcg-hf--bench-1b/bcg_tpu_quantized.json ]; then
       touch "$OUT/$name.skip"
       log "SKIP $name: no quantized artifact on disk (art_convert skipped or wiped)"
+      return 0
+    fi
+  fi
+  # The kernel-ON 14B arm needs hardware evidence for the padded
+  # group-5 dispatch (both INFO cases OK) and the fallback number to
+  # compare against — otherwise it would just re-crash or re-measure.
+  if [ "$name" = bench_14b_kernel ]; then
+    if ! { [ -e "$OUT/bench_14b.done" ] \
+           && grep -q "14b-group5-padded/step.*info-OK" "$OUT/int8_probe.json" 2>/dev/null \
+           && grep -q "14b-group5-padded/chunk.*info-OK" "$OUT/int8_probe.json" 2>/dev/null; }; then
+      touch "$OUT/$name.skip"
+      log "SKIP $name: padded-group kernel lacks probe evidence or no fallback 14B number"
       return 0
     fi
   fi
