@@ -137,7 +137,7 @@ def _is_transient(exc: BaseException) -> bool:
 
 def _error_result(exc: BaseException, retried: bool) -> dict:
     tb = traceback.format_exception(type(exc), exc, exc.__traceback__)
-    return {
+    out = {
         "metric": "agent_decisions_per_sec",
         "value": 0.0,
         "unit": "decisions/sec",
@@ -147,6 +147,38 @@ def _error_result(exc: BaseException, retried: bool) -> dict:
                     else "; not retried (non-transient)"),
         "traceback_tail": "".join(tb)[-1000:],
     }
+    # Honesty + provenance on outage: `value` stays 0.0 (this run
+    # measured nothing), but if the hardware-recovery watcher recorded a
+    # same-config result EARLIER (results/hw_r*/bench_default.json), cite
+    # it so a tunnel outage at the driver's bench minute doesn't erase
+    # the round's actual measured number from the record.
+    try:
+        import glob as _glob
+
+        rounds = [
+            d for d in _glob.glob("results/hw_r*")
+            if os.path.isdir(d) and d.rsplit("hw_r", 1)[1].isdigit()
+        ]
+        if rounds:
+            newest = max(rounds, key=lambda d: int(d.rsplit("hw_r", 1)[1]))
+            path = os.path.join(newest, "bench_default.json")
+            if os.path.exists(os.path.join(newest, "bench_default.done")):
+                with open(path) as f:
+                    prior = json.loads(f.read().strip().splitlines()[-1])
+                if prior.get("value"):
+                    out["watcher_recorded_this_round"] = {
+                        "note": "NOT this run's measurement — same-config "
+                                "result recorded by scripts/hw_watcher.sh "
+                                "earlier this round, cited because this "
+                                "run could not attach the accelerator",
+                        "source": path,
+                        "value": prior["value"],
+                        "unit": prior.get("unit"),
+                        "vs_baseline": prior.get("vs_baseline"),
+                    }
+    except Exception:
+        pass
+    return out
 
 
 # Engines built by attempts that later FAILED: the retry must free their
