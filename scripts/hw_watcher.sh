@@ -24,10 +24,17 @@ mkdir -p "$OUT"
 # probes they depend on), then the prefill-MFU attack, then the smaller
 # A/Bs, with the long parity sweeps last — a short healthy window must
 # not be spent on minor A/Bs while the flagship claims starve.
+# Round-5 reorder (post-flagship): default/8B/kernel-probe numbers are
+# BANKED (.done), so the next healthy window goes to the remaining
+# verdict asks in priority order — the kernel-ON int8 arm, the repaired
+# W4 probe, the 14B capacity number, the trained-BPE fixture bench, then
+# ONE hardware parity distribution (q2, the headline config) ahead of
+# the attribution microbenches and minor A/Bs; the two remaining parity
+# sweeps close the queue.
 STEPS="bench_default int8_probe bench_int8kv bench_8b w4_probe bench_14b \
-bench_hf1b mb_prefill bench_w8a16 bench_8b_unroll bench_bf16w \
+bench_hf1b parity_q2 mb_prefill bench_w8a16 bench_8b_unroll bench_bf16w \
 bench_finesuffix bench_conc2 art_convert bench_artifact mb_decode \
-bench_14b_kernel parity_q1-baseline parity_q1-full parity_q2"
+bench_14b_kernel parity_q1-baseline parity_q1-full"
 
 log() { echo "$(date -u +%H:%M:%S) $*" >> "$LOG"; }
 
