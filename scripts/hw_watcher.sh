@@ -31,7 +31,7 @@ mkdir -p "$OUT"
 # ONE hardware parity distribution (q2, the headline config) ahead of
 # the attribution microbenches and minor A/Bs; the two remaining parity
 # sweeps close the queue.
-STEPS="bench_default int8_probe bench_int8kv bench_8b w4_probe bench_14b \
+STEPS="bench_default int8_probe bench_int8kv bench_8b w4_probe flash_probe bench_14b \
 bench_hf1b parity_q2 mb_prefill bench_w8a16 bench_8b_unroll bench_bf16w \
 bench_finesuffix bench_conc2 art_convert bench_artifact mb_decode \
 bench_14b_kernel parity_q1-baseline parity_q1-full"
@@ -122,6 +122,9 @@ step_spec() {
       CMD=(env BENCH_ROUNDS=3 BENCH_MODEL=bcg-tpu/bench-8b
            BENCH_SCAN_LAYERS=0
            ${INT8_FALLBACK[@]+"${INT8_FALLBACK[@]}"} python bench.py);;
+    flash_probe)
+      TMOS=1500; PAT='flash-prefill-probe OK'
+      CMD=(env PYTHONPATH=/root/repo${PYTHONPATH:+:$PYTHONPATH} python scripts/probe_flash_prefill.py);;
     w4_probe)
       TMOS=1200; PAT='w4-kernel-probe OK'
       CMD=(env PYTHONPATH=/root/repo${PYTHONPATH:+:$PYTHONPATH} python scripts/probe_w4_kernel.py);;
