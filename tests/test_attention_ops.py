@@ -85,6 +85,28 @@ def test_pallas_kernel_interpret_mode():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
+def test_pallas_kernel_interpret_14b_chunk_dims():
+    """The 14B chunked-prefill geometry (H=40, GQA group 5) through the
+    production Pallas launch config in interpret mode — pins the MATH at
+    the exact shape scripts/probe_flash_prefill.py lowers on hardware,
+    so a probe failure isolates Mosaic lowering, not the kernel logic
+    (the same split the int8 serving-shape tests make)."""
+    from bcg_tpu.ops.attention import _pallas_flash
+
+    B, T, S, H, Hkv, Dh = 2, 128, 256, 40, 8, 128
+    q, k, v, mask, rv = _random_case(jax.random.PRNGKey(7), B, T, S, H, Hkv, Dh)
+    scale = 1.0 / np.sqrt(Dh)
+    ref = _xla_attention(q, k, v, mask, scale) * rv
+    out = _pallas_flash(
+        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3), mask, scale,
+        block_q=128, block_kv=128, interpret=True,
+    )
+    out = out.transpose(0, 2, 1, 3) * rv
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
 def test_pad_to():
     x = jnp.ones((2, 3))
     assert _pad_to(x, 1, 4).shape == (2, 4)
