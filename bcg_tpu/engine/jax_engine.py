@@ -47,7 +47,6 @@ from bcg_tpu.models.transformer import (
     decode_chunk,
     decode_step,
     init_kv_cache,
-    init_params,
     layers_stacked,
     prefill,
     prefill_chunk_at,
@@ -199,6 +198,20 @@ class JaxEngine(InferenceEngine):
     def __init__(self, config, mesh=None, params=None, spec: Optional[ModelSpec] = None):
         _enable_compilation_cache()
         self.config = config
+        # Boot-phase memory/timing breakdown (runtime/metrics.py):
+        # created FIRST so this boot owns metrics.LAST_BOOT_PHASES from
+        # its first instant — a boot that dies even before its first
+        # recorded phase (config validation, tokenizer) must not leave a
+        # previous attempt's breakdown to be misattributed.  Each phase
+        # records wall time + allocator readings, survives a mid-phase
+        # OOM (recorded `failed`), and is printed under BCG_TPU_TIMING /
+        # attached to bench JSON — so the next 14B boot failure names
+        # its phase instead of dying as a bare RESOURCE_EXHAUSTED.
+        from bcg_tpu.runtime.metrics import BootPhaseRecorder
+
+        self._boot = BootPhaseRecorder()
+        self.boot_phases = self._boot.phases
+        self._first_call_recorded = False
         self.spec = spec or spec_for_model(config.model_name)
         if self.spec is None:
             raise ValueError(
@@ -253,29 +266,28 @@ class JaxEngine(InferenceEngine):
         # Operational kill-switch (scripts/probe_int8_decode.py): if the
         # int8 kernels fail hardware lowering, serve through the dequant
         # fallback (slower, warned below) instead of crashing.
-        int8_kernel_off = env_flag("BCG_TPU_DISABLE_INT8_DECODE_KERNEL")
-        # GQA group-width guard: the kernels are hardware-validated at
-        # power-of-two groups (1B group 2, 8B group 4 — probe cases);
-        # the 14B preset's group 5 (H=40, Hkv=8) crashed the remote
-        # Mosaic compile outright (tpu_compile_helper exit 1, 2026-08-01)
-        # with no recoverable error text, so non-power-of-two groups
-        # take the XLA dequant fallback BY CONSTRUCTION instead of
-        # discovering the crash minutes into a 14B boot.  The wrappers
-        # now pad such groups to pow2_rows (ops/decode_attention.py);
-        # flip this guard to accept them once the probe's
-        # "14b-group5-padded" INFO case records an OK on hardware.
+        kill_switch = env_flag("BCG_TPU_DISABLE_INT8_DECODE_KERNEL")
+        # GQA group-width guard: power-of-two groups keep the kernel
+        # (hardware-validated at groups 2 and 4; wider pow2 groups are
+        # the same row-block dispatch — a `group <= 8` cap here once
+        # knocked them out too, ADVICE round-5 low); the 14B preset's
+        # group 5 (H=40, Hkv=8) crashed the remote Mosaic compile
+        # outright (tpu_compile_helper exit 1, 2026-08-01) with no
+        # recoverable error text, so NON-power-of-two groups take the
+        # XLA dequant fallback BY CONSTRUCTION instead of discovering
+        # the crash minutes into a 14B boot.  The wrappers now pad such
+        # groups to pow2_rows (ops/decode_attention.py).
         from bcg_tpu.ops.decode_attention import pow2_rows
 
         group = self.spec.num_heads // max(self.spec.num_kv_heads, 1)
-        group_ok = pow2_rows(group) == group and group <= 8
+        group_ok = pow2_rows(group) == group
         if env_flag("BCG_TPU_ALLOW_PADDED_GROUP_KERNEL"):
             # Hardware-A/B escape: accept non-power-of-two groups via
             # the wrappers' row padding once the probe's
             # "14b-group5-padded" INFO case records an OK — flips the
             # kernel on without a code change.
-            group_ok = pow2_rows(group) <= 8
-        if not group_ok:
-            int8_kernel_off = True
+            group_ok = True
+        int8_kernel_off = kill_switch or not group_ok
         if self.kv_quantized and on_tpu_aligned and not int8_kernel_off:
             self.decode_attention_impl = "pallas"
         else:
@@ -285,13 +297,16 @@ class JaxEngine(InferenceEngine):
         if self.kv_quantized and self.decode_attention_impl != "pallas":
             import warnings
 
+            # Cause attribution: the env kill-switch is checked FIRST —
+            # when both it and the group guard apply, the operator set
+            # the switch and the stated cause must be the actual cause.
             warnings.warn(
                 "int8 KV cache without the Pallas decode kernel ("
-                + ("GQA group width "
-                   f"{group} outside the kernel-validated set"
+                + ("BCG_TPU_DISABLE_INT8_DECODE_KERNEL is set"
+                   if kill_switch
+                   else "GQA group width "
+                   f"{group} is not a power of two (kernel-crashing set)"
                    if not group_ok
-                   else "BCG_TPU_DISABLE_INT8_DECODE_KERNEL is set"
-                   if int8_kernel_off
                    else "non-TPU backend or head_dim not a multiple of 128")
                 + "): the fallback dequantizes the whole cache per step, "
                 "which is SLOWER than bfloat16",
@@ -374,44 +389,49 @@ class JaxEngine(InferenceEngine):
         quant_mode = config.quantization  # None | "int8" | "int4"
         quantize = quant_mode is not None
         owns_params = params is None
-        if params is not None:
-            self.params = params
-        elif config.model_name.startswith("bcg-tpu/"):
-            # Hermetic presets: random weights (no checkpoint needed),
-            # quantized leaf-by-leaf as they are created — the same
-            # streaming the checkpoint loader does, so an 8B-class bench
-            # never holds the full bf16 tree (which alone OOMs a 16 GB
-            # chip).
-            from bcg_tpu.models.quantize import quantize_leaf_transform
+        with self._boot.phase("init_params"):
+            if params is not None:
+                self.params = params
+            elif config.model_name.startswith("bcg-tpu/"):
+                # Hermetic presets: BORN-SHARDED random weights (no
+                # checkpoint needed) — every leaf materializes through a
+                # jitted per-leaf initializer under its param_sharding
+                # with the quantize transform INSIDE the jit
+                # (models/loader.py init_random_params_sharded), so no
+                # full-precision leaf ever exists unsharded and a
+                # 14B-class bench boots within one chip's share of HBM.
+                from bcg_tpu.models.loader import init_random_params_sharded
+                from bcg_tpu.models.quantize import quantize_leaf_transform
 
-            self.params = init_params(
-                self.spec, jax.random.PRNGKey(0),
-                leaf_transform=quantize_leaf_transform(self.spec, quant_mode) if quantize else None,
-            )
-        else:
-            from bcg_tpu.models import artifact
-            from bcg_tpu.models.loader import (
-                find_checkpoint_dir, load_checkpoint_params,
-            )
-            from bcg_tpu.models.quantize import quantize_leaf_transform
-
-            ckpt_dir = find_checkpoint_dir(config.model_name)
-            if artifact.artifact_mode(ckpt_dir) is not None:
-                # Pre-quantized artifact (models/artifact.py): boot skips
-                # both the bf16 shard streaming and the quantization
-                # pass; the load raises on any mode/shape mismatch.
-                self.params = artifact.load_quantized_artifact(
-                    self.spec, ckpt_dir, quant_mode, mesh=mesh
+                self.params = init_random_params_sharded(
+                    self.spec, jax.random.PRNGKey(0), mesh=mesh,
+                    leaf_transform=quantize_leaf_transform(self.spec, quant_mode) if quantize else None,
                 )
             else:
-                # Streamed quantized loading: each weight is quantized as
-                # it arrives so the bf16 model never exists whole on
-                # device.
-                self.params = load_checkpoint_params(
-                    self.spec, config.model_name, mesh=mesh,
-                    leaf_transform=quantize_leaf_transform(self.spec, quant_mode) if quantize else None,
-                    ckpt_dir=ckpt_dir,
+                from bcg_tpu.models import artifact
+                from bcg_tpu.models.loader import (
+                    find_checkpoint_dir, load_checkpoint_params,
                 )
+                from bcg_tpu.models.quantize import quantize_leaf_transform
+
+                ckpt_dir = find_checkpoint_dir(config.model_name)
+                if artifact.artifact_mode(ckpt_dir) is not None:
+                    # Pre-quantized artifact (models/artifact.py): boot
+                    # skips both the bf16 shard streaming and the
+                    # quantization pass; the load raises on any
+                    # mode/shape mismatch.
+                    self.params = artifact.load_quantized_artifact(
+                        self.spec, ckpt_dir, quant_mode, mesh=mesh
+                    )
+                else:
+                    # Streamed quantized loading: each weight is
+                    # quantized as it arrives so the bf16 model never
+                    # exists whole on device.
+                    self.params = load_checkpoint_params(
+                        self.spec, config.model_name, mesh=mesh,
+                        leaf_transform=quantize_leaf_transform(self.spec, quant_mode) if quantize else None,
+                        ckpt_dir=ckpt_dir,
+                    )
 
         if not owns_params:
             # Constructor-shared tree (weight sharing between engines):
@@ -447,15 +467,22 @@ class JaxEngine(InferenceEngine):
 
             # Quantize BEFORE sharding so the int8/int4 tensors (not the
             # bf16 originals) are what gets laid out over the mesh.
-            # Constructor-supplied params may already be quantized (weight
-            # sharing between engines, mode-checked above) — don't
-            # quantize twice, and only consume (free-as-we-go) a tree
-            # this engine created itself.
-            if not is_quantized(self.params["layers"][0]["wq"]):
-                self.params = quantize_params(
-                    self.params, self.spec, consume=owns_params, mode=quant_mode
+            # With a mesh each leaf quantizes through a donation-aware
+            # jit under its param_sharding, so the transient is one bf16
+            # leaf SHARD per device, not per replica.  Constructor-
+            # supplied params may already be quantized (weight sharing
+            # between engines, mode-checked above) — don't quantize
+            # twice, and only consume (free-as-we-go) a tree this engine
+            # created itself.
+            with self._boot.phase("quantize"):
+                if not is_quantized(self.params["layers"][0]["wq"]):
+                    self.params = quantize_params(
+                        self.params, self.spec, consume=owns_params,
+                        mode=quant_mode, mesh=mesh,
+                    )
+                ensure_quantized_head(
+                    self.params, self.spec, mode=quant_mode, mesh=mesh
                 )
-            ensure_quantized_head(self.params, self.spec, mode=quant_mode)
 
         # Per-engine suffix ladder (config field; env var as the
         # bench/sweep override) — see _SUFFIX_BUCKETS_FINE.
@@ -471,8 +498,14 @@ class JaxEngine(InferenceEngine):
             # Scan-over-layers: program size O(1) in depth (see
             # EngineConfig.scan_layers).  Stacking after quantization so
             # the int8 leaves (not bf16) are what stacks; consuming an
-            # owned tree keeps the peak at model + one leaf-group.
-            self.params = stack_layer_params(self.params, consume=owns_params)
+            # owned tree keeps the peak at model + one leaf-group — with
+            # a mesh, per device SHARD (jitted donate + out_shardings,
+            # transformer.stack_layer_params).
+            with self._boot.phase("stack"):
+                self.params = stack_layer_params(
+                    self.params, consume=owns_params,
+                    mesh=mesh, spec=self.spec,
+                )
         elif layers_stacked(self.params):
             # Constructor-supplied stacked params (weight sharing from a
             # scan-mode engine, mode-checked above) force scan mode here
@@ -482,7 +515,11 @@ class JaxEngine(InferenceEngine):
         if mesh is not None:
             from bcg_tpu.parallel.sharding import shard_params
 
-            self.params = shard_params(self.params, self.spec, mesh)
+            # Leaves born under their param_sharding re-place as a
+            # no-op; this pass exists for constructor-shared trees and
+            # any path that still materializes replicated.
+            with self._boot.phase("shard"):
+                self.params = shard_params(self.params, self.spec, mesh)
 
         self._key = jax.random.PRNGKey(config.fake_seed if hasattr(config, "fake_seed") else 0)
         # Cumulative observability counters (bench.py's no-decode /
@@ -654,15 +691,26 @@ class JaxEngine(InferenceEngine):
         self._prefix_budget = 4 << 30
         # One-time constants for the hbm_utilization OOM guard.  Leaf
         # .nbytes is the GLOBAL size while bytes_limit is ONE device's.
-        # Weights shard over the tp axis only (replicated across dp/sp —
-        # parallel/sharding.py), while the KV cache shards over every
-        # axis, so the two divide by different factors.
+        # Per-device weight bytes come from the leaves' ACTUAL shardings
+        # (tree_bytes_per_device — a leaf the head-divisibility guards
+        # replicate counts whole); per-device KV bytes come from the
+        # axes kv_cache_tree_sharding actually engages for the given
+        # B/S/Hkv (_kv_bytes_per_device), NOT a flat mesh.size divisor —
+        # the dp-bypass path replicates the batch axis, so dividing by
+        # the full mesh overcommitted per-device HBM by up to dp×
+        # (ADVICE round-5 medium).
         self._kv_budget_warned = False
-        self._tp_devices = mesh.shape.get("tp", 1) if mesh is not None else 1
         self._mesh_devices = mesh.size if mesh is not None else 1
+        self._kv_bytes_memo: Dict[Tuple[int, int], int] = {}
         self._param_bytes = sum(
             getattr(p, "nbytes", 0) for p in jax.tree.leaves(self.params)
         )
+        if mesh is not None:
+            from bcg_tpu.parallel.sharding import tree_bytes_per_device
+
+            self._param_bytes_per_device = tree_bytes_per_device(self.params)
+        else:
+            self._param_bytes_per_device = self._param_bytes
         try:
             stats = jax.devices()[0].memory_stats() or {}
             self._mem_limit = stats.get("bytes_limit")
@@ -672,9 +720,25 @@ class JaxEngine(InferenceEngine):
             # Weight-aware: the prefix cache may only use a slice of what
             # the model leaves free (an 8B int8 model on a 16 GB chip
             # leaves ~7 GB for KV + prefixes + workspace).
-            free = self._mem_limit - self._param_bytes / self._tp_devices
+            free = self._mem_limit - self._param_bytes_per_device
             self._prefix_budget = min(
                 4 << 30, max(256 << 20, int(free * 0.25))
+            )
+        if _TIMING and self.boot_phases:
+            import sys as _sys
+
+            # stderr, not stdout: bench.py's stdout is the driver's
+            # single JSON line and must stay parseable under TIMING.
+            print(
+                "[engine] boot phases: " + "; ".join(
+                    f"{name}={p.get('seconds', 0):.2f}s"
+                    + (
+                        f" peak={p['peak_bytes_in_use'] / 1e9:.2f}GB"
+                        if p.get("peak_bytes_in_use") else ""
+                    )
+                    for name, p in self.boot_phases.items()
+                ),
+                flush=True, file=_sys.stderr,
             )
 
     # ------------------------------------------------------------- tokenizing
@@ -1810,6 +1874,12 @@ class JaxEngine(InferenceEngine):
         del _cache_out  # dropped immediately; exists only for aliasing
         out_np = np.asarray(out)
         t2 = time.perf_counter()
+        if not self._first_call_recorded:
+            # Boot breakdown's final phase: the first serving call pays
+            # the first prefill + decode-loop compiles (plus one
+            # execute) — recorded so a compile-time OOM names itself.
+            self._boot.note("first_compile", t2 - t0)
+            self._first_call_recorded = True
         # Observability: decode-loop iterations of the last call (each is
         # one weight pass — the wall-clock unit of the decode phase).
         self.last_decode_steps = int(steps)
@@ -1825,6 +1895,10 @@ class JaxEngine(InferenceEngine):
         self.decode_kv_bytes += int(steps) * B * S * slot_bytes * spec.num_layers
         self.decode_weight_passes += int(steps)
         if _TIMING:
+            import sys as _sys
+
+            # stderr like the boot-phase line: stdout belongs to the
+            # bench driver's single JSON line.
             print(
                 f"[engine] decode B={B} L={L} S={S} max_new={max_new} "
                 f"steps={int(steps)} "
@@ -1832,7 +1906,7 @@ class JaxEngine(InferenceEngine):
                 f"prefill={t1 - t0:.2f}s decode={t2 - t1:.2f}s "
                 f"prefix={'hit' if prepped is not None else 'miss'} "
                 f"prefix_fallbacks={self.prefix_fallbacks}",
-                flush=True,
+                flush=True, file=_sys.stderr,
             )
         texts = []
         for i in range(real_B):
@@ -1841,6 +1915,80 @@ class JaxEngine(InferenceEngine):
             row = row[: end[0]] if end.size else row
             texts.append(self.tokenizer.decode(row.tolist()))
         return texts
+
+    def _kv_bytes_per_device(self, B: int, S: int) -> int:
+        """Per-device decode-cache bytes for a [B, S] cache under the
+        layout ``kv_cache_tree_sharding`` ACTUALLY places — an axis that
+        fails its divisibility guard (Hkv % tp, S % sp, B % dp)
+        replicates and does NOT divide.  Memoized per (B, S): eval_shape
+        is cheap but this sits on every generation call's cap path."""
+        if self.mesh is None or self._mesh_devices <= 1:
+            return B * S * self._kv_slot_bytes * self.spec.num_layers
+        key = (B, S)
+        got = self._kv_bytes_memo.get(key)
+        if got is None:
+            from bcg_tpu.parallel.sharding import kv_cache_bytes_per_device
+
+            shapes = jax.eval_shape(partial(
+                init_kv_cache, self.spec, B, S,
+                quantized=self.kv_quantized, stacked=self.scan_layers,
+            ))
+            got = kv_cache_bytes_per_device(
+                self.mesh, shapes,
+                quantized=self.kv_quantized, stacked=self.scan_layers,
+            )
+            self._kv_bytes_memo[key] = got
+        return got
+
+    def _kv_row_budget(self) -> Optional[float]:
+        """Device bytes available to the decode cache: the budgeted HBM
+        fraction minus this device's weight SHARD and the prefix-cache
+        reserve.  The reserve is the full static BUDGET, not the current
+        fill: a volatile reserve would flip the derived cap between
+        calls and re-chunk the same logical batch into fresh compiled
+        shapes (tens of seconds each on a remote chip)."""
+        if self._mem_limit is None:
+            return None
+        prefix_reserve = (
+            self._prefix_budget
+            if self.prefix_caching and self._prefix_safe
+            else 0
+        )
+        return (
+            self.config.hbm_utilization * self._mem_limit
+            - self._param_bytes_per_device
+            - prefix_reserve
+        )
+
+    def cap_for(self, S: int) -> Optional[int]:
+        """Concurrent-row cap for decode-cache length ``S``, derived
+        from the mesh axes that actually engage (ADVICE round-5 medium).
+
+        Two regimes, mirroring ``_dp_mult``: if the engaged-axes cap
+        admits at least ``dp`` rows, the caller will dp-align the batch
+        and the batch axis shards — per-row cost is one dp-shard's
+        share.  Otherwise the batch runs dp-REPLICATED (the dp-bypass
+        path), every device holds every row, and the cap must be
+        re-derived at full per-row cost — the old flat
+        ``/ mesh.size`` divisor overcommitted exactly here, by up to
+        dp×.  tp/sp engagement (Hkv and S divisibility) is read off the
+        same placement function the cache allocation uses, so engaged
+        configs get every row the layout genuinely affords."""
+        budget = self._kv_row_budget()
+        if budget is None:
+            return None
+        S += (-S) % self._kv_align
+        dp = max(self._dp_devices, 1)
+        per_row = self._kv_bytes_per_device(dp, S) / dp
+        if per_row <= 0:
+            return None
+        cap = max(1, int(budget // per_row))
+        if dp > 1 and cap < dp:
+            # dp-bypass: _dp_mult will drop the alignment and the batch
+            # axis replicates — re-derive at replicated per-row cost.
+            per_row = float(self._kv_bytes_per_device(1, S))
+            cap = max(1, int(budget // per_row))
+        return cap
 
     def _provisioned_row_cap(self, parts, budgets: List[int]) -> Optional[int]:
         """``hbm_utilization`` as an ACTUAL provisioner — the reference's
@@ -1855,46 +2003,24 @@ class JaxEngine(InferenceEngine):
         device limit is unknown (CPU tests) or the whole batch fits."""
         if self._mem_limit is None:
             return None
-        spec = self.spec
         max_new = max(budgets)
         decode_res = (
             _ff_decode_slots(max_new) if self.fast_forward else max_new + 1
         )
         limit = self.max_model_len - min(budgets) - 1
-        slot = self._kv_slot_bytes
-        # Reserve the full prefix-cache BUDGET (static per run), not the
-        # current fill: a volatile reserve would flip the derived cap
-        # between calls and re-chunk the same logical batch into fresh
-        # compiled shapes (tens of seconds each on a remote chip).
-        prefix_reserve = (
-            self._prefix_budget
-            if self.prefix_caching and self._prefix_safe
-            else 0
-        )
-        budget = (
-            self.config.hbm_utilization * self._mem_limit
-            - self._param_bytes / self._tp_devices
-            - prefix_reserve
-        )
-
-        def cap_for(S: int) -> Optional[int]:
-            S += (-S) % self._kv_align
-            per_row = S * slot * spec.num_layers / self._mesh_devices
-            return max(1, int(budget // per_row)) if per_row > 0 else None
-
         B_pad = _aligned_pad_batch(len(parts), self._dp_devices)
         # Cheap pre-check at the WORST-CASE prompt window: if even that
         # fits the whole padded batch, skip the per-row tokenization
         # below (~1.4 ms/row on HF tokenizers — real host time on every
         # call of a 1-core box when it can never change the answer).
-        worst = cap_for(limit + decode_res)
+        worst = self.cap_for(limit + decode_res)
         if worst is None or worst >= B_pad:
             return None
         longest = max(
             len(self.tokenizer.encode(p + c + t)[-limit:]) for p, c, t in parts
         )
         L = next((b for b in _LEN_BUCKETS if b >= longest), limit)
-        cap = cap_for(min(L, limit) + decode_res)
+        cap = self.cap_for(min(L, limit) + decode_res)
         if cap is None or cap >= B_pad:
             return None
         # The caller (_run_guided/_run_free) re-derives the dp padding
@@ -1908,7 +2034,9 @@ class JaxEngine(InferenceEngine):
         """hbm_utilization as an OOM guard (the reference's
         ``gpu_memory_utilization``, config.py:36): warn — once — when the
         worst-case KV cache for this batch would push past the budgeted
-        fraction of device memory, naming the knobs that bound it."""
+        fraction of device memory, naming the knobs that bound it.  B is
+        the batch ACTUALLY decoded, so the engaged-axes accounting is
+        exact here: a B that skips dp alignment counts replicated."""
         if self._kv_budget_warned or self._mem_limit is None:
             return
         spec = self.spec
@@ -1922,7 +2050,7 @@ class JaxEngine(InferenceEngine):
         S = self.max_model_len - min(budgets) - 1 + decode_res
         kv_total = B * S * self._kv_slot_bytes * spec.num_layers
         per_device = (
-            kv_total / self._mesh_devices + self._param_bytes / self._tp_devices
+            self._kv_bytes_per_device(B, S) + self._param_bytes_per_device
         )
         if per_device > self.config.hbm_utilization * self._mem_limit:
             import warnings

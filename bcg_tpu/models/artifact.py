@@ -190,15 +190,18 @@ def load_quantized_artifact(
     dtypes = manifest["dtypes"]
 
     def restore(name: str, arr: np.ndarray):
+        # bf16 bit patterns re-view on the HOST ndarray so the first
+        # device placement is already the sharded one — `jnp.asarray`
+        # before `device_put` would stage the full tensor unsharded on
+        # the default device first (the transient the per-leaf sharded
+        # load exists to avoid; same discipline as loader._convert).
         if dtypes.get(name) == "bfloat16":
-            t = jax.lax.bitcast_convert_type(
-                jnp.asarray(arr.view(np.uint16)), jnp.bfloat16
-            )
-        else:
-            t = jnp.asarray(arr)
+            import ml_dtypes
+
+            arr = arr.view(np.uint16).view(ml_dtypes.bfloat16)
         if sharding_for is not None:
-            t = jax.device_put(t, sharding_for(name))
-        return t
+            return jax.device_put(arr, sharding_for(name))
+        return jnp.asarray(arr)
 
     def read_file(path: str) -> Dict[str, jax.Array]:
         flat: Dict[str, jax.Array] = {}

@@ -12,11 +12,13 @@ on local disk (HF cache layout or a flat directory of ``*.safetensors``).
 
 from __future__ import annotations
 
+import math
 import os
 from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
+import ml_dtypes
 import numpy as np
 
 from bcg_tpu.models.configs import ModelSpec
@@ -128,16 +130,20 @@ def load_checkpoint_params(
         return _convert(arr, logical)
 
     def _convert(arr, logical: str):
+        # bf16 bit-pattern view, transpose, and dtype cast all happen on
+        # the HOST ndarray, so the FIRST device placement is already the
+        # sharded one — `jnp.asarray` first would stage the full tensor
+        # unsharded on the default device, exactly the transient the
+        # per-leaf sharded load exists to avoid.
         if arr.dtype == np.uint16:  # raw bf16 storage
-            arr = arr.view(np.uint16)
-            tensor = jax.lax.bitcast_convert_type(jnp.asarray(arr), jnp.bfloat16)
-        else:
-            tensor = jnp.asarray(arr, dtype=dtype)
+            arr = arr.view(ml_dtypes.bfloat16)
         if logical.split(".")[-1] in _TRANSPOSED:
-            tensor = tensor.T
-        tensor = tensor.astype(dtype)
+            arr = arr.T
+        arr = arr.astype(np.dtype(dtype), copy=False)
         if sharding_for is not None:
-            tensor = jax.device_put(tensor, sharding_for(logical))
+            tensor = jax.device_put(arr, sharding_for(logical))
+        else:
+            tensor = jnp.asarray(arr)
         if leaf_transform is not None:
             tensor = leaf_transform(logical, tensor)
         return tensor
@@ -180,3 +186,223 @@ def load_checkpoint_params(
                 pass
         open_files.clear()
     return params
+
+
+# -------------------------------------------------- born-sharded random init
+
+def init_random_params_sharded(
+    spec: ModelSpec,
+    key: jax.Array,
+    mesh=None,
+    dtype=jnp.bfloat16,
+    leaf_transform=None,
+) -> Dict:
+    """Born-sharded, born-quantized random init — the flagship-scale
+    boot path (hermetic ``bcg-tpu/*`` presets and benches).
+
+    ``transformer.init_params`` creates every leaf eagerly on the
+    default device: an fp32 intermediate per tensor, unsharded — a 14B
+    bf16 tree peaks far past one chip's HBM during init even when the
+    mesh has room (the round-5 ``bench_14b`` RESOURCE_EXHAUSTED, twice).
+    This materializes the SAME ``param_plan`` (same key consumption,
+    bit-identical values) leaf by leaf through a jitted initializer with
+    ``out_shardings=param_sharding(...)`` and the quantize
+    ``leaf_transform`` INSIDE the jit, so:
+
+    * no full-precision leaf ever exists unsharded — the fp32 source and
+      its bf16/int8 product are computed per device shard;
+    * peak device memory is the transformed tree so far plus ONE leaf's
+      shard-sized transient (see ``boot_peak_report`` for the analytic
+      accounting).
+
+    ``leaf_transform`` must depend only on the LAST component of the
+    logical name (true of ``quantize_leaf_transform``): per-leaf jits
+    are reused across layers of the same shape, so a transform keyed on
+    the layer index would silently apply layer 0's behaviour everywhere.
+
+    With ``mesh=None`` the per-leaf jit still fuses the fp32
+    intermediate away (single-device peak = tree + one leaf), matching
+    the streamed-checkpoint discipline this replaces.
+
+    Values are MESH-SHAPE-INVARIANT: the partitionable threefry RNG is
+    enabled for the scope of this call, so the same seed yields the same
+    weights at tp=1 and tp=8 (the legacy counter scheme re-derives
+    per-shard streams under ``out_shardings`` — a tp=2 and a tp=4 bench
+    would otherwise serve different random models).  They intentionally
+    differ bit-wise from ``transformer.init_params``'s legacy-RNG
+    output; no golden-value contract exists for random weights.
+    """
+    from bcg_tpu.models.transformer import assemble_param_tree, param_plan
+
+    sharding_for = None
+    if mesh is not None:
+        from bcg_tpu.parallel.sharding import param_sharding
+
+        sharding_for = lambda logical: param_sharding(logical, spec, mesh)  # noqa: E731
+
+    plan = param_plan(spec)
+    keys = jax.random.split(key, 4 + spec.num_layers * 7)
+    spare_key = keys[-1]  # never consumed by the plan; feeds ones/zeros jits
+    ki = 0
+    fns: Dict = {}
+    items = []
+    prev_partitionable = jax.config.jax_threefry_partitionable
+    jax.config.update("jax_threefry_partitionable", True)
+    try:
+        for logical, kind, shape in plan:
+            leaf = logical.split(".")[-1]
+            if kind == "dense":
+                k = keys[ki]
+                ki += 1
+            else:
+                k = spare_key
+
+            cache_key = (leaf, kind, shape)
+            fn = fns.get(cache_key)
+            if fn is None:
+
+                def _init(k, _kind=kind, _shape=shape, _logical=logical):
+                    if _kind == "dense":
+                        w = (
+                            jax.random.normal(k, _shape, jnp.float32)
+                            / math.sqrt(_shape[0])
+                        ).astype(dtype)
+                        # Dense leaves only, like init_params and
+                        # boot_peak_report — the three param_plan
+                        # consumers must agree on what transforms.
+                        if leaf_transform is not None:
+                            w = leaf_transform(_logical, w)
+                        return w
+                    if _kind == "ones":
+                        return jnp.ones(_shape, dtype)
+                    return jnp.zeros(_shape, dtype)
+
+                out_shardings = None
+                if sharding_for is not None:
+                    out_struct = jax.eval_shape(_init, k)
+                    if isinstance(out_struct, dict):  # quantized {"q","scale"}
+                        out_shardings = {
+                            sub: sharding_for(f"{logical}.{sub}")
+                            for sub in out_struct
+                        }
+                    else:
+                        out_shardings = sharding_for(logical)
+                    fn = jax.jit(_init, out_shardings=out_shardings)
+                else:
+                    fn = jax.jit(_init)
+                fns[cache_key] = fn
+            items.append((logical, fn(k)))
+    finally:
+        jax.config.update("jax_threefry_partitionable", prev_partitionable)
+    return assemble_param_tree(items)
+
+
+def _shard_bytes(struct, sharding) -> int:
+    """Per-device bytes of a ShapeDtypeStruct under a NamedSharding
+    (full bytes when ``sharding`` is None) — the shared computation in
+    ``parallel/sharding.shard_bytes``, so this analytic report and the
+    engine's HBM budget cannot drift apart."""
+    from bcg_tpu.parallel.sharding import shard_bytes
+
+    return shard_bytes(struct.shape, struct.dtype, sharding)
+
+
+def boot_peak_report(
+    spec: ModelSpec,
+    mesh=None,
+    quantization: Optional[str] = None,
+    dtype=jnp.bfloat16,
+    scan_layers: bool = True,
+) -> Dict:
+    """Analytic per-device boot-memory accounting for the born-sharded
+    init path — pure ``eval_shape`` + ``param_sharding``, NO weights
+    materialized (safe for 14B/32B specs on a laptop CPU).
+
+    Models the engine boot phase by phase:
+
+    * per-leaf init: the already-materialized (transformed) tree so far,
+      plus the current leaf's fp32 source and its transformed output —
+      all at SHARD size, because ``init_random_params_sharded``'s
+      ``out_shardings`` partition the whole per-leaf computation;
+    * consume-stacking (``scan_layers``): the full transformed tree plus
+      one leaf-group's stacked copy (``stack_layer_params(consume=True)``
+      frees each group's per-layer sources as its stack appears).
+
+    Returns a dict of byte counts; the headline invariant — boot peak
+    per device <= final tree + one leaf-group (where "one leaf-group"
+    is the larger of the biggest stacking group and the biggest single-
+    leaf init transient) — holds by construction and is asserted by
+    ``tests/test_born_sharded.py`` and ``scripts/boot_smoke.py`` against
+    the components reported here.
+    """
+    from bcg_tpu.models.transformer import param_plan
+
+    transform = None
+    if quantization is not None:
+        from bcg_tpu.models.quantize import quantize_leaf_transform
+
+        transform = quantize_leaf_transform(spec, quantization)
+
+    sharding_for = None
+    if mesh is not None:
+        from bcg_tpu.parallel.sharding import param_sharding
+
+        sharding_for = lambda logical: param_sharding(logical, spec, mesh)  # noqa: E731
+
+    done = 0
+    init_peak = 0
+    max_transient = 0
+    max_transient_leaf = None
+    group_bytes: Dict[str, int] = {}
+    for logical, kind, shape in param_plan(spec):
+        src_dtype = jnp.float32 if kind == "dense" else dtype
+
+        def _make(w, _logical=logical, _kind=kind):
+            w = w.astype(dtype)
+            if transform is not None and _kind == "dense":
+                return transform(_logical, w)
+            return w
+
+        src = jax.ShapeDtypeStruct(shape, src_dtype)
+        out_struct = jax.eval_shape(_make, src)
+        if isinstance(out_struct, dict):
+            out_b = sum(
+                _shard_bytes(
+                    sub,
+                    sharding_for(f"{logical}.{name}") if sharding_for else None,
+                )
+                for name, sub in out_struct.items()
+            )
+        else:
+            out_b = _shard_bytes(
+                out_struct, sharding_for(logical) if sharding_for else None
+            )
+        # The fp32 source transient is sharded like the parent weight
+        # (out_shardings propagate back through the elementwise chain).
+        transient = (
+            _shard_bytes(src, sharding_for(logical) if sharding_for else None)
+            if kind == "dense"
+            else 0
+        )
+        init_peak = max(init_peak, done + transient + out_b)
+        if transient + out_b > max_transient:
+            max_transient = transient + out_b
+            max_transient_leaf = logical
+        done += out_b
+        parts = logical.split(".")
+        if parts[0] == "layers":
+            group_bytes[parts[2]] = group_bytes.get(parts[2], 0) + out_b
+
+    max_group = max(group_bytes.values()) if group_bytes else 0
+    stack_peak = done + max_group if scan_layers else done
+    return {
+        "final_bytes_per_device": done,
+        "init_peak_bytes_per_device": init_peak,
+        "stack_peak_bytes_per_device": stack_peak,
+        "peak_bytes_per_device": max(init_peak, stack_peak),
+        "max_init_transient_bytes": max_transient,
+        "max_init_transient_leaf": max_transient_leaf,
+        "max_leaf_group_bytes": max_group,
+        "devices": 1 if mesh is None else mesh.size,
+        "quantization": quantization,
+    }

@@ -248,8 +248,54 @@ def _quantizer(mode: str):
     raise ValueError(f"quantization mode {mode!r}: expected 'int8' or 'int4'")
 
 
+def _sharded_quantizer(mode: str, spec: ModelSpec, mesh):
+    """Per-leaf jitted quantizer whose ``out_shardings`` is the leaf's
+    ``param_sharding`` (q like the parent weight, scale per-output-
+    channel) and whose input is DONATED under ``consume`` — so a
+    tp-sharded bf16 leaf quantizes shard-wise with the int8 result laid
+    out directly on the mesh, never re-staged replicated.  Jits are
+    memoized per (leaf name, shape, consume): layers share shapes, so a
+    14B tree compiles each transform once, not once per layer."""
+    from bcg_tpu.parallel.sharding import param_sharding
+
+    fns: Dict = {}
+
+    def quantize(logical: str, w, consume: bool):
+        leaf = logical.split(".")[-1]
+        key = (leaf, w.shape, str(w.dtype), consume)
+        fn = fns.get(key)
+        if fn is None:
+            if mode == "int8":
+                impl = _quantize_impl
+            else:
+                impl = partial(_quantize4_impl, group=int4_group_for(w.shape[0]))
+            out_struct = jax.eval_shape(impl, jax.ShapeDtypeStruct(w.shape, w.dtype))
+            outs = {
+                sub: param_sharding(f"{logical}.{sub}", spec, mesh)
+                for sub in out_struct
+            }
+            fn = jax.jit(
+                impl, out_shardings=outs,
+                donate_argnums=(0,) if consume else (),
+            )
+            fns[key] = fn
+        # Donation frees the bf16 source shard-wise; it can never ALIAS
+        # the int8/int4 output (dtype change), so silence the
+        # per-compile "not usable" lowering warning.
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable"
+            )
+            return fn(jnp.asarray(w))
+
+    return quantize
+
+
 def quantize_params(
-    params: Dict, spec: ModelSpec, consume: bool = False, mode: str = "int8"
+    params: Dict, spec: ModelSpec, consume: bool = False, mode: str = "int8",
+    mesh=None,
 ) -> Dict:
     """Quantize every dense matmul weight of a transformer param pytree.
 
@@ -266,16 +312,26 @@ def quantize_params(
     model fitting a single v5e chip or not.  Only pass it for a tree
     the caller owns exclusively.  ``mode`` selects int8 (W8A8) or int4
     (grouped W4A16).
+
+    With ``mesh``, each leaf quantizes through a jitted transform whose
+    ``out_shardings`` is the leaf's ``param_sharding``
+    (:func:`_sharded_quantizer`): with ``consume`` the per-device peak is
+    the quantized model SHARD plus one bf16 leaf shard, not per replica.
     """
-    quantize = _quantizer(mode)
+    if mesh is not None:
+        sharded = _sharded_quantizer(mode, spec, mesh)
+        quantize = lambda logical, w, consume: sharded(logical, w, consume)  # noqa: E731
+    else:
+        plain = _quantizer(mode)
+        quantize = lambda logical, w, consume: plain(w, consume=consume)  # noqa: E731
     out = dict(params)
     out_layers = []
-    for layer in params["layers"]:
+    for li, layer in enumerate(params["layers"]):
         new_layer = {}
         for k in list(layer):
             v = layer[k]
             if k in _QUANT_LEAVES:
-                new_layer[k] = quantize(v, consume=consume)
+                new_layer[k] = quantize(f"layers.{li}.{k}", v, consume)
                 if consume:
                     del layer[k]
                 del v  # drop the local bf16 reference immediately
@@ -284,11 +340,11 @@ def quantize_params(
         out_layers.append(new_layer)
     out["layers"] = out_layers
     if "lm_head" in params:
-        out["lm_head"] = quantize(params["lm_head"], consume=consume)
+        out["lm_head"] = quantize("lm_head", params["lm_head"], consume)
         if consume:
             del params["lm_head"]
     elif spec.tie_embeddings:
-        out["lm_head"] = quantize(params["embed"].T, consume=True)
+        out["lm_head"] = quantize("lm_head", params["embed"].T, True)
     return out
 
 
@@ -307,10 +363,18 @@ def quantize_leaf_transform(spec: ModelSpec, mode: str = "int8"):
     return transform
 
 
-def ensure_quantized_head(params: Dict, spec: ModelSpec, mode: str = "int8") -> Dict:
+def ensure_quantized_head(
+    params: Dict, spec: ModelSpec, mode: str = "int8", mesh=None
+) -> Dict:
     """Give tied-embedding models their explicit quantized LM head when a
     leaf-transform load (which never sees an ``lm_head`` tensor) built the
-    rest of the tree."""
+    rest of the tree.  With ``mesh`` the head quantizes under its
+    ``param_sharding`` like every other leaf (:func:`_sharded_quantizer`)."""
     if "lm_head" not in params and spec.tie_embeddings:
-        params["lm_head"] = _quantizer(mode)(params["embed"].T, consume=True)
+        if mesh is not None:
+            params["lm_head"] = _sharded_quantizer(mode, spec, mesh)(
+                "lm_head", params["embed"].T, True
+            )
+        else:
+            params["lm_head"] = _quantizer(mode)(params["embed"].T, consume=True)
     return params

@@ -35,6 +35,72 @@ TransformerParams = Dict  # pytree: see init_params for the layout
 
 # ----------------------------------------------------------------- building
 
+def param_plan(spec: ModelSpec):
+    """Ordered ``(logical_name, init_kind, shape)`` triples for every
+    leaf :func:`init_params` creates — ``init_kind`` is ``"dense"``
+    (random, scaled by 1/sqrt(fan_in)), ``"ones"`` (norm vectors) or
+    ``"zeros"`` (projection biases).
+
+    This is the single source of truth for the parameter layout: the
+    eager initializer (:func:`init_params`), the born-sharded
+    initializer (``models/loader.py::init_random_params_sharded``) and
+    the analytic boot-memory accounting (``loader.boot_peak_report``)
+    all iterate it, so creation order, key consumption and shapes
+    cannot drift between the materializing and the abstract paths.
+
+    Key-consumption contract: dense leaves consume one key each, in
+    plan order, from ``jax.random.split(key, 4 + num_layers * 7)``.
+    """
+    plan = [
+        ("embed", "dense", (spec.vocab_size, spec.hidden_size)),
+        ("final_norm", "ones", (spec.hidden_size,)),
+    ]
+    for li in range(spec.num_layers):
+        pre = f"layers.{li}."
+        plan += [
+            (pre + "attn_norm", "ones", (spec.hidden_size,)),
+            (pre + "wq", "dense", (spec.hidden_size, spec.q_size)),
+            (pre + "wk", "dense", (spec.hidden_size, spec.kv_size)),
+            (pre + "wv", "dense", (spec.hidden_size, spec.kv_size)),
+            (pre + "wo", "dense", (spec.q_size, spec.hidden_size)),
+            (pre + "mlp_norm", "ones", (spec.hidden_size,)),
+            (pre + "w_gate", "dense", (spec.hidden_size, spec.intermediate_size)),
+            (pre + "w_up", "dense", (spec.hidden_size, spec.intermediate_size)),
+            (pre + "w_down", "dense", (spec.intermediate_size, spec.hidden_size)),
+        ]
+        if spec.qk_norm:
+            plan += [
+                (pre + "q_norm", "ones", (spec.head_dim,)),
+                (pre + "k_norm", "ones", (spec.head_dim,)),
+            ]
+        if spec.attn_bias:
+            plan += [
+                (pre + "bq", "zeros", (spec.q_size,)),
+                (pre + "bk", "zeros", (spec.kv_size,)),
+                (pre + "bv", "zeros", (spec.kv_size,)),
+            ]
+    if not spec.tie_embeddings:
+        plan.append(("lm_head", "dense", (spec.hidden_size, spec.vocab_size)))
+    return plan
+
+
+def assemble_param_tree(items) -> TransformerParams:
+    """``(logical_name, leaf)`` pairs -> the nested param pytree
+    (``layers.{i}.{name}`` paths become ``params["layers"][i][name]``)."""
+    params: Dict = {}
+    for logical, leaf in items:
+        parts = logical.split(".")
+        if parts[0] == "layers":
+            layers = params.setdefault("layers", [])
+            li = int(parts[1])
+            while len(layers) <= li:
+                layers.append({})
+            layers[li][parts[2]] = leaf
+        else:
+            params[logical] = leaf
+    return params
+
+
 def init_params(
     spec: ModelSpec, key: jax.Array, dtype=jnp.bfloat16, leaf_transform=None
 ) -> TransformerParams:
@@ -57,50 +123,36 @@ def init_params(
     so e.g. int8 quantization never holds the whole bf16 model: an
     8B-class random-weight bench would otherwise OOM a 16 GB chip during
     init alone.
+
+    This EAGER path still creates every leaf replicated on the default
+    device with an fp32 intermediate per tensor — for flagship-scale
+    specs use ``models/loader.py::init_random_params_sharded``, which
+    materializes each leaf of the same :func:`param_plan` (same shapes,
+    same key consumption) through a jitted per-leaf initializer under
+    its ``param_sharding``, so no leaf ever exists unsharded.  Its
+    VALUES intentionally differ bit-wise from this path's (it scopes the
+    partitionable RNG for mesh-shape invariance); random weights carry
+    no golden-value contract.
     """
     keys = iter(jax.random.split(key, 4 + spec.num_layers * 7))
 
-    def _init_dense(k, logical, shape):
-        fan_in = shape[0]
-        w = (jax.random.normal(k, shape, jnp.float32) / math.sqrt(fan_in)).astype(dtype)
-        return leaf_transform(logical, w) if leaf_transform else w
+    def build(logical, kind, shape):
+        if kind == "dense":
+            w = (
+                jax.random.normal(next(keys), shape, jnp.float32)
+                / math.sqrt(shape[0])
+            ).astype(dtype)
+            return leaf_transform(logical, w) if leaf_transform else w
+        return (jnp.ones if kind == "ones" else jnp.zeros)(shape, dtype)
 
-    params: Dict = {
-        "embed": _init_dense(next(keys), "embed", (spec.vocab_size, spec.hidden_size)),
-        "final_norm": jnp.ones((spec.hidden_size,), dtype),
-        "layers": [],
-    }
-    for li in range(spec.num_layers):
-        pre = f"layers.{li}."
-
-        layer = {
-            "attn_norm": jnp.ones((spec.hidden_size,), dtype),
-            "wq": _init_dense(next(keys), pre + "wq", (spec.hidden_size, spec.q_size)),
-            "wk": _init_dense(next(keys), pre + "wk", (spec.hidden_size, spec.kv_size)),
-            "wv": _init_dense(next(keys), pre + "wv", (spec.hidden_size, spec.kv_size)),
-            "wo": _init_dense(next(keys), pre + "wo", (spec.q_size, spec.hidden_size)),
-            "mlp_norm": jnp.ones((spec.hidden_size,), dtype),
-            "w_gate": _init_dense(next(keys), pre + "w_gate", (spec.hidden_size, spec.intermediate_size)),
-            "w_up": _init_dense(next(keys), pre + "w_up", (spec.hidden_size, spec.intermediate_size)),
-            "w_down": _init_dense(next(keys), pre + "w_down", (spec.intermediate_size, spec.hidden_size)),
-        }
-        if spec.qk_norm:
-            layer["q_norm"] = jnp.ones((spec.head_dim,), dtype)
-            layer["k_norm"] = jnp.ones((spec.head_dim,), dtype)
-        if spec.attn_bias:
-            layer["bq"] = jnp.zeros((spec.q_size,), dtype)
-            layer["bk"] = jnp.zeros((spec.kv_size,), dtype)
-            layer["bv"] = jnp.zeros((spec.kv_size,), dtype)
-        params["layers"].append(layer)
-    if not spec.tie_embeddings:
-        params["lm_head"] = _init_dense(
-            next(keys), "lm_head", (spec.hidden_size, spec.vocab_size)
-        )
-    return params
+    return assemble_param_tree(
+        (logical, build(logical, kind, shape))
+        for logical, kind, shape in param_plan(spec)
+    )
 
 
 def stack_layer_params(
-    params: TransformerParams, consume: bool = False
+    params: TransformerParams, consume: bool = False, mesh=None, spec=None
 ) -> TransformerParams:
     """Convert ``params["layers"]`` from a per-layer list to a STACKED
     pytree (each leaf gains a leading ``[num_layers]`` dim) for
@@ -117,10 +169,74 @@ def stack_layer_params(
     peak device memory is the model plus ONE leaf-group instead of two
     full copies — stacking an 8B int8 model non-consuming OOMs a 16 GB
     chip (measured).  Only pass ``consume`` for a tree the caller owns.
+
+    With ``mesh`` (and ``spec``), each leaf-group stacks through a
+    jitted transform whose ``out_shardings`` is the group's stacked
+    ``param_sharding`` and whose inputs are DONATED under ``consume`` —
+    so a tp/dp-sharded tree stays sharded through the stack and the
+    leaf-group transient is per device SHARD, not per replica (a 14B
+    tree stacking replicated would re-stage dp×/tp× the bytes the
+    born-sharded init just avoided).
     """
     layers = params["layers"]
     if isinstance(layers, dict):
         return params
+
+    stack_group = None
+    if mesh is not None:
+        if spec is None:
+            raise ValueError("stack_layer_params(mesh=...) needs spec= too")
+        from bcg_tpu.parallel.sharding import param_sharding
+
+        def _stack(ls):
+            if isinstance(ls[0], dict):
+                return {k: jnp.stack([lv[k] for lv in ls]) for k in ls[0]}
+            return jnp.stack(ls)
+
+        # Memoized per (leaf signature, output shardings): same-shaped
+        # groups — wk/wv, w_gate/w_up, the norm vectors — share ONE
+        # compiled stack instead of re-lowering identical programs
+        # (compiles sit on the boot path this function exists to slim).
+        stack_fns: Dict = {}
+
+        def stack_group(name, leaves):
+            sample = leaves[0]
+            if isinstance(sample, dict):
+                outs = {
+                    k: param_sharding(
+                        f"layers.{name}.{k}", spec, mesh, stacked=True
+                    )
+                    for k in sample
+                }
+                sig = tuple(
+                    sorted(
+                        (k, v.shape, str(v.dtype), outs[k].spec)
+                        for k, v in sample.items()
+                    )
+                )
+            else:
+                outs = param_sharding(f"layers.{name}", spec, mesh, stacked=True)
+                sig = (sample.shape, str(sample.dtype), outs.spec)
+            key = (sig, len(leaves))
+            fn = stack_fns.get(key)
+            if fn is None:
+                fn = jax.jit(
+                    _stack, out_shardings=outs,
+                    donate_argnums=(0,) if consume else (),
+                )
+                stack_fns[key] = fn
+            # Donation here frees each per-layer source as its slice is
+            # copied; it can never ALIAS the stacked output (leading dim
+            # added), so silence the per-compile "not usable" lowering
+            # warning — the free, not the alias, is the point.
+            import warnings
+
+            with warnings.catch_warnings():
+                warnings.filterwarnings(
+                    "ignore", message="Some donated buffers were not usable"
+                )
+                return fn(leaves)
+
     out = dict(params)
     stacked: Dict = {}
     for name in list(layers[0].keys()):
@@ -128,7 +244,9 @@ def stack_layer_params(
             leaves = [l.pop(name) for l in layers]
         else:
             leaves = [l[name] for l in layers]
-        if isinstance(leaves[0], dict):  # quantized {"q", "scale"}
+        if stack_group is not None:
+            stacked[name] = stack_group(name, leaves)
+        elif isinstance(leaves[0], dict):  # quantized {"q", "scale"}
             stacked[name] = {
                 k: jnp.stack([lv[k] for lv in leaves]) for k in leaves[0]
             }

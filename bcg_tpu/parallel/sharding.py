@@ -170,3 +170,69 @@ def kv_cache_tree_sharding(mesh: Mesh, cache_shapes, quantized: bool = False,
         return NamedSharding(mesh, P(*spec))
 
     return jax.tree.map(place, cache_shapes)
+
+
+def shard_bytes(shape, dtype, sharding=None) -> int:
+    """Bytes of ONE device's shard of an array (full bytes when
+    ``sharding`` is None).  The single shard-size computation behind
+    every per-device HBM accounting path — the provisioner
+    (:func:`kv_cache_bytes_per_device`), the weight-budget term
+    (:func:`tree_bytes_per_device`) and the analytic boot report
+    (``models/loader.boot_peak_report``) must not drift apart."""
+    import numpy as np
+
+    dims = sharding.shard_shape(tuple(shape)) if sharding is not None else shape
+    n = 1
+    for d in dims:
+        n *= d
+    return n * np.dtype(dtype).itemsize
+
+
+def kv_cache_bytes_per_device(
+    mesh: Mesh, cache_shapes, quantized: bool = False, stacked: bool = False
+) -> int:
+    """Bytes ONE device actually holds for a cache placed by
+    :func:`kv_cache_tree_sharding`.
+
+    The engine's HBM provisioner must divide by the mesh axes that
+    ENGAGE for the given shapes — an axis that fails its divisibility
+    guard (or a batch that skips dp alignment on the dp-bypass path)
+    replicates, so dividing per-row bytes by the full ``mesh.size``
+    overcommits per-device HBM by up to that axis's size (ADVICE
+    round-5 medium).  Summing each leaf's ``shard_shape`` bytes under
+    the SAME placement function keeps the accounting and the layout
+    from drifting apart.  ``cache_shapes`` is a cache pytree or a
+    ``jax.eval_shape`` result.
+    """
+    shardings = kv_cache_tree_sharding(
+        mesh, cache_shapes, quantized=quantized, stacked=stacked
+    )
+    is_sharding = lambda s: isinstance(s, NamedSharding)  # noqa: E731
+    return sum(
+        shard_bytes(leaf.shape, leaf.dtype, sh)
+        for leaf, sh in zip(
+            jax.tree.leaves(cache_shapes),
+            jax.tree.leaves(shardings, is_leaf=is_sharding),
+        )
+    )
+
+
+def tree_bytes_per_device(tree) -> int:
+    """Per-device bytes of a pytree of (possibly sharded) arrays: a leaf
+    with a ``NamedSharding`` counts its SHARD size; anything else counts
+    whole.  Used for the weight term of the engine's HBM budget — the
+    former ``param_bytes / tp`` estimate over-divided leaves that the
+    head-divisibility guards in :func:`param_sharding` replicate."""
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        sharding = getattr(leaf, "sharding", None)
+        shape = getattr(leaf, "shape", None)
+        if (
+            isinstance(sharding, NamedSharding)
+            and shape is not None
+            and hasattr(leaf, "dtype")
+        ):
+            total += shard_bytes(shape, leaf.dtype, sharding)
+        else:
+            total += int(getattr(leaf, "nbytes", 0))
+    return total

@@ -1,4 +1,5 @@
-"""Result persistence: JSON + CSV metrics sinks.
+"""Result persistence: JSON + CSV metrics sinks, plus boot-phase
+observability.
 
 Byte-compatible with the reference layout (``main.py:792-995``):
 ``results/json/run_NNN.json`` (config + statistics + per-round trajectory +
@@ -6,6 +7,15 @@ final state + message count), ``results/metrics/run_NNN.csv`` (fixed column
 order with the reference's rounding map), ``results/logs/run_NNN_log.txt``
 (written live by :class:`RunLogger`).  Adds performance fields the
 reference lacks (rounds/sec, decisions/sec).
+
+:class:`BootPhaseRecorder` stamps per-phase wall time and device-
+allocator readings over engine boot (init → quantize → stack → shard →
+first compile), so an on-device ``RESOURCE_EXHAUSTED`` names the phase
+it died in — the round-5 14B boot failed inside ``init_params`` twice
+with nothing but the raw XLA error to go on.  The last recorder's
+phases are mirrored in :data:`LAST_BOOT_PHASES` so ``bench.py`` can
+attach them to an error JSON even when the engine object never finished
+constructing.
 """
 
 from __future__ import annotations
@@ -13,9 +23,85 @@ from __future__ import annotations
 import csv
 import json
 import os
+import time
+from contextlib import contextmanager
 from dataclasses import asdict
 from datetime import datetime
 from typing import Dict, Optional
+
+# Phases of the most recent BootPhaseRecorder (including a partially
+# failed boot) — bench.py's error path reads this.
+LAST_BOOT_PHASES: Optional[Dict] = None
+
+
+def _device_memory():
+    """(bytes_in_use, peak_bytes_in_use) of device 0, or (None, None)
+    where the backend exposes no allocator stats (CPU)."""
+    try:
+        import jax
+
+        stats = jax.devices()[0].memory_stats() or {}
+        return stats.get("bytes_in_use"), stats.get("peak_bytes_in_use")
+    except Exception:
+        return None, None
+
+
+class BootPhaseRecorder:
+    """Phase-labelled boot memory/timing breakdown.
+
+    ``peak_bytes_in_use`` is the allocator's cumulative high-water mark
+    (TPU allocators expose no per-phase reset), so the phase whose
+    reading first jumps IS the phase that set the peak; ``bytes_in_use``
+    before/after bounds each phase's resident delta.  A phase that
+    raises is still recorded (``failed: true``) before the exception
+    propagates — the breakdown survives a mid-boot OOM.
+    """
+
+    def __init__(self):
+        self.phases: Dict[str, Dict] = {}
+        # Publish the (empty) dict immediately: a retry's boot that dies
+        # BEFORE its first phase must not leave the previous attempt's
+        # breakdown in LAST_BOOT_PHASES to be mislabeled as its own.
+        self._publish()
+
+    @contextmanager
+    def phase(self, name: str):
+        t0 = time.perf_counter()
+        before, _ = _device_memory()
+        try:
+            yield
+        except BaseException:
+            self._record(name, t0, before, failed=True)
+            raise
+        self._record(name, t0, before)
+
+    def note(self, name: str, seconds: float) -> None:
+        """Record an externally timed phase (e.g. the first serving
+        call's compile+execute, measured where it runs)."""
+        after, peak = _device_memory()
+        self.phases[name] = {
+            "seconds": round(seconds, 3),
+            "bytes_in_use": after,
+            "peak_bytes_in_use": peak,
+        }
+        self._publish()
+
+    def _record(self, name, t0, before, failed: bool = False) -> None:
+        after, peak = _device_memory()
+        entry = {
+            "seconds": round(time.perf_counter() - t0, 3),
+            "bytes_in_use_before": before,
+            "bytes_in_use": after,
+            "peak_bytes_in_use": peak,
+        }
+        if failed:
+            entry["failed"] = True
+        self.phases[name] = entry
+        self._publish()
+
+    def _publish(self) -> None:
+        global LAST_BOOT_PHASES
+        LAST_BOOT_PHASES = self.phases
 
 # Q1/Q2 metric families — single source of truth for the CSV column
 # sections below AND the track_* gating in build_metrics_payload (a

@@ -135,6 +135,30 @@ def _is_transient(exc: BaseException) -> bool:
     return any(m in text for m in _TRANSIENT_MARKERS)
 
 
+# Env overrides that change the SERVED configuration.  The watcher's
+# results/hw_r*/bench_default.json is only a same-config citation for a
+# run with none of these set — a failed BENCH_MODEL=bcg-tpu/bench-14b
+# run once risked embedding the default-config number labeled as
+# same-config (ADVICE round-5 low; provenance in the permanent record).
+# Measurement-window knobs (BENCH_ROUNDS/WARMUP/ATTACH_TIMEOUT/
+# PROFILE_DIR) don't change the config and stay out of this list.
+# BCG_TPU_* operational flags that change the served path (kernel
+# kill-switches, ladder/precision A/B knobs) count as overrides too.
+_CONFIG_OVERRIDE_ENVS = (
+    "BENCH_MODEL", "BENCH_BACKEND", "BENCH_QUANTIZATION", "BENCH_KV_DTYPE",
+    "BENCH_FAST_FORWARD", "BENCH_COMPACT_JSON", "BENCH_PREFIX_CACHING",
+    "BENCH_SHARED_CORE", "BENCH_PREFILL_CHUNK", "BENCH_SCAN_LAYERS",
+    "BENCH_ATTENTION_IMPL", "BENCH_CONCURRENCY", "BENCH_FORCE_CPU",
+    "BCG_TPU_DISABLE_INT8_DECODE_KERNEL", "BCG_TPU_DISABLE_W4_KERNEL",
+    "BCG_TPU_ALLOW_PADDED_GROUP_KERNEL", "BCG_TPU_FINE_SUFFIX",
+    "BCG_TPU_W8A16_PREFILL",
+)
+
+
+def _is_default_config() -> bool:
+    return not any(os.environ.get(v) for v in _CONFIG_OVERRIDE_ENVS)
+
+
 def _error_result(exc: BaseException, retried: bool) -> dict:
     tb = traceback.format_exception(type(exc), exc, exc.__traceback__)
     out = {
@@ -147,18 +171,32 @@ def _error_result(exc: BaseException, retried: bool) -> dict:
                     else "; not retried (non-transient)"),
         "traceback_tail": "".join(tb)[-1000:],
     }
+    # Boot-phase breakdown of the failed attempt (engine boots record
+    # into runtime.metrics.LAST_BOOT_PHASES even when construction
+    # dies mid-phase): a RESOURCE_EXHAUSTED error line now names the
+    # phase — init / quantize / stack / first compile — it died in.
+    try:
+        from bcg_tpu.runtime import metrics as _metrics
+
+        if _metrics.LAST_BOOT_PHASES:
+            out["boot_phases"] = _metrics.LAST_BOOT_PHASES
+    except Exception:
+        pass
     # Honesty + provenance on outage: `value` stays 0.0 (this run
     # measured nothing), but if the hardware-recovery watcher recorded a
     # same-config result EARLIER (results/hw_r*/bench_default.json), cite
     # it so a tunnel outage at the driver's bench minute doesn't erase
-    # the round's actual measured number from the record.
+    # the round's actual measured number from the record.  Only when
+    # this run IS the default config: the watcher file is the default
+    # arm, and an overridden run (BENCH_MODEL/BENCH_KV_DTYPE/...) must
+    # not embed another config's number as "same-config".
     try:
         import glob as _glob
 
         rounds = [
             d for d in _glob.glob("results/hw_r*")
             if os.path.isdir(d) and d.rsplit("hw_r", 1)[1].isdigit()
-        ]
+        ] if _is_default_config() else []
         if rounds:
             newest = max(rounds, key=lambda d: int(d.rsplit("hw_r", 1)[1]))
             path = os.path.join(newest, "bench_default.json")
@@ -505,6 +543,11 @@ def _run_attempt(cfg, model: str, backend: str, concurrency: int,
             "boot_plus_first_round_s": (
                 round(first_round_s, 2) if first_round_s is not None else None
             ),
+            # Per-phase boot breakdown (seconds + allocator readings):
+            # init_params / quantize / stack / shard / first_compile —
+            # the phase attribution the next boot-time OOM needs
+            # (runtime/metrics.py BootPhaseRecorder).
+            "boot_phases": getattr(engine, "boot_phases", None),
             "window_decode_steps": window_steps,
             "window_failed_row_fraction": round(failed_fraction, 4),
             "baseline_denominator_dec_per_sec": (

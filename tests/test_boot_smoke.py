@@ -1,0 +1,41 @@
+"""Wire scripts/boot_smoke.py into the tier-1 suite: every preset
+(14B/32B included) must abstract-boot — plan + shardings + HBM
+accounting — without materializing weights."""
+
+import importlib.util
+import os
+
+import jax
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices"
+)
+
+
+def _load_boot_smoke():
+    path = os.path.join(REPO, "scripts", "boot_smoke.py")
+    spec = importlib.util.spec_from_file_location("boot_smoke", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_all_presets_abstract_boot():
+    boot_smoke = _load_boot_smoke()
+    problems = boot_smoke.run_all(verbose=False)
+    assert problems == []
+
+
+def test_smoke_catches_bad_sharding():
+    # The smoke is only worth wiring in if it actually FAILS on an
+    # inconsistency: a mesh whose tp doesn't divide the 14B vocab dim
+    # must surface as a placement problem, not pass silently.
+    boot_smoke = _load_boot_smoke()
+    from bcg_tpu.parallel.mesh import build_mesh
+
+    mesh = build_mesh(dp=1, tp=5, sp=1)  # 151936 % 5 != 0
+    problems = boot_smoke.check_preset("bcg-tpu/bench-14b", mesh, "int8")
+    assert any("does not place" in p for p in problems)

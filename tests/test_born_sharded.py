@@ -1,0 +1,197 @@
+"""Born-sharded parameter instantiation (models/loader.py
+init_random_params_sharded) and the analytic boot-memory accounting
+(loader.boot_peak_report) — the flagship-scale boot path that replaces
+eager unsharded ``init_params`` for hermetic presets.
+
+Runs on the 8-virtual-device CPU mesh from conftest.py
+(XLA_FLAGS=--xla_force_host_platform_device_count=8).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from bcg_tpu.models.configs import MODEL_SPECS, spec_for_model
+from bcg_tpu.models.loader import boot_peak_report, init_random_params_sharded
+from bcg_tpu.models.quantize import quantize_leaf_transform
+from bcg_tpu.models.transformer import (
+    assemble_param_tree,
+    init_params,
+    param_plan,
+    stack_layer_params,
+)
+from bcg_tpu.parallel import build_mesh
+from bcg_tpu.parallel.sharding import param_sharding
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices"
+)
+
+TINY = "bcg-tpu/tiny-test"
+
+
+def _walk(params):
+    """(logical, leaf) pairs over a param tree, quantized sub-leaves
+    included ("layers.0.wq.q" style paths)."""
+    for top, v in params.items():
+        if top == "layers":
+            for li, layer in enumerate(v):
+                for name, leaf in layer.items():
+                    if isinstance(leaf, dict):
+                        for sub, s in leaf.items():
+                            yield f"layers.{li}.{name}.{sub}", s
+                    else:
+                        yield f"layers.{li}.{name}", leaf
+        elif isinstance(v, dict):
+            for sub, s in v.items():
+                yield f"{top}.{sub}", s
+        else:
+            yield top, v
+
+
+class TestBornShardedInit:
+    def test_plan_matches_eager_structure(self):
+        spec = spec_for_model(TINY)
+        eager = init_params(spec, jax.random.PRNGKey(0))
+        plan_tree = assemble_param_tree(
+            (logical, jax.ShapeDtypeStruct(shape, jax.numpy.bfloat16))
+            for logical, _kind, shape in param_plan(spec)
+        )
+        assert jax.tree.structure(eager) == jax.tree.structure(plan_tree)
+        for a, b in zip(jax.tree.leaves(eager), jax.tree.leaves(plan_tree)):
+            assert a.shape == b.shape
+
+    def test_values_mesh_shape_invariant(self):
+        # Same seed -> same weights at mesh=None, tp=2 and dp2/tp2/sp2:
+        # the partitionable-RNG scope makes the served model independent
+        # of the parallelism config.
+        spec = spec_for_model(TINY)
+        key = jax.random.PRNGKey(0)
+        base = init_random_params_sharded(spec, key)
+        for mesh in (build_mesh(dp=1, tp=2, sp=1), build_mesh(dp=2, tp=2, sp=2)):
+            got = init_random_params_sharded(spec, key, mesh=mesh)
+            jax.tree.map(
+                lambda x, y: np.testing.assert_array_equal(
+                    np.asarray(x), np.asarray(y)
+                ),
+                base, got,
+            )
+
+    def test_every_leaf_carries_prescribed_sharding(self):
+        spec = spec_for_model(TINY)
+        mesh = build_mesh(dp=2, tp=2, sp=2)
+        params = init_random_params_sharded(
+            spec, jax.random.PRNGKey(0), mesh=mesh
+        )
+        for logical, leaf in _walk(params):
+            expected = param_sharding(logical, spec, mesh)
+            assert leaf.sharding == expected, (
+                f"{logical}: {leaf.sharding} != {expected}"
+            )
+
+    def test_quantized_leaves_carry_prescribed_sharding(self):
+        # The acceptance property: quantize happens INSIDE the per-leaf
+        # jit, and the {"q","scale"} outputs land directly under their
+        # param_sharding — no unsharded full-precision leaf in between.
+        spec = spec_for_model(TINY)
+        mesh = build_mesh(dp=1, tp=2, sp=1)
+        params = init_random_params_sharded(
+            spec, jax.random.PRNGKey(0), mesh=mesh,
+            leaf_transform=quantize_leaf_transform(spec, "int8"),
+        )
+        wq = params["layers"][0]["wq"]
+        assert sorted(wq.keys()) == ["q", "scale"]
+        for logical, leaf in _walk(params):
+            expected = param_sharding(logical, spec, mesh)
+            assert leaf.sharding == expected, (
+                f"{logical}: {leaf.sharding} != {expected}"
+            )
+
+    def test_quantized_values_match_post_hoc_quantization(self):
+        # Born-quantized == quantize-after-init for the same weights
+        # (same _quantize_impl, just jitted per leaf with out_shardings).
+        spec = spec_for_model(TINY)
+        mesh = build_mesh(dp=1, tp=2, sp=1)
+        transform = quantize_leaf_transform(spec, "int8")
+        born = init_random_params_sharded(
+            spec, jax.random.PRNGKey(0), mesh=mesh, leaf_transform=transform,
+        )
+        plain = init_random_params_sharded(spec, jax.random.PRNGKey(0))
+        ref = transform("layers.0.wq", plain["layers"][0]["wq"])
+        np.testing.assert_array_equal(
+            np.asarray(born["layers"][0]["wq"]["q"]), np.asarray(ref["q"])
+        )
+
+    def test_stack_keeps_sharding_and_values(self):
+        spec = spec_for_model(TINY)
+        mesh = build_mesh(dp=1, tp=2, sp=1)
+        transform = quantize_leaf_transform(spec, "int8")
+        params = init_random_params_sharded(
+            spec, jax.random.PRNGKey(0), mesh=mesh, leaf_transform=transform,
+        )
+        reference = init_random_params_sharded(
+            spec, jax.random.PRNGKey(0), mesh=mesh, leaf_transform=transform,
+        )
+        stacked = stack_layer_params(params, consume=True, mesh=mesh, spec=spec)
+        wq = stacked["layers"]["wq"]
+        assert wq["q"].shape[0] == spec.num_layers
+        assert wq["q"].sharding == param_sharding(
+            "layers.wq.q", spec, mesh, stacked=True
+        )
+        # Values survive the donated, out_sharded stack.
+        ref_stack = np.stack(
+            [np.asarray(l["wq"]["q"]) for l in reference["layers"]]
+        )
+        np.testing.assert_array_equal(np.asarray(wq["q"]), ref_stack)
+
+
+class TestBootAccounting:
+    """Analytic (eval_shape, no weights) per-device boot-peak accounting
+    for flagship specs — the 14B acceptance criterion."""
+
+    def _assert_contract(self, report):
+        headroom = max(
+            report["max_leaf_group_bytes"], report["max_init_transient_bytes"]
+        )
+        assert report["peak_bytes_per_device"] <= (
+            report["final_bytes_per_device"] + headroom
+        )
+
+    def test_14b_int4_tp8_peak_bound(self):
+        spec = MODEL_SPECS["bcg-tpu/bench-14b"]
+        mesh = build_mesh(dp=1, tp=8, sp=1)
+        report = boot_peak_report(spec, mesh=mesh, quantization="int4")
+        self._assert_contract(report)
+        # No unsharded full-precision leaf at any point: the biggest
+        # init transient is a SHARD, strictly below the full fp32 embed
+        # the old eager init staged on one device.
+        full_embed_fp32 = spec.vocab_size * spec.hidden_size * 4
+        assert report["max_init_transient_bytes"] < full_embed_fp32
+        # Absolute scale: a 14B int4 boot fits one 16 GB v5e chip's
+        # share with the decode budget untouched (~1.6 GB peak at tp=8).
+        assert report["peak_bytes_per_device"] < 4 << 30
+
+    def test_14b_int8_tp2_peak_bound(self):
+        spec = MODEL_SPECS["bcg-tpu/bench-14b"]
+        mesh = build_mesh(dp=1, tp=2, sp=1)
+        report = boot_peak_report(spec, mesh=mesh, quantization="int8")
+        self._assert_contract(report)
+        # int8 14B across two 16 GB chips: weights ~7.5 GB/device, the
+        # boot transient must not add more than one leaf-group on top.
+        assert report["peak_bytes_per_device"] < 12 << 30
+
+    def test_single_device_path(self):
+        spec = spec_for_model(TINY)
+        report = boot_peak_report(spec, mesh=None, quantization=None)
+        self._assert_contract(report)
+        assert report["devices"] == 1
+
+    def test_peak_drops_with_tp(self):
+        spec = MODEL_SPECS["bcg-tpu/bench-8b"]
+        r2 = boot_peak_report(
+            spec, mesh=build_mesh(dp=1, tp=2, sp=1), quantization="int8"
+        )
+        r8 = boot_peak_report(
+            spec, mesh=build_mesh(dp=1, tp=8, sp=1), quantization="int8"
+        )
+        assert r8["peak_bytes_per_device"] < r2["peak_bytes_per_device"]
