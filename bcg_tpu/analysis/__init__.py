@@ -1,0 +1,59 @@
+"""JAX-aware static lint suite for the bcg_tpu codebase.
+
+Every hardware regression this repo has eaten — KV overcommit from a
+raw-mesh-size divisor, boot OOM from eagerly-materialized unsharded
+leaves, typo'd env knobs silently ignored — was a mechanically
+detectable pattern.  This package is the mechanism: an AST analyzer
+specialized for this codebase's JAX-on-TPU hazards, run over the whole
+package as a tier-1 test (``tests/test_analysis.py``) and standalone as
+``python -m bcg_tpu.analysis`` / ``scripts/lint.py``.
+
+Rule catalog (stable IDs — see DESIGN.md "Static analysis pass"):
+
+* ``BCG-HOST-SYNC``     host↔device sync (``.item()``, ``device_get``,
+                        ``block_until_ready``, ``np.asarray``) inside a
+                        jitted region or a ``lax`` loop body
+* ``BCG-JIT-NP``        other ``np.*`` calls inside jitted regions
+* ``BCG-JIT-BRANCH``    Python ``if``/``while`` on a (non-static) traced
+                        parameter of a jitted function
+* ``BCG-JIT-OUTSHARD``  parameter-materializing ``jax.jit`` in models/ or
+                        parallel/ without ``out_shardings``
+* ``BCG-JIT-DONATE``    sharded-output jit taking array args without
+                        ``donate_argnums``
+* ``BCG-SHARD-AXIS``    ``PartitionSpec`` axis names not defined by
+                        ``parallel/mesh.py``
+* ``BCG-SHARD-DIVISOR`` per-device byte accounting dividing by raw mesh
+                        size instead of engaged axes
+* ``BCG-ENV-RAW``       raw ``os.environ`` read of a registered flag name
+                        outside ``runtime/envflags.py``
+* ``BCG-ENV-UNREG``     ``envflags`` accessor call with an unregistered
+                        flag name
+* ``BCG-EXCEPT-BROAD``  ``except Exception`` that neither re-raises,
+                        logs, nor inspects the exception
+* ``BCG-MUT-DEFAULT``   mutable default argument values
+
+Suppression: a checked-in baseline (``lint_baseline.json``) parks
+existing deliberate violations with a one-line justification each;
+``# lint: ignore[RULE-ID]`` suppresses inline.
+"""
+
+from bcg_tpu.analysis.core import (
+    AnalysisResult,
+    Finding,
+    analyze_paths,
+    default_paths,
+    load_baseline,
+    repo_root,
+)
+from bcg_tpu.analysis.rules import ALL_RULES, RULE_IDS
+
+__all__ = [
+    "ALL_RULES",
+    "RULE_IDS",
+    "AnalysisResult",
+    "Finding",
+    "analyze_paths",
+    "default_paths",
+    "load_baseline",
+    "repo_root",
+]

@@ -1,0 +1,90 @@
+"""CLI: ``python -m bcg_tpu.analysis [paths...]``.
+
+Exit status: 0 = no unsuppressed findings and no parse errors; 1
+otherwise.  Unused baseline entries are reported on stderr (full-tree
+runs only — a partial run never visits most baselined files) but never
+affect the exit status; the load-bearing check lives in
+``tests/test_analysis.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from bcg_tpu.analysis.core import (
+    analyze_paths,
+    baseline_path,
+    default_paths,
+    load_baseline,
+)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m bcg_tpu.analysis",
+        description="JAX-aware static lint for the bcg_tpu codebase",
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files/directories to analyze (default: whole package)",
+    )
+    parser.add_argument(
+        "--baseline", default=None,
+        help=f"baseline JSON (default: {baseline_path()})",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="report every finding, ignoring the baseline",
+    )
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="machine-readable output",
+    )
+    parser.add_argument(
+        "--show-baselined", action="store_true",
+        help="also list findings matched by the baseline",
+    )
+    args = parser.parse_args(argv)
+
+    baseline = [] if args.no_baseline else load_baseline(args.baseline)
+    result = analyze_paths(paths=args.paths or default_paths(), baseline=baseline)
+
+    if args.as_json:
+        print(json.dumps({
+            "files_scanned": result.files_scanned,
+            "findings": [f.__dict__ for f in result.findings],
+            "baselined": [f.__dict__ for f in result.baselined],
+            "unused_baseline": [e.__dict__ for e in result.unused_baseline],
+            "parse_errors": result.parse_errors,
+        }, indent=2))
+    else:
+        for f in result.findings:
+            print(f.format())
+        if args.show_baselined:
+            for f in result.baselined:
+                print(f"[baselined] {f.format()}")
+        for err in result.parse_errors:
+            print(f"parse error: {err}", file=sys.stderr)
+        # A partial run (explicit paths / --diff) never visits most
+        # baselined files — "unused" is only meaningful on the full tree.
+        if not args.paths:
+            for e in result.unused_baseline:
+                print(
+                    f"unused baseline entry: {e.rule} {e.path} {e.content!r}",
+                    file=sys.stderr,
+                )
+        print(
+            f"{result.files_scanned} files, {len(result.findings)} findings "
+            f"({len(result.baselined)} baselined)",
+            file=sys.stderr,
+        )
+    if result.findings or result.parse_errors:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,361 @@
+"""Analyzer engine: file walking, per-module AST context, jit-region
+resolution, baseline/suppression handling.
+
+Rules live in :mod:`bcg_tpu.analysis.rules`; each is a callable
+``rule(ctx: ModuleContext) -> Iterable[Finding]``.  The engine parses
+each file once, builds the shared context (source lines, jit-region
+function set, inline-suppression map), runs every rule, then subtracts
+baseline matches.
+
+Baseline entries match on ``(rule, path, stripped source line)`` — NOT
+line numbers — so unrelated edits don't invalidate them, while deleting
+or fixing the flagged line retires the entry (the meta-test in
+``tests/test_analysis.py`` asserts every entry still matches a real
+finding: the baseline is load-bearing, not a blanket mute).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+_IGNORE_RE = re.compile(r"#\s*lint:\s*ignore\[([A-Z0-9,\-\s]+)\]")
+
+# Callables that take (cond, body)-style function operands whose bodies
+# trace like jit regions.
+_LAX_HOF_NAMES = {"while_loop", "scan", "fori_loop", "cond", "switch", "map"}
+
+
+def repo_root() -> str:
+    """The repository root (two levels above this package)."""
+    return os.path.abspath(
+        os.path.join(os.path.dirname(__file__), os.pardir, os.pardir)
+    )
+
+
+def default_paths() -> List[str]:
+    """The tree the repo-wide run covers (tests/fixtures excluded —
+    fixtures contain violations on purpose)."""
+    root = repo_root()
+    paths = [os.path.join(root, "bcg_tpu"), os.path.join(root, "scripts")]
+    for name in ("bench.py", "__graft_entry__.py"):
+        cand = os.path.join(root, name)
+        if os.path.exists(cand):
+            paths.append(cand)
+    return paths
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative, posix separators
+    line: int
+    message: str
+    content: str  # stripped source of the flagged line (baseline key)
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.content)
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+@dataclass
+class BaselineEntry:
+    rule: str
+    path: str
+    content: str
+    reason: str
+    # Max occurrences this entry suppresses.  Identical source lines
+    # (several bare ``except Exception:`` in one file) share a key, and
+    # an uncapped entry would silently park every FUTURE violation with
+    # the same text too — the blanket mute the baseline must not be.
+    count: int = 1
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.content)
+
+
+class ModuleContext:
+    """Everything a rule needs about one parsed file."""
+
+    def __init__(self, path: str, rel_path: str, source: str):
+        self.path = path
+        self.rel_path = rel_path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self._parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[child] = parent
+        self.jit_regions = _resolve_jit_regions(self.tree)
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(node)
+
+    def line_content(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def suppressed(self, lineno: int, rule: str) -> bool:
+        """Inline ``# lint: ignore[RULE]`` on the flagged line (or the
+        line above, for flagged multi-line statements)."""
+        for ln in (lineno, lineno - 1):
+            m = _IGNORE_RE.search(self.line_content(ln))
+            if m:
+                ids = {s.strip() for s in m.group(1).split(",")}
+                if rule in ids or "*" in ids:
+                    return True
+        return False
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        lineno = getattr(node, "lineno", 1)
+        return Finding(
+            rule=rule,
+            path=self.rel_path,
+            line=lineno,
+            message=message,
+            content=self.line_content(lineno),
+        )
+
+    def in_jit_region(self, node: ast.AST) -> bool:
+        """Is ``node`` lexically inside a function (or lambda) that
+        traces under jit or a lax control-flow body, directly or
+        transitively?"""
+        cur = self._parents.get(node)
+        while cur is not None:
+            if isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                if cur in self.jit_regions:
+                    return True
+            cur = self._parents.get(cur)
+        return False
+
+
+def _call_name(func: ast.AST) -> str:
+    """Dotted name of a call target, e.g. ``jax.lax.while_loop`` ->
+    'jax.lax.while_loop'; non-name shapes -> ''."""
+    parts: List[str] = []
+    cur = func
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def is_jit_callable(node: ast.AST) -> bool:
+    """Does this expression denote ``jax.jit`` (possibly via
+    ``partial(jax.jit, ...)``)?"""
+    name = _call_name(node)
+    if name in ("jax.jit", "jit"):
+        return True
+    if isinstance(node, ast.Call):
+        fname = _call_name(node.func)
+        if fname in ("partial", "functools.partial") and node.args:
+            return is_jit_callable(node.args[0])
+    return False
+
+
+def jit_call_kwargs(node: ast.AST) -> Set[str]:
+    """Keyword names attached to a jit wrapper expression, looking
+    through ``partial(jax.jit, kw=...)`` and ``jax.jit(fn, kw=...)``."""
+    kwargs: Set[str] = set()
+    if isinstance(node, ast.Call):
+        for kw in node.keywords:
+            if kw.arg:
+                kwargs.add(kw.arg)
+        fname = _call_name(node.func)
+        if fname in ("partial", "functools.partial") and node.args:
+            kwargs |= jit_call_kwargs(node.args[0])
+        # partial(jax.jit, ...)(fn): outer call's func is the partial call
+        if isinstance(node.func, ast.Call):
+            kwargs |= jit_call_kwargs(node.func)
+    return kwargs
+
+
+def _resolve_jit_regions(tree: ast.Module) -> Set[ast.AST]:
+    """The set of FunctionDef nodes whose bodies trace under jit.
+
+    Roots: functions decorated with ``jax.jit`` / ``partial(jax.jit,..)``,
+    functions whose NAME is passed to a ``jax.jit(...)`` call or a
+    ``lax.while_loop/scan/cond/...`` operand position anywhere in the
+    module.  Then a fixpoint closure over intra-module calls: a function
+    invoked by simple name from inside a jit region traces too.
+    """
+    funcs_by_name: Dict[str, List[ast.AST]] = {}
+    all_funcs: List[ast.AST] = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            funcs_by_name.setdefault(node.name, []).append(node)
+            all_funcs.append(node)
+
+    regions: Set[ast.AST] = set()
+
+    def mark_by_name(name: str) -> None:
+        for fn in funcs_by_name.get(name, []):
+            regions.add(fn)
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if is_jit_callable(dec):
+                    regions.add(node)
+        elif isinstance(node, ast.Call):
+            name = _call_name(node.func)
+            if is_jit_callable(node.func) and node.args:
+                first = node.args[0]
+                if isinstance(first, ast.Name):
+                    mark_by_name(first.id)
+            # partial(jax.jit, ...)(fn)
+            if (
+                isinstance(node.func, ast.Call)
+                and is_jit_callable(node.func)
+                and node.args
+                and isinstance(node.args[0], ast.Name)
+            ):
+                mark_by_name(node.args[0].id)
+            short = name.rsplit(".", 1)[-1]
+            # Exact lax spelling only: a permissive `jax.*` match would
+            # drag in jax.tree.map, whose function runs EAGERLY on host
+            # (convert-before-device_put is an established idiom here).
+            is_lax_hof = short in _LAX_HOF_NAMES and (
+                name == f"lax.{short}"
+                or name == f"jax.lax.{short}"
+                or (name == short and short in ("while_loop", "fori_loop"))
+            )
+            if is_lax_hof:
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        mark_by_name(arg.id)
+                    elif isinstance(arg, ast.Lambda):
+                        regions.add(arg)
+
+    # Fixpoint: calls by simple name from inside a region pull the callee in.
+    changed = True
+    while changed:
+        changed = False
+        for region in list(regions):
+            for node in ast.walk(region):
+                if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                    for fn in funcs_by_name.get(node.func.id, []):
+                        if fn not in regions:
+                            regions.add(fn)
+                            changed = True
+    return regions
+
+
+# ------------------------------------------------------------- baseline
+def baseline_path() -> str:
+    return os.path.join(repo_root(), "lint_baseline.json")
+
+
+def load_baseline(path: Optional[str] = None) -> List[BaselineEntry]:
+    path = path or baseline_path()
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        data = json.load(f)
+    entries = []
+    for row in data.get("suppressions", []):
+        entries.append(
+            BaselineEntry(
+                rule=row["rule"],
+                path=row["path"],
+                content=row["content"],
+                reason=row.get("reason", ""),
+                count=int(row.get("count", 1)),
+            )
+        )
+    return entries
+
+
+@dataclass
+class AnalysisResult:
+    findings: List[Finding] = field(default_factory=list)  # unsuppressed
+    baselined: List[Finding] = field(default_factory=list)
+    unused_baseline: List[BaselineEntry] = field(default_factory=list)
+    files_scanned: int = 0
+    parse_errors: List[str] = field(default_factory=list)
+
+
+def iter_python_files(paths: Sequence[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+        elif os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [
+                    d for d in dirnames
+                    if d not in ("__pycache__", ".git", "analysis_fixtures")
+                ]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+
+
+def analyze_paths(
+    paths: Optional[Sequence[str]] = None,
+    rules: Optional[Sequence] = None,
+    baseline: Optional[Sequence[BaselineEntry]] = None,
+) -> AnalysisResult:
+    """Run ``rules`` over every python file under ``paths``.
+
+    ``baseline=None`` means "no baseline" (all findings reported);
+    callers wanting the checked-in baseline pass ``load_baseline()``.
+    """
+    from bcg_tpu.analysis.rules import ALL_RULES
+
+    paths = list(paths) if paths else default_paths()
+    rules = list(rules) if rules is not None else list(ALL_RULES)
+    baseline = list(baseline) if baseline else []
+    root = repo_root()
+
+    result = AnalysisResult()
+    raw: List[Finding] = []
+    for file_path in iter_python_files(paths):
+        rel = os.path.relpath(os.path.abspath(file_path), root).replace(
+            os.sep, "/"
+        )
+        try:
+            with open(file_path, encoding="utf-8") as f:
+                source = f.read()
+            ctx = ModuleContext(file_path, rel, source)
+        except (SyntaxError, UnicodeDecodeError) as exc:
+            result.parse_errors.append(f"{rel}: {exc}")
+            continue
+        result.files_scanned += 1
+        for rule in rules:
+            for finding in rule(ctx):
+                if not ctx.suppressed(finding.line, finding.rule):
+                    raw.append(finding)
+
+    matched_keys: Set[Tuple[str, str, str]] = set()
+    budget: Dict[Tuple[str, str, str], int] = {}
+    for e in baseline:
+        budget[e.key()] = budget.get(e.key(), 0) + max(1, e.count)
+    raw.sort(key=lambda f: (f.path, f.line, f.rule))
+    for finding in raw:
+        if budget.get(finding.key(), 0) > 0:
+            budget[finding.key()] -= 1
+            matched_keys.add(finding.key())
+            result.baselined.append(finding)
+        else:
+            # Over-budget duplicates of a baselined line are NEW debt —
+            # they resurface instead of riding the existing entry.
+            result.findings.append(finding)
+    result.unused_baseline = [
+        e for e in baseline if e.key() not in matched_keys
+    ]
+    result.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return result

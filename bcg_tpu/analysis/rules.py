@@ -1,0 +1,600 @@
+"""Rule implementations.
+
+Each rule is ``rule(ctx: ModuleContext) -> Iterable[Finding]`` with a
+stable ``.rule_id`` attribute.  Rules are deliberately heuristic — the
+goal is catching this codebase's recurring hazard patterns cheaply, not
+soundness; deliberate violations are parked in ``lint_baseline.json``
+with a justification, and ``# lint: ignore[ID]`` suppresses inline.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterable, List, Optional, Sequence, Set
+
+from bcg_tpu.analysis.core import (
+    Finding,
+    ModuleContext,
+    _call_name,
+    is_jit_callable,
+    jit_call_kwargs,
+    repo_root,
+)
+
+# Env-flag name shapes owned by this repo (see runtime/envflags.py).
+_ENV_NAME_RE = re.compile(r"^(BCG_TPU_|BENCH_|MB_)\w*$|^VERBOSE$")
+_ENV_ACCESSORS = {"get_bool", "get_int", "get_str", "is_set", "env_flag"}
+_NP_BASES = {"np", "numpy", "onp"}
+_HOST_MATERIALIZE = {"asarray", "array"}
+_LOGGY_RE = re.compile(r"log|warn|print|debug|echo|exception|progress", re.I)
+
+
+def _rule(rule_id: str):
+    def wrap(fn):
+        fn.rule_id = rule_id
+        return fn
+    return wrap
+
+
+def _registered_env_names() -> Set[str]:
+    from bcg_tpu.runtime.envflags import REGISTRY
+
+    return set(REGISTRY)
+
+
+_MESH_AXES_MEMO: Optional[Set[str]] = None
+
+
+def _mesh_axes() -> Set[str]:
+    """Axis names ``parallel/mesh.py`` actually defines — parsed from
+    source so the rule tracks the single source of truth (memoized:
+    static per process, and rule_shard_axis runs once per module)."""
+    global _MESH_AXES_MEMO
+    if _MESH_AXES_MEMO is not None:
+        return _MESH_AXES_MEMO
+    _MESH_AXES_MEMO = _parse_mesh_axes()
+    return _MESH_AXES_MEMO
+
+
+def _parse_mesh_axes() -> Set[str]:
+    mesh_py = os.path.join(repo_root(), "bcg_tpu", "parallel", "mesh.py")
+    try:
+        with open(mesh_py) as fh:
+            source = fh.read()
+        tree = ast.parse(source)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign):
+                targets = [
+                    t.id for t in node.targets if isinstance(t, ast.Name)
+                ]
+                if "AXES" in targets and isinstance(
+                    node.value, (ast.Tuple, ast.List)
+                ):
+                    names = set()
+                    for elt in node.value.elts:
+                        if isinstance(elt, ast.Constant) and isinstance(
+                            elt.value, str
+                        ):
+                            names.add(elt.value)
+                    if names:
+                        return names
+    except (OSError, SyntaxError):
+        pass
+    return {"dp", "tp", "sp"}
+
+
+# ------------------------------------------------------------ rule: host sync
+@_rule("BCG-HOST-SYNC")
+def rule_host_sync(ctx: ModuleContext) -> Iterable[Finding]:
+    """Host↔device synchronization inside a traced region: ``.item()``,
+    ``jax.device_get``, ``block_until_ready``, ``np.asarray``/``np.array``.
+    Inside jit these either fail at trace time or silently force a
+    device round-trip per retrace — in the decode loop that is a stall
+    per token step."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not ctx.in_jit_region(node):
+            continue
+        what = None
+        if isinstance(node.func, ast.Attribute):
+            base = _call_name(node.func.value)
+            if node.func.attr == "item" and not node.args:
+                what = ".item()"
+            elif node.func.attr == "block_until_ready":
+                what = ".block_until_ready()"
+            elif (
+                base.split(".")[0] in _NP_BASES
+                and node.func.attr in _HOST_MATERIALIZE
+            ):
+                what = f"{base}.{node.func.attr}()"
+        name = _call_name(node.func)
+        if name in ("jax.device_get", "device_get"):
+            what = name + "()"
+        if what:
+            yield ctx.finding(
+                "BCG-HOST-SYNC",
+                node,
+                f"host-sync call {what} inside a jitted/traced region",
+            )
+
+
+# --------------------------------------------------------- rule: np under jit
+@_rule("BCG-JIT-NP")
+def rule_jit_np(ctx: ModuleContext) -> Iterable[Finding]:
+    """``np.*`` calls inside a jitted/traced region: numpy executes on
+    the host at trace time, so the result is baked in as a constant (or
+    the trace fails on tracer input) — use ``jnp``/``lax``."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not ctx.in_jit_region(node):
+            continue
+        if isinstance(node.func, ast.Attribute):
+            base = _call_name(node.func.value)
+            if (
+                base.split(".")[0] in _NP_BASES
+                and node.func.attr not in _HOST_MATERIALIZE
+            ):
+                yield ctx.finding(
+                    "BCG-JIT-NP",
+                    node,
+                    f"numpy call {base}.{node.func.attr}() inside a "
+                    "jitted/traced region (host-side, baked in at trace "
+                    "time) — use jnp/lax",
+                )
+
+
+# ------------------------------------------------------ rule: tracer branching
+def _jit_static_names(ctx: ModuleContext, fn: ast.AST) -> Set[str]:
+    """static_argnums/static_argnames declared for ``fn`` across its
+    decorators and any ``jax.jit(fn, ...)`` call sites in the module."""
+    static: Set[str] = set()
+    pos_params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+
+    def collect(call_like: ast.AST) -> None:
+        if not isinstance(call_like, ast.Call):
+            return
+        for kw in call_like.keywords:
+            if kw.arg == "static_argnames":
+                for c in ast.walk(kw.value):
+                    if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                        static.add(c.value)
+            elif kw.arg == "static_argnums":
+                for c in ast.walk(kw.value):
+                    if isinstance(c, ast.Constant) and isinstance(c.value, int):
+                        if 0 <= c.value < len(pos_params):
+                            static.add(pos_params[c.value])
+        fname = _call_name(call_like.func)
+        if fname in ("partial", "functools.partial") and call_like.args:
+            collect(call_like.args[0])
+        if isinstance(call_like.func, ast.Call):
+            collect(call_like.func)
+
+    for dec in fn.decorator_list:
+        if is_jit_callable(dec):
+            collect(dec)
+    for node in ast.walk(ctx.tree):
+        if (
+            isinstance(node, ast.Call)
+            and is_jit_callable(node.func)
+            and node.args
+            and isinstance(node.args[0], ast.Name)
+            and node.args[0].id == getattr(fn, "name", None)
+        ):
+            collect(node)
+    return static
+
+
+@_rule("BCG-JIT-BRANCH")
+def rule_jit_branch(ctx: ModuleContext) -> Iterable[Finding]:
+    """Python ``if``/``while`` on a traced (non-static) parameter of a
+    jit-wrapped function: raises TracerBoolConversionError at trace
+    time, or — when the arg happens to be a python scalar — silently
+    retraces per value.  Branch on ``.shape``/static args, or use
+    ``lax.cond``/``jnp.where``."""
+    for fn in ctx.jit_regions:
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue  # lambda lax operands: params unknowable here
+        has_jit_wrapper = any(
+            is_jit_callable(d) for d in fn.decorator_list
+        ) or any(
+            isinstance(n, ast.Call)
+            and is_jit_callable(n.func)
+            and n.args
+            and isinstance(n.args[0], ast.Name)
+            and n.args[0].id == fn.name
+            for n in ast.walk(ctx.tree)
+        )
+        if not has_jit_wrapper:
+            continue  # lax bodies / transitive callees: params unknowable
+        static = _jit_static_names(ctx, fn)
+        # Params WITH defaults are closure captures (`_kind=kind`) or
+        # optional host values, not traced call arguments.
+        pos = fn.args.posonlyargs + fn.args.args
+        n_defaulted = len(fn.args.defaults)
+        traced_pos = pos[: len(pos) - n_defaulted] if n_defaulted else pos
+        params = {a.arg for a in traced_pos} - static - {"self", "cls"}
+        if not params:
+            continue
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                bad = _traced_name_in_test(ctx, node.test, params)
+                if bad:
+                    yield ctx.finding(
+                        "BCG-JIT-BRANCH",
+                        node,
+                        f"python branch on traced parameter {bad!r} of "
+                        f"jitted {fn.name}() — use lax.cond/jnp.where or "
+                        "mark it static",
+                    )
+
+
+def _traced_name_in_test(
+    ctx: ModuleContext, test: ast.AST, params: Set[str]
+) -> Optional[str]:
+    for node in ast.walk(test):
+        if not (isinstance(node, ast.Name) and node.id in params):
+            continue
+        # x.shape / x.ndim / x.dtype ... — static metadata, fine.
+        parent = ctx.parent(node)
+        skip = False
+        cur, child = parent, node
+        while cur is not None:
+            if isinstance(cur, ast.Attribute) and cur.value is child:
+                skip = True
+                break
+            if isinstance(cur, ast.Call):
+                fname = _call_name(cur.func)
+                if fname in ("len", "isinstance", "hasattr", "getattr", "type"):
+                    skip = True
+                    break
+            if cur is test:
+                break
+            child, cur = cur, ctx.parent(cur)
+        if skip:
+            continue
+        # `x is None` / `x is not None`: optional-arg idiom, static.
+        if isinstance(parent, ast.Compare):
+            operands = [parent.left] + list(parent.comparators)
+            if any(
+                isinstance(o, ast.Constant) and o.value is None
+                for o in operands
+            ):
+                continue
+        return node.id
+    return None
+
+
+# ----------------------------------------------- rules: jit sharding hygiene
+def _in_param_scope(ctx: ModuleContext) -> bool:
+    rel = ctx.rel_path
+    return "/models/" in rel or "/parallel/" in rel or rel.startswith(
+        ("models/", "parallel/")
+    )
+
+
+def _iter_jit_wrappers(ctx: ModuleContext):
+    """Every expression that wraps a function in jax.jit: decorators,
+    ``jax.jit(fn, ...)`` calls, ``partial(jax.jit, ...)(fn)``."""
+    seen = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if is_jit_callable(dec) and id(dec) not in seen:
+                    seen.add(id(dec))
+                    yield dec, node
+        elif isinstance(node, ast.Call) and is_jit_callable(node.func):
+            if id(node) not in seen:
+                seen.add(id(node))
+                wrapped = None
+                if node.args and isinstance(node.args[0], ast.Name):
+                    for fn in ast.walk(ctx.tree):
+                        if (
+                            isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+                            and fn.name == node.args[0].id
+                        ):
+                            wrapped = fn
+                            break
+                yield node, wrapped
+
+
+@_rule("BCG-JIT-OUTSHARD")
+def rule_jit_outshard(ctx: ModuleContext) -> Iterable[Finding]:
+    """In parameter-materializing modules (models/, parallel/): a
+    ``jax.jit`` without ``out_shardings`` materializes its outputs with
+    whatever sharding XLA infers — for param init/quantize/stack paths
+    that is a full unsharded replica per device at boot (the PR-1 boot
+    OOM class).  Pin ``out_shardings`` (or baseline the single-device
+    fallback paths)."""
+    if not _in_param_scope(ctx):
+        return
+    for wrapper, _fn in _iter_jit_wrappers(ctx):
+        if "out_shardings" not in jit_call_kwargs(wrapper):
+            yield ctx.finding(
+                "BCG-JIT-OUTSHARD",
+                wrapper,
+                "jax.jit in a parameter-materializing module without "
+                "out_shardings — outputs materialize unsharded",
+            )
+
+
+@_rule("BCG-JIT-DONATE")
+def rule_jit_donate(ctx: ModuleContext) -> Iterable[Finding]:
+    """In models//parallel/: a jit that PINS sharded outputs but takes
+    array arguments without ``donate_argnums`` holds source + result
+    live simultaneously — the boot-peak doubling the born-sharded path
+    exists to avoid.  Donate the consumed buffer (or baseline the
+    deliberately-preserving variants)."""
+    if not _in_param_scope(ctx):
+        return
+    for wrapper, fn in _iter_jit_wrappers(ctx):
+        kwargs = jit_call_kwargs(wrapper)
+        if "out_shardings" not in kwargs or "donate_argnums" in kwargs:
+            continue
+        if fn is not None:
+            # Only NON-defaulted params are call arguments (defaults are
+            # closure captures); PRNG keys are bytes-trivial, nothing to
+            # donate.
+            pos = fn.args.posonlyargs + fn.args.args
+            n_def = len(fn.args.defaults)
+            call_args = pos[: len(pos) - n_def] if n_def else pos
+            donatable = [
+                a.arg
+                for a in call_args
+                if a.arg not in ("self", "cls")
+                and not re.match(r"^(k|key|rng|seed|prng)", a.arg)
+            ]
+            if not donatable:
+                continue
+        yield ctx.finding(
+            "BCG-JIT-DONATE",
+            wrapper,
+            "sharded-output jax.jit takes array args without "
+            "donate_argnums — source and result both live at peak",
+        )
+
+
+# ------------------------------------------------------ rule: sharding axes
+@_rule("BCG-SHARD-AXIS")
+def rule_shard_axis(ctx: ModuleContext) -> Iterable[Finding]:
+    """PartitionSpec axis names must be axes ``parallel/mesh.py``
+    defines — a typo'd axis name shards nothing, silently replicating
+    the tensor on every device."""
+    if ctx.rel_path.endswith("parallel/mesh.py"):
+        return  # the definition site itself
+    axes = _mesh_axes()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node.func)
+        short = name.rsplit(".", 1)[-1]
+        if short not in ("PartitionSpec", "P"):
+            continue
+        for arg in list(node.args) + [
+            kw.value for kw in node.keywords
+        ]:
+            for c in ast.walk(arg):
+                if (
+                    isinstance(c, ast.Constant)
+                    and isinstance(c.value, str)
+                    and c.value not in axes
+                ):
+                    yield ctx.finding(
+                        "BCG-SHARD-AXIS",
+                        c if hasattr(c, "lineno") else node,
+                        f"PartitionSpec axis {c.value!r} is not a mesh "
+                        f"axis (defined: {sorted(axes)}) — silently "
+                        "replicates",
+                    )
+
+
+# -------------------------------------------------- rule: per-device divisor
+@_rule("BCG-SHARD-DIVISOR")
+def rule_shard_divisor(ctx: ModuleContext) -> Iterable[Finding]:
+    """Per-device byte accounting must divide by the product of ENGAGED
+    mesh axes, not raw device count: an axis that fails its divisibility
+    guard replicates instead of sharding, and dividing by mesh.size then
+    overcommits HBM by that axis's factor (the dp-bypass KV overcommit).
+    Route through ``sharding.kv_cache_bytes_per_device`` /
+    ``tree_bytes_per_device``."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.BinOp) or not isinstance(
+            node.op, (ast.Div, ast.FloorDiv)
+        ):
+            continue
+        right = node.right
+        desc = None
+        dotted = _call_name(right) if not isinstance(right, ast.Call) else ""
+        if dotted:
+            terminal = dotted.rsplit(".", 1)[-1]
+            if re.search(r"mesh", dotted, re.I) and re.search(
+                r"size|devices|count", terminal, re.I
+            ):
+                desc = dotted
+        if isinstance(right, ast.Call):
+            cname = _call_name(right.func)
+            if cname in ("jax.device_count", "jax.local_device_count"):
+                desc = cname + "()"
+            elif cname == "len" and right.args:
+                inner = right.args[0]
+                if (
+                    isinstance(inner, ast.Call)
+                    and _call_name(inner.func)
+                    in ("jax.devices", "jax.local_devices")
+                ):
+                    desc = f"len({_call_name(inner.func)}())"
+        if desc:
+            yield ctx.finding(
+                "BCG-SHARD-DIVISOR",
+                node,
+                f"division by raw device count ({desc}) — divide by "
+                "engaged mesh axes (parallel/sharding per-device "
+                "helpers) or replication overcommits HBM",
+            )
+
+
+# ----------------------------------------------------------- rules: env flags
+@_rule("BCG-ENV-RAW")
+def rule_env_raw(ctx: ModuleContext) -> Iterable[Finding]:
+    """Raw environment reads of registered flag names (BCG_TPU_*,
+    BENCH_*, MB_*, VERBOSE) outside ``runtime/envflags.py`` bypass the
+    registry's single parse + defaults — resolve through
+    ``envflags.get_bool/get_int/get_str/is_set``."""
+    if ctx.rel_path.endswith("runtime/envflags.py"):
+        return
+
+    def flag_name(node: ast.AST) -> Optional[str]:
+        if (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and _ENV_NAME_RE.match(node.value)
+        ):
+            return node.value
+        return None
+
+    for node in ast.walk(ctx.tree):
+        name = None
+        how = None
+        if isinstance(node, ast.Call):
+            cname = _call_name(node.func)
+            if cname in ("os.environ.get", "environ.get") and node.args:
+                name, how = flag_name(node.args[0]), cname
+            elif cname in ("os.getenv", "getenv") and node.args:
+                name, how = flag_name(node.args[0]), cname
+        elif isinstance(node, ast.Subscript):
+            base = _call_name(node.value)
+            if base in ("os.environ", "environ") and isinstance(
+                getattr(node, "ctx", None), ast.Load
+            ):
+                name, how = flag_name(node.slice), f"{base}[...]"
+        elif isinstance(node, ast.Compare) and len(node.ops) == 1:
+            if isinstance(node.ops[0], (ast.In, ast.NotIn)):
+                comp = node.comparators[0]
+                if _call_name(comp) in ("os.environ", "environ"):
+                    name, how = flag_name(node.left), "in os.environ"
+        if name:
+            yield ctx.finding(
+                "BCG-ENV-RAW",
+                node,
+                f"raw env read of {name!r} via {how} — use "
+                "bcg_tpu.runtime.envflags accessors",
+            )
+
+
+@_rule("BCG-ENV-UNREG")
+def rule_env_unreg(ctx: ModuleContext) -> Iterable[Finding]:
+    """envflags accessor called with a name the registry doesn't know —
+    a typo'd knob reads as permanently-default instead of erroring."""
+    registered = _registered_env_names()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node.func)
+        short = name.rsplit(".", 1)[-1]
+        if short not in _ENV_ACCESSORS:
+            continue
+        if "." in name and "envflags" not in name and short != "env_flag":
+            continue
+        if not node.args:
+            continue
+        arg = node.args[0]
+        if (
+            isinstance(arg, ast.Constant)
+            and isinstance(arg.value, str)
+            and arg.value not in registered
+        ):
+            yield ctx.finding(
+                "BCG-ENV-UNREG",
+                node,
+                f"env flag {arg.value!r} is not registered in "
+                "bcg_tpu.runtime.envflags (typo, or add it to the "
+                "registry)",
+            )
+
+
+# ------------------------------------------------------ rule: broad excepts
+@_rule("BCG-EXCEPT-BROAD")
+def rule_except_broad(ctx: ModuleContext) -> Iterable[Finding]:
+    """``except Exception`` (or bare ``except:``) whose body neither
+    re-raises, logs, nor inspects the exception swallows real failures —
+    the misattributed-warning / silent-fallback class.  Narrow the type,
+    or bind the exception and report it."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        names = []
+        t = node.type
+        if t is None:
+            names = ["<bare>"]
+        else:
+            elts = t.elts if isinstance(t, ast.Tuple) else [t]
+            names = [_call_name(e).rsplit(".", 1)[-1] for e in elts]
+        if not any(n in ("Exception", "BaseException", "<bare>") for n in names):
+            continue
+        handled = False
+        for child in ast.walk(node):
+            if isinstance(child, (ast.Raise, ast.Assert)):
+                handled = True
+                break
+            if (
+                node.name
+                and isinstance(child, ast.Name)
+                and child.id == node.name
+            ):
+                handled = True
+                break
+            if isinstance(child, ast.Call) and _LOGGY_RE.search(
+                _call_name(child.func).rsplit(".", 1)[-1]
+            ):
+                handled = True
+                break
+        if not handled:
+            yield ctx.finding(
+                "BCG-EXCEPT-BROAD",
+                node,
+                "broad except swallows the exception (no re-raise, no "
+                "logging, exception unused) — narrow the type or report",
+            )
+
+
+# ------------------------------------------------- rule: mutable defaults
+@_rule("BCG-MUT-DEFAULT")
+def rule_mut_default(ctx: ModuleContext) -> Iterable[Finding]:
+    """Mutable default argument values are shared across every call."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        defaults = list(node.args.defaults) + [
+            d for d in node.args.kw_defaults if d is not None
+        ]
+        for d in defaults:
+            mutable = isinstance(
+                d, (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+                    ast.SetComp)
+            ) or (
+                isinstance(d, ast.Call)
+                and _call_name(d.func) in ("list", "dict", "set", "bytearray")
+            )
+            if mutable:
+                yield ctx.finding(
+                    "BCG-MUT-DEFAULT",
+                    d,
+                    f"mutable default argument in {node.name}() — shared "
+                    "across calls; use None + in-body init",
+                )
+
+
+ALL_RULES: Sequence = (
+    rule_host_sync,
+    rule_jit_np,
+    rule_jit_branch,
+    rule_jit_outshard,
+    rule_jit_donate,
+    rule_shard_axis,
+    rule_shard_divisor,
+    rule_env_raw,
+    rule_env_unreg,
+    rule_except_broad,
+    rule_mut_default,
+)
+
+RULE_IDS: List[str] = [r.rule_id for r in ALL_RULES]

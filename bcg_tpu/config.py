@@ -11,20 +11,19 @@ defaults and knobs.
 from __future__ import annotations
 
 import dataclasses
-import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 
-def env_flag(name: str, default: bool = False) -> bool:
-    """Boolean environment flag, ONE parse for the whole package:
-    unset or empty -> ``default``; otherwise the falsy strings
-    ("0", "false", "no", "off", case/whitespace-insensitive) -> False
-    and anything else -> True."""
-    raw = os.environ.get(name)
-    if raw is None or raw.strip() == "":
-        return default
-    return raw.strip().lower() not in ("0", "false", "no", "off")
+def env_flag(name: str, default: Optional[bool] = None) -> bool:
+    """Boolean environment flag — compatibility shim over the central
+    registry (:mod:`bcg_tpu.runtime.envflags`), which owns the one
+    parse, the defaults, and the docstrings.  ``name`` must be
+    registered there (a typo raises instead of silently defaulting);
+    ``default=None`` defers to the registered default."""
+    from bcg_tpu.runtime.envflags import get_bool
+
+    return get_bool(name, default)
 
 # Model presets used in the reference experiments (config.py:20-25).
 MODEL_PRESETS: Dict[str, str] = {

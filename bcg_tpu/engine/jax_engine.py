@@ -80,7 +80,7 @@ _SUFFIX_BUCKETS_FINE = (256, 512, 1024, 1536, 2048, 3072, 4096, 8192)
 _PREFIX_BUCKETS = (128, 256, 512, 768, 1024, 1536, 1792, 2048, 4096, 6144, 8192)
 
 # BCG_TPU_TIMING=1 prints per-call prefill/decode wall times.
-_TIMING = os.environ.get("BCG_TPU_TIMING", "") not in ("", "0")
+_TIMING = env_flag("BCG_TPU_TIMING")
 
 _comp_cache_enabled = False
 
@@ -107,7 +107,9 @@ def _enable_compilation_cache() -> None:
     global _comp_cache_enabled
     if _comp_cache_enabled:
         return
-    setting = os.environ.get("BCG_TPU_XLA_CACHE", "")
+    from bcg_tpu.runtime.envflags import get_str
+
+    setting = get_str("BCG_TPU_XLA_CACHE") or ""
     if setting.lower() in ("off", "0", "none"):
         return
     # Default-on only for TPU: CPU AOT artifacts are keyed to the exact
@@ -132,7 +134,9 @@ def _enable_compilation_cache() -> None:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
         _comp_cache_enabled = True
-    except Exception:  # unsupported backend/version: run without the cache
+    except (OSError, ValueError, AttributeError, RuntimeError):
+        # Unsupported backend/version or unwritable cache dir: run
+        # without the persistent cache rather than failing the boot.
         pass
 
 
@@ -714,7 +718,9 @@ class JaxEngine(InferenceEngine):
         try:
             stats = jax.devices()[0].memory_stats() or {}
             self._mem_limit = stats.get("bytes_limit")
-        except Exception:
+        except (IndexError, AttributeError, NotImplementedError, RuntimeError):
+            # Backend exposes no allocator stats (CPU) — size-adaptive
+            # prefix budgeting simply stays off.
             self._mem_limit = None
         if self._mem_limit:
             # Weight-aware: the prefix cache may only use a slice of what

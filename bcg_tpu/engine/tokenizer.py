@@ -158,7 +158,9 @@ class HFTokenizer(Tokenizer):
                     return True
                 if "Metaspace" in kinds:
                     return False
-            except Exception:
+            except (ValueError, TypeError, KeyError, AttributeError):
+                # Malformed/unexpected backend spec JSON: fall through to
+                # the whole-vocab scan below.
                 pass
         return any("Ġ" in t for t in self.tk.get_vocab())
 

@@ -22,6 +22,7 @@ import ml_dtypes
 import numpy as np
 
 from bcg_tpu.models.configs import ModelSpec
+from bcg_tpu.runtime.envflags import get_str
 
 # HF parameter name templates for the Qwen/Llama/Mistral family.
 _LAYER_MAP = {
@@ -52,7 +53,7 @@ _TRANSPOSED = {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "lm_head"}
 def find_checkpoint_dir(model_name: str) -> Optional[str]:
     """Locate a local checkpoint: explicit dir, HF cache, or env override."""
     candidates = []
-    env = os.environ.get("BCG_TPU_CHECKPOINT_DIR")
+    env = get_str("BCG_TPU_CHECKPOINT_DIR")
     if env:
         candidates.append(os.path.join(env, model_name.replace("/", "--")))
         candidates.append(env)

@@ -40,7 +40,6 @@ group straddles the halves (group shrinks via gcd for tiny test dims).
 from __future__ import annotations
 
 import math
-import os
 from functools import partial
 from typing import Dict, Union
 
@@ -169,10 +168,9 @@ def _w8a16_prefill_rows() -> int:
     signature), not import time, so tests can monkeypatch it; it is a
     bench A/B knob, not a per-engine config field — if the hardware A/B
     wins it becomes an unconditional shape dispatch like int4's."""
-    try:
-        return int(os.environ.get("BCG_TPU_W8A16_PREFILL", "0"))
-    except ValueError:
-        return 0
+    from bcg_tpu.runtime.envflags import get_int
+
+    return get_int("BCG_TPU_W8A16_PREFILL")
 
 
 def dense(x: jax.Array, w: DenseWeight, out_dtype=None) -> jax.Array:

@@ -1,6 +1,19 @@
 """Simulation runtime: orchestrator, metrics sinks, logging, checkpointing,
-profiling, CLI (reference ``main.py``)."""
+profiling, CLI (reference ``main.py``).
 
-from bcg_tpu.runtime.orchestrator import BCGSimulation
+``BCGSimulation`` is exported lazily (PEP 562): the orchestrator pulls
+the whole engine stack (jax included), and light consumers — bench.py's
+flag reads via :mod:`bcg_tpu.runtime.envflags`, the static analyzer —
+must be able to import runtime submodules without paying for it or
+initializing a backend early.
+"""
 
 __all__ = ["BCGSimulation"]
+
+
+def __getattr__(name: str):
+    if name == "BCGSimulation":
+        from bcg_tpu.runtime.orchestrator import BCGSimulation
+
+        return BCGSimulation
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

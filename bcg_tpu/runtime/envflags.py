@@ -1,0 +1,259 @@
+"""Central registry of environment flags — the ONLY module that reads them.
+
+Every ``BCG_TPU_*`` / ``VERBOSE`` / ``BENCH_*`` / ``MB_*`` environment
+knob is declared here once with its name, type, default, and docstring;
+call sites resolve through the typed accessors (:func:`get_bool`,
+:func:`get_int`, :func:`get_str`).  The static analyzer
+(:mod:`bcg_tpu.analysis`, rule ``BCG-ENV-RAW``) rejects raw
+``os.environ`` / ``os.getenv`` reads of these names anywhere else in the
+package, and rule ``BCG-ENV-UNREG`` rejects accessor calls whose name
+literal is not registered — so a typo'd flag name is a lint failure, not
+a silently-ignored knob.
+
+Reading is always at CALL time, never import time, so tests can
+``monkeypatch.setenv`` freely.  ``python -m bcg_tpu.runtime.envflags``
+prints the registry as a markdown table (the README flag table is
+derived from it).
+
+External env vars owned by other tools (``XLA_FLAGS``, ``JAX_PLATFORMS``,
+``HF_HOME``, ``JAX_COMPILATION_CACHE_DIR``) are deliberately NOT
+registered: they keep their owners' parsing semantics and raw reads of
+them are allowed.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, Optional, Union
+
+_FALSY = ("0", "false", "no", "off")
+
+
+@dataclass(frozen=True)
+class EnvFlag:
+    """One registered environment knob."""
+
+    name: str
+    kind: str  # "bool" | "int" | "str"
+    default: Union[bool, int, str, None]
+    doc: str
+
+
+REGISTRY: Dict[str, EnvFlag] = {}
+
+
+def _register(name: str, kind: str, default, doc: str) -> None:
+    if name in REGISTRY:
+        raise ValueError(f"env flag {name!r} registered twice")
+    REGISTRY[name] = EnvFlag(name=name, kind=kind, default=default, doc=doc)
+
+
+# --------------------------------------------------------------- registry
+# BCG_TPU_* operational flags.
+_register(
+    "BCG_TPU_TIMING", "bool", False,
+    "Print per-call prefill/decode wall times and the boot-phase "
+    "breakdown to stderr.",
+)
+_register(
+    "BCG_TPU_XLA_CACHE", "str", "",
+    "Persistent XLA compilation cache: 'off'/'0'/'none' disables, a "
+    "directory path overrides the default location "
+    "(~/.cache/bcg_tpu_xla; default-on only on TPU backends).",
+)
+_register(
+    "BCG_TPU_CHECKPOINT_DIR", "str", None,
+    "Root directory searched for local safetensors checkpoints "
+    "(models/loader.find_checkpoint_dir).",
+)
+_register(
+    "BCG_TPU_W8A16_PREFILL", "int", 0,
+    "Row-count threshold routing prefill-shaped int8 matmuls through "
+    "the experimental W8A16 path (0 = off; bench A/B knob).",
+)
+_register(
+    "BCG_TPU_DISABLE_INT8_DECODE_KERNEL", "bool", False,
+    "Kill switch: route int8-KV decode through the XLA fallback "
+    "instead of the Pallas kernel.",
+)
+_register(
+    "BCG_TPU_ALLOW_PADDED_GROUP_KERNEL", "bool", False,
+    "Allow the int8 decode kernel's padded-GQA-group path on "
+    "non-power-of-two group sizes (off: XLA fallback + warning).",
+)
+_register(
+    "BCG_TPU_DISABLE_W4_KERNEL", "bool", False,
+    "Kill switch: route W4A16 matmuls through the XLA dequantize "
+    "fallback instead of the Pallas kernel.",
+)
+_register(
+    "BCG_TPU_FINE_SUFFIX", "bool", False,
+    "Enable the fine suffix-length bucket ladder (adds 1536/3072 "
+    "rungs); bench/sweep override for EngineConfig.fine_suffix_buckets.",
+)
+_register(
+    "BCG_TPU_SKIP_SLOW", "bool", False,
+    "Test-suite opt-out of the ~10-minute CPU full-stack bench test "
+    "(tests/test_bench_cpu_stack.py).",
+)
+_register(
+    "VERBOSE", "bool", False,
+    "Force RunLogger console verbosity (reference repo convention).",
+)
+
+# BENCH_* driver-bench overrides (bench.py).  Defaults marked
+# "size-class dependent" are resolved at the call site from the model's
+# parameter count; the registered default is the small-model arm.
+_register("BENCH_MODEL", "str", "bcg-tpu/bench-1b", "Bench model preset.")
+_register("BENCH_BACKEND", "str", "jax", "Bench engine backend (jax | fake).")
+_register(
+    "BENCH_QUANTIZATION", "str", "int8",
+    "Bench weight quantization ('none'/'bfloat16' disables; XL models "
+    "default to int4 when unset).",
+)
+_register(
+    "BENCH_KV_DTYPE", "str", "bfloat16",
+    "Bench KV-cache dtype (size-class dependent: int8 for the large "
+    "class, bfloat16 below).",
+)
+_register("BENCH_ROUNDS", "int", 3, "Measured bench rounds.")
+_register("BENCH_WARMUP", "int", 2, "Warmup (compile) rounds before the window.")
+_register("BENCH_CONCURRENCY", "int", 1, "Concurrent games in the bench window.")
+_register(
+    "BENCH_ATTACH_TIMEOUT", "int", 900,
+    "Deadline (s) for the subprocess accelerator-attach probe.",
+)
+_register(
+    "BENCH_ATTENTION_IMPL", "str", "auto",
+    "Prefill attention kernel override (auto | pallas | xla).",
+)
+_register(
+    "BENCH_PREFILL_CHUNK", "int", 0,
+    "Chunked-prefill slice in tokens (size-class dependent: 512 for "
+    "the large class, 0 = whole prompt below).",
+)
+_register(
+    "BENCH_FORCE_CPU", "bool", False,
+    "Hermetic mode: run the real jax bench path on the host CPU.",
+)
+_register("BENCH_FAST_FORWARD", "bool", True, "Forced-chain decode fast-forward.")
+_register("BENCH_COMPACT_JSON", "bool", True, "Compact-JSON generation grammar.")
+_register(
+    "BENCH_PREFIX_CACHING", "bool", True,
+    "System-prompt prefix KV caching (size-class dependent: off for "
+    "the large class).",
+)
+_register(
+    "BENCH_SCAN_LAYERS", "bool", False,
+    "Scan-over-layers layer stack (size-class dependent: on for the "
+    "large class).",
+)
+_register(
+    "BENCH_SHARED_CORE", "bool", False,
+    "Vote-phase shared-core prompt caching (AgentConfig.shared_core_votes).",
+)
+_register(
+    "BENCH_PROFILE_DIR", "str", None,
+    "Capture a jax.profiler trace of the measured window into this "
+    "directory (real backends only).",
+)
+
+# MB_* microbench knobs (scripts/microbench_prefill.py).
+_register("MB_ITERS", "int", 30, "Microbench timed iterations.")
+_register("MB_B", "int", 10, "Microbench batch size (agents).")
+_register("MB_L", "int", 2048, "Microbench padded prompt length.")
+_register(
+    "MB_TINY", "bool", False,
+    "CPU smoke: shrink every microbench dimension to seconds-scale.",
+)
+
+
+# -------------------------------------------------------------- accessors
+def _lookup(name: str) -> EnvFlag:
+    flag = REGISTRY.get(name)
+    if flag is None:
+        raise KeyError(
+            f"env flag {name!r} is not registered in "
+            f"bcg_tpu.runtime.envflags — add it to the registry"
+        )
+    return flag
+
+
+def parse_bool(raw: Optional[str], default: bool = False) -> bool:
+    """ONE boolean parse for the whole package: unset/empty -> default;
+    '0'/'false'/'no'/'off' (case/whitespace-insensitive) -> False;
+    anything else -> True."""
+    if raw is None or raw.strip() == "":
+        return default
+    return raw.strip().lower() not in _FALSY
+
+
+def is_set(name: str) -> bool:
+    """True when the (registered) flag is present in the environment at
+    all — for call sites whose default depends on other state."""
+    return os.environ.get(_lookup(name).name) is not None
+
+
+def get_bool(name: str, default: Optional[bool] = None) -> bool:
+    """Boolean flag value; ``default`` overrides the registered default
+    (for size-class-dependent call sites)."""
+    flag = _lookup(name)
+    if flag.kind != "bool":
+        raise TypeError(f"env flag {name} is kind={flag.kind}, not bool")
+    fallback = flag.default if default is None else default
+    return parse_bool(os.environ.get(name), bool(fallback))
+
+
+def get_int(name: str, default: Optional[int] = None) -> int:
+    """Integer flag value; unset/empty -> default; unparseable -> default
+    with a LOUD stderr warning (silently recording a run under the wrong
+    window/rounds config would be worse than either crashing or
+    defaulting)."""
+    flag = _lookup(name)
+    if flag.kind != "int":
+        raise TypeError(f"env flag {name} is kind={flag.kind}, not int")
+    fallback = int(flag.default if default is None else default)
+    raw = os.environ.get(name)
+    if raw is None or raw.strip() == "":
+        return fallback
+    try:
+        return int(raw)
+    except ValueError:
+        import sys
+
+        print(
+            f"envflags: {name}={raw!r} is not an integer — using "
+            f"{fallback}",
+            file=sys.stderr,
+        )
+        return fallback
+
+
+def get_str(name: str, default: Optional[str] = None) -> Optional[str]:
+    """String flag value; unset -> default (which may be None)."""
+    flag = _lookup(name)
+    if flag.kind != "str":
+        raise TypeError(f"env flag {name} is kind={flag.kind}, not str")
+    fallback = flag.default if default is None else default
+    raw = os.environ.get(name)
+    return fallback if raw is None else raw
+
+
+# ------------------------------------------------------------------ docs
+def markdown_table() -> str:
+    """Registry as a README-ready markdown table."""
+    lines = [
+        "| Flag | Type | Default | Meaning |",
+        "| --- | --- | --- | --- |",
+    ]
+    for flag in REGISTRY.values():
+        default = "(unset)" if flag.default is None else repr(flag.default)
+        lines.append(
+            f"| `{flag.name}` | {flag.kind} | `{default}` | {flag.doc} |"
+        )
+    return "\n".join(lines)
+
+
+if __name__ == "__main__":
+    print(markdown_table())

@@ -11,6 +11,8 @@ from __future__ import annotations
 import os
 from typing import IO, Optional
 
+from bcg_tpu.runtime.envflags import get_bool
+
 
 class RunLogger:
     """Tee logger: every message goes to the log file (if any); console
@@ -21,7 +23,7 @@ class RunLogger:
     ):
         # VERBOSE=1 env forces verbosity (reference convention:
         # vllm_agent.py:31, byzantine_consensus.py:17, main.py:1108).
-        self.verbose = verbose or os.environ.get("VERBOSE", "") == "1"
+        self.verbose = verbose or get_bool("VERBOSE")
         self.log_path = log_path
         self._fh: Optional[IO] = None
         if log_path:

@@ -42,7 +42,8 @@ def _device_memory():
 
         stats = jax.devices()[0].memory_stats() or {}
         return stats.get("bytes_in_use"), stats.get("peak_bytes_in_use")
-    except Exception:
+    except (ImportError, IndexError, AttributeError, NotImplementedError,
+            RuntimeError):
         return None, None
 
 

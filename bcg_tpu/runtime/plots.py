@@ -25,7 +25,8 @@ def generate_run_plots(game, results_dir: str, run_number: str) -> Optional[str]
         import matplotlib
         matplotlib.use("Agg")
         import matplotlib.pyplot as plt
-    except Exception:
+    except (ImportError, RuntimeError):
+        # No matplotlib (or no usable backend): plots are best-effort.
         return None
     if not game.rounds:
         return None

@@ -60,6 +60,8 @@ import sys
 import time
 import traceback
 
+from bcg_tpu.runtime import envflags
+
 # --- Reference baseline denominator (BASELINE.md appendix A) ---------
 # Decode at batch 4 is weight-streaming-bound, so the reference's
 # steady-state rate on its own hardware is bounded by
@@ -113,9 +115,7 @@ _TRANSIENT_MARKERS = (
 
 
 def _env_flag(name: str, default: bool) -> bool:
-    from bcg_tpu.config import env_flag
-
-    return env_flag(name, default)
+    return envflags.get_bool(name, default)
 
 
 def _progress(msg: str) -> None:
@@ -156,7 +156,7 @@ _CONFIG_OVERRIDE_ENVS = (
 
 
 def _is_default_config() -> bool:
-    return not any(os.environ.get(v) for v in _CONFIG_OVERRIDE_ENVS)
+    return not any(envflags.is_set(v) for v in _CONFIG_OVERRIDE_ENVS)
 
 
 def _error_result(exc: BaseException, retried: bool) -> dict:
@@ -365,7 +365,7 @@ def _run_attempt(cfg, model: str, backend: str, concurrency: int,
         waves = 0
         w0 = _counters()
         t0 = time.perf_counter()
-        prof_dir = os.environ.get("BENCH_PROFILE_DIR") if backend != "fake" else None
+        prof_dir = envflags.get_str("BENCH_PROFILE_DIR") if backend != "fake" else None
         _progress("measured window start")
         with jax_trace(prof_dir):
             while waves < measured_rounds:
@@ -414,7 +414,7 @@ def _run_attempt(cfg, model: str, backend: str, concurrency: int,
         # Real backends only: start_trace initializes the default
         # backend, which on the fake path would attach the (possibly
         # dead) tunnel a fake bench never needs.
-        prof_dir = os.environ.get("BENCH_PROFILE_DIR") if backend != "fake" else None
+        prof_dir = envflags.get_str("BENCH_PROFILE_DIR") if backend != "fake" else None
         _progress("measured window start")
         with jax_trace(prof_dir):
             while rounds_done < measured_rounds:
@@ -574,17 +574,17 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-    model = os.environ.get("BENCH_MODEL", "bcg-tpu/bench-1b")
-    backend = os.environ.get("BENCH_BACKEND", "jax")
-    quant_env = os.environ.get("BENCH_QUANTIZATION", "int8")
+    model = envflags.get_str("BENCH_MODEL")
+    backend = envflags.get_str("BENCH_BACKEND")
+    quant_env = envflags.get_str("BENCH_QUANTIZATION")
     # 3 measured rounds (~10 s window): 2-round windows showed +-8% noise
     # from retry-ladder luck; the attach/warmup cost already dominates.
-    measured_rounds = int(os.environ.get("BENCH_ROUNDS", "3"))
+    measured_rounds = envflags.get_int("BENCH_ROUNDS")
     # Two warmup rounds: round 1 compiles the initial shapes; round 2
     # covers the history-grown prompt's length bucket, so the measured
     # window is (normally) compile-free.
-    warmup_rounds = int(os.environ.get("BENCH_WARMUP", "2"))
-    concurrency = int(os.environ.get("BENCH_CONCURRENCY", "1"))
+    warmup_rounds = envflags.get_int("BENCH_WARMUP")
+    concurrency = envflags.get_int("BENCH_CONCURRENCY")
 
     from bcg_tpu.config import BCGConfig
     from bcg_tpu.models.configs import (
@@ -598,7 +598,7 @@ def main() -> None:
     if backend == "jax":
         import subprocess
 
-        attach_timeout = int(os.environ.get("BENCH_ATTACH_TIMEOUT", "900"))
+        attach_timeout = envflags.get_int("BENCH_ATTACH_TIMEOUT")
         cpu_stmt = (
             'jax.config.update("jax_platforms", "cpu"); ' if force_cpu else ""
         )
@@ -650,7 +650,7 @@ def main() -> None:
     spec = spec_for_model(model)
     large_model = spec is not None and spec.param_count >= LARGE_MODEL_PARAMS
     xl_model = spec is not None and spec.param_count >= XL_MODEL_PARAMS
-    if xl_model and "BENCH_QUANTIZATION" not in os.environ:
+    if xl_model and not envflags.is_set("BENCH_QUANTIZATION"):
         # 14B-class: int8 weights alone are >= 12 GB — single-chip
         # serving needs the int4 capacity path unless overridden.
         quant_env = "int4"
@@ -658,7 +658,7 @@ def main() -> None:
     # pushes a 16 GB chip past capacity next to int8 weights (measured
     # compile-time OOM); smaller models default bf16 (int8 KV loses
     # wall-clock there).
-    kv_dtype = os.environ.get(
+    kv_dtype = envflags.get_str(
         "BENCH_KV_DTYPE", "int8" if large_model else "bfloat16"
     )
     base = BCGConfig()
@@ -683,7 +683,7 @@ def main() -> None:
             # compile failures at new model geometries (a 14B prefill
             # compile crashed the helper on 2026-08-01; xla isolates
             # whether the flash kernel is the crasher).
-            attention_impl=os.environ.get("BENCH_ATTENTION_IMPL", "auto"),
+            attention_impl=envflags.get_str("BENCH_ATTENTION_IMPL"),
             decode_fast_forward=_env_flag("BENCH_FAST_FORWARD", True),
             guided_compact_json=_env_flag("BENCH_COMPACT_JSON", True),
             # Off by default for the large size class: weights + KV
@@ -695,9 +695,9 @@ def main() -> None:
             # pass).  Default ON for the large size class: whole-prompt
             # prefill activations alone exceed the HBM left after
             # weights + KV cache there.
-            prefill_chunk=int(os.environ.get(
-                "BENCH_PREFILL_CHUNK", "512" if large_model else "0"
-            )),
+            prefill_chunk=envflags.get_int(
+                "BENCH_PREFILL_CHUNK", 512 if large_model else 0
+            ),
             # Scan-over-layers: O(1)-in-depth program, required for
             # 8B-class compiles through the remote-compile helper
             # (default ON for the large size class, off elsewhere — the

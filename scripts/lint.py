@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Developer entry point for the static analyzer (bcg_tpu.analysis).
+
+``python scripts/lint.py``          — whole-repo run (same as
+                                      ``python -m bcg_tpu.analysis``)
+``python scripts/lint.py --diff``   — findings restricted to files
+                                      changed vs main (fast pre-commit)
+``python scripts/lint.py PATH...``  — explicit files/dirs
+
+Any remaining ``python -m bcg_tpu.analysis`` flags pass through
+(``--no-baseline``, ``--json``, ``--show-baselined``).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def changed_files(base: str = "main") -> list:
+    """Python files changed vs the merge-base with ``base`` (falls back
+    to HEAD~1, then to uncommitted changes only)."""
+    candidates = []
+    for ref in (base, "HEAD~1"):
+        try:
+            mb = subprocess.run(
+                ["git", "merge-base", "HEAD", ref],
+                cwd=REPO, capture_output=True, text=True, check=True,
+            ).stdout.strip()
+            candidates = [mb]
+            break
+        except subprocess.CalledProcessError:
+            continue
+    # With no usable merge-base, diff against HEAD (staged + unstaged);
+    # a bare `git diff` would silently skip staged modifications.
+    diff_args = ["git", "diff", "--name-only", candidates[0] if candidates else "HEAD"]
+    try:
+        out = subprocess.run(
+            diff_args, cwd=REPO, capture_output=True, text=True, check=True
+        ).stdout
+    except subprocess.CalledProcessError:
+        out = subprocess.run(
+            ["git", "diff", "--name-only"],
+            cwd=REPO, capture_output=True, text=True, check=True,
+        ).stdout
+    # `git diff` never lists brand-new (untracked) files — exactly the
+    # ones a pre-commit check most needs to see.
+    out += subprocess.run(
+        ["git", "ls-files", "--others", "--exclude-standard"],
+        cwd=REPO, capture_output=True, text=True, check=True,
+    ).stdout
+    files = []
+    for line in out.splitlines():
+        line = line.strip()
+        if line.endswith(".py"):
+            full = os.path.join(REPO, line)
+            if os.path.exists(full) and not line.startswith("tests/"):
+                files.append(full)
+    return files
+
+
+def main() -> int:
+    args = sys.argv[1:]
+    if "--diff" in args:
+        args.remove("--diff")
+        files = changed_files()
+        if not files:
+            print("lint --diff: no changed python files vs main",
+                  file=sys.stderr)
+            return 0
+        args = files + args
+    from bcg_tpu.analysis.__main__ import main as analysis_main
+
+    return analysis_main(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,7 +29,10 @@ Usage (on the TPU):  python scripts/microbench_prefill.py
 from __future__ import annotations
 
 import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
@@ -39,8 +42,9 @@ from bcg_tpu.models.configs import spec_for_model
 from bcg_tpu.models.quantize import dense, quantize_weight, quantize_weight_int4
 from bcg_tpu.models.transformer import apply_rope, rms_norm, rope_table
 from bcg_tpu.ops.attention import blockwise_attention, flash_attention
+from bcg_tpu.runtime.envflags import get_bool, get_int
 
-ITERS = int(os.environ.get("MB_ITERS", "30"))
+ITERS = get_int("MB_ITERS")
 PEAK_BF16 = 197e12
 PEAK_INT8 = 394e12
 
@@ -77,11 +81,11 @@ def bench_matmul(name, x, w, flops, peak):
 
 
 def main():
-    B = int(os.environ.get("MB_B", "10"))
-    L = int(os.environ.get("MB_L", "2048"))
+    B = get_int("MB_B")
+    L = get_int("MB_L")
     spec = spec_for_model("bcg-tpu/bench-1b")
     D, H, Hkv, Dh, F = 2048, 16, 8, 128, 6144
-    if os.environ.get("MB_TINY"):  # CPU smoke: shrink every dim
+    if get_bool("MB_TINY"):  # CPU smoke: shrink every dim
         B, L, D, H, Hkv, Dh, F = 2, 64, 64, 2, 1, 32, 128
     S = L  # self-attention over the fresh prompt
     rng = np.random.default_rng(0)

@@ -1,0 +1,10 @@
+"""BAD: raw environment reads of registered flag names."""
+import os
+
+TIMING = os.environ.get("BCG_TPU_TIMING", "") not in ("", "0")  # BCG-ENV-RAW
+VERBOSE = os.getenv("VERBOSE") == "1"                           # BCG-ENV-RAW
+MODEL = os.environ["BENCH_MODEL"]                               # BCG-ENV-RAW
+
+
+def overridden():
+    return "BENCH_QUANTIZATION" in os.environ                   # BCG-ENV-RAW

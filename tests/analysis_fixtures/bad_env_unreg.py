@@ -1,0 +1,6 @@
+"""BAD: accessor called with a name the registry does not know."""
+from bcg_tpu.config import env_flag
+from bcg_tpu.runtime import envflags
+
+A = envflags.get_bool("BCG_TPU_TIMNIG")   # BCG-ENV-UNREG (typo)
+B = env_flag("BCG_TPU_NO_SUCH_FLAG")      # BCG-ENV-UNREG

@@ -1,0 +1,24 @@
+"""BAD: host-sync calls inside jitted/traced regions."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def decode_step(x):
+    stop = x.sum().item()          # BCG-HOST-SYNC
+    return x * stop
+
+
+def loop(cache, n):
+    def body(carry):
+        i, c = carry
+        host = np.asarray(c)       # BCG-HOST-SYNC
+        c.block_until_ready()      # BCG-HOST-SYNC
+        v = jax.device_get(c)      # BCG-HOST-SYNC
+        return i + 1, c * host.shape[0] * v[0]
+
+    def cond(carry):
+        return carry[0] < n
+
+    return jax.lax.while_loop(cond, body, (0, cache))

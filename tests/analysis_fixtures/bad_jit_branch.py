@@ -1,0 +1,19 @@
+"""BAD: python control flow on a traced parameter."""
+import jax
+
+
+@jax.jit
+def f(x, threshold):
+    if threshold > 0:              # BCG-JIT-BRANCH (traced param)
+        return x * threshold
+    return x
+
+
+def g(x, n):
+    while n > 0:                   # BCG-JIT-BRANCH via jit call-site below
+        x = x + 1
+        n = n - 1
+    return x
+
+
+g_jit = jax.jit(g)

@@ -1,0 +1,9 @@
+"""BAD: numpy ops inside a jitted function."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def f(x):
+    scale = np.sqrt(2.0)           # BCG-JIT-NP
+    return x * np.maximum(scale, 1.0)  # BCG-JIT-NP

@@ -1,0 +1,8 @@
+"""BAD: PartitionSpec axis names the mesh does not define."""
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+SPEC = P(None, "model")            # BCG-SHARD-AXIS ("model" not a mesh axis)
+
+
+def shard(mesh, arr):
+    return NamedSharding(mesh, P("data", None))  # BCG-SHARD-AXIS

@@ -1,0 +1,11 @@
+"""BAD: per-device accounting divided by raw device counts."""
+import jax
+
+
+def kv_bytes_per_device(total_bytes, mesh):
+    return total_bytes / mesh.size            # BCG-SHARD-DIVISOR
+
+
+def tree_bytes_per_device(total_bytes):
+    per = total_bytes // jax.device_count()   # BCG-SHARD-DIVISOR
+    return per + total_bytes / len(jax.devices())  # BCG-SHARD-DIVISOR

@@ -1,0 +1,13 @@
+"""GOOD: registry accessors; raw reads only of EXTERNAL names."""
+import os
+
+from bcg_tpu.runtime.envflags import get_bool, get_int, get_str, is_set
+
+TIMING = get_bool("BCG_TPU_TIMING")
+ROUNDS = get_int("BENCH_ROUNDS")
+MODEL = get_str("BENCH_MODEL")
+XLA_FLAGS = os.environ.get("XLA_FLAGS", "")  # external env: allowed
+
+
+def overridden():
+    return is_set("BENCH_QUANTIZATION")

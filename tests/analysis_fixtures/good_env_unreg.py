@@ -1,0 +1,6 @@
+"""GOOD: accessors with registered names only."""
+from bcg_tpu.config import env_flag
+from bcg_tpu.runtime import envflags
+
+A = envflags.get_bool("BCG_TPU_TIMING")
+B = env_flag("BCG_TPU_FINE_SUFFIX")

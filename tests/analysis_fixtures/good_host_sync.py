@@ -1,0 +1,15 @@
+"""GOOD: host syncs only in host-side orchestration code."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def decode_step(x):
+    return x * jnp.sum(x)
+
+
+def orchestrate(x):
+    out = decode_step(jnp.asarray(x))
+    out.block_until_ready()        # host side: fine
+    return np.asarray(out).item()  # host side: fine

@@ -1,0 +1,15 @@
+"""GOOD: branches on static args, shapes, and None-checks only."""
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def f(x, mode, bias=None):
+    if mode == "scale":            # static arg: fine
+        x = x * 2
+    if bias is not None:           # optional-arg idiom: fine
+        x = x + bias
+    if x.shape[0] > 4:             # shape metadata: fine
+        x = x[:4]
+    return jnp.where(x > 0, x, 0)  # traced select: the right tool

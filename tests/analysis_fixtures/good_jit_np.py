@@ -1,0 +1,11 @@
+"""GOOD: jnp inside jit; numpy only at module/host scope."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+SCALE = np.sqrt(2.0)  # host-side constant: fine
+
+
+@jax.jit
+def f(x):
+    return x * jnp.maximum(SCALE, 1.0)

@@ -1,0 +1,8 @@
+"""GOOD: only axes parallel/mesh.py defines (dp/tp/sp)."""
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+SPEC = P(None, "tp")
+
+
+def shard(mesh, arr):
+    return NamedSharding(mesh, P("dp", None, "sp"))

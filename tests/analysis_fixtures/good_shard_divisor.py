@@ -1,0 +1,8 @@
+"""GOOD: divide by the product of ENGAGED mesh axes only."""
+
+
+def kv_bytes_per_device(total_bytes, mesh, engaged_axes):
+    engaged = 1
+    for ax in engaged_axes:
+        engaged *= mesh.shape[ax]
+    return total_bytes / engaged

@@ -1,0 +1,10 @@
+"""BAD: sharded-output jit consumes an array arg without donating it."""
+import jax
+
+
+def _quantize(w):
+    return (w * 127).astype("int8")
+
+
+def make(sharding):
+    return jax.jit(_quantize, out_shardings=sharding)  # BCG-JIT-DONATE

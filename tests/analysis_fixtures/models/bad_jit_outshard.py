@@ -1,0 +1,15 @@
+"""BAD (param-materializing module scope): jit without out_shardings."""
+import jax
+from functools import partial
+
+
+def _init(key, shape):
+    return jax.random.normal(key, shape)
+
+
+init_fn = jax.jit(_init)           # BCG-JIT-OUTSHARD (+ no donate is fine: key-only)
+
+
+@partial(jax.jit, static_argnums=1)   # BCG-JIT-OUTSHARD
+def materialize(key, shape):
+    return jax.random.normal(key, shape)

@@ -1,0 +1,16 @@
+"""GOOD: donation declared; key-only jits need none."""
+import jax
+
+
+def _quantize(w):
+    return (w * 127).astype("int8")
+
+
+def _init(key):
+    return jax.random.normal(key, (8, 8))
+
+
+def make(sharding):
+    consuming = jax.jit(_quantize, out_shardings=sharding, donate_argnums=(0,))
+    fresh = jax.jit(_init, out_shardings=sharding)  # key arg: nothing to donate
+    return consuming, fresh

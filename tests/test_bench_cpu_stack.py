@@ -18,11 +18,13 @@ import sys
 
 import pytest
 
+from bcg_tpu.runtime.envflags import get_bool
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 @pytest.mark.skipif(
-    os.environ.get("BCG_TPU_SKIP_SLOW") == "1",
+    get_bool("BCG_TPU_SKIP_SLOW"),
     reason="~10 min of 1-core work; BCG_TPU_SKIP_SLOW=1 opts out for "
            "interim local runs (default ON — this is the 8B-path "
            "insurance the driver's suite must keep)",
