@@ -556,6 +556,84 @@ def rule_except_broad(ctx: ModuleContext) -> Iterable[Finding]:
             )
 
 
+# ----------------------------------------------- rule: engine call under lock
+_LOCKY_RE = re.compile(r"lock|cond|mutex|barrier", re.I)
+_ENGINE_CALL_ATTRS = {
+    "generate", "batch_generate", "generate_json", "batch_generate_json",
+}
+_DEVICE_CALL_ATTRS = {"device_put", "device_get", "block_until_ready"}
+
+
+def _lock_regions(ctx: ModuleContext) -> List[ast.AST]:
+    """AST nodes whose lexical body runs with a scheduler/collective
+    lock held: ``with <lock-ish>:`` blocks (context expression's last
+    name segment matches lock/cond/mutex/barrier) and functions named
+    ``*_locked`` (the repo convention for called-with-the-lock-held
+    helpers, e.g. ``CollectiveEngine._dispatch_all_locked``)."""
+    regions: List[ast.AST] = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                expr = item.context_expr
+                if isinstance(expr, ast.Call):
+                    expr = expr.func
+                name = _call_name(expr)
+                if name and _LOCKY_RE.search(name.rsplit(".", 1)[-1]):
+                    regions.append(node)
+                    break
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name.endswith("_locked"):
+                regions.append(node)
+    return regions
+
+
+@_rule("BCG-LOCK-CALL")
+def rule_lock_call(ctx: ModuleContext) -> Iterable[Finding]:
+    """Engine/device calls made while holding a scheduler/collective
+    lock: the inner call can block for a full device batch (seconds on a
+    remote-attached TPU) while every other participant spins on the
+    lock — and any completion path that needs the same lock deadlocks.
+    Copy queue state under the lock, release it, then dispatch
+    (bcg_tpu/serve/scheduler.py is the reference shape)."""
+    regions = _lock_regions(ctx)
+    if not regions:
+        return
+    seen: Set[int] = set()  # nested regions (with-lock inside *_locked): report once
+    for region in regions:
+        # The lock-ACQUIRING expression itself (`with engine.lock():`)
+        # runs before the lock is held — exclude the context expressions
+        # from the region walk.
+        excluded: Set[int] = set()
+        if isinstance(region, (ast.With, ast.AsyncWith)):
+            for item in region.items:
+                excluded.update(id(n) for n in ast.walk(item.context_expr))
+        for node in ast.walk(region):
+            if node is region or not isinstance(node, ast.Call):
+                continue
+            if id(node) in seen or id(node) in excluded:
+                continue  # nested regions: report once; context exprs: pre-lock
+            if not isinstance(node.func, ast.Attribute):
+                continue
+            attr = node.func.attr
+            base = _call_name(node.func.value)
+            is_engine = attr in _ENGINE_CALL_ATTRS or (
+                base and re.search(r"engine", base.rsplit(".", 1)[-1], re.I)
+                and not attr.startswith("_")
+            )
+            is_device = attr in _DEVICE_CALL_ATTRS
+            if not (is_engine or is_device):
+                continue
+            seen.add(id(node))
+            kind = "device" if is_device and not is_engine else "engine"
+            yield ctx.finding(
+                "BCG-LOCK-CALL",
+                node,
+                f"{kind} call .{attr}() while holding a scheduler/"
+                "collective lock — copy state under the lock, dispatch "
+                "outside it",
+            )
+
+
 # ------------------------------------------------- rule: mutable defaults
 @_rule("BCG-MUT-DEFAULT")
 def rule_mut_default(ctx: ModuleContext) -> Iterable[Finding]:
@@ -595,6 +673,7 @@ ALL_RULES: Sequence = (
     rule_env_unreg,
     rule_except_broad,
     rule_mut_default,
+    rule_lock_call,
 )
 
 RULE_IDS: List[str] = [r.rule_id for r in ALL_RULES]

@@ -59,10 +59,32 @@ def run_simulation(
     engine_cfg = resolve_engine_config(model_name, backend, base=base)
     metrics = dataclasses.replace(base.metrics, save_results=False, generate_plots=False)
 
-    sim = BCGSimulation(
-        config=dataclasses.replace(base, game=game, engine=engine_cfg, metrics=metrics),
-        engine=engine,
-    )
+    created = engine is None
+    run_engine = engine
+    if created:
+        from bcg_tpu.runtime import envflags
+
+        if envflags.get_bool("BCG_TPU_SERVE"):
+            # Serving-stack path: the internally created engine is
+            # fronted by the continuous-batching scheduler proxy
+            # (bcg_tpu/serve) — same results, arrival-driven dispatch.
+            from bcg_tpu.engine.interface import create_engine
+            from bcg_tpu.serve import ServingEngine
+
+            run_engine = ServingEngine(create_engine(engine_cfg), owns_inner=True)
+
+    try:
+        sim = BCGSimulation(
+            config=dataclasses.replace(base, game=game, engine=engine_cfg, metrics=metrics),
+            engine=run_engine,
+        )
+    except BaseException:
+        if created and run_engine is not None:
+            # The serving wrapper (and its booted inner engine) exists
+            # before the sim does — a constructor failure must not leak
+            # device memory or the scheduler thread.
+            run_engine.shutdown()
+        raise
     try:
         while not sim.game.game_over:
             sim.run_round()
@@ -70,7 +92,8 @@ def run_simulation(
         stats["byzantine_awareness"] = byzantine_awareness
         return {"metrics": stats}
     finally:
-        if engine is None:
-            # We created the engine internally; release its device memory.
+        if created:
+            # We created the engine internally; release its device
+            # memory (and, on the serving path, the scheduler thread).
             sim.engine.shutdown()
         sim.close()

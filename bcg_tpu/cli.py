@@ -39,6 +39,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--topology", type=str, default=None, choices=["fully_connected", "ring", "grid"], help="Network topology")
     p.add_argument("--spmd-exchange", action="store_true",
                    help="Exchange values via XLA collectives (one all_gather) instead of the host message loop")
+    p.add_argument("--serve", action="store_true",
+                   help="Route LLM calls through the continuous-batching "
+                        "serving scheduler (bcg_tpu/serve; also enabled by "
+                        "BCG_TPU_SERVE=1) — prints scheduler stats on exit "
+                        "with --verbose")
     p.add_argument("--results-dir", type=str, default=None, help="Results directory")
     p.add_argument("--no-save", action="store_true", help="Disable result files")
     p.add_argument("--plots", action="store_true", help="Save per-run plots (value trajectories, agreement)")
@@ -230,11 +235,26 @@ def main(argv: Optional[list] = None) -> int:
     except (ValueError, FileNotFoundError) as e:
         print(f"Error: {e}", file=sys.stderr)
         return 1
+    serving = None
+    from bcg_tpu.runtime import envflags
+
+    if args.serve or envflags.get_bool("BCG_TPU_SERVE"):
+        from bcg_tpu.serve import ServingEngine
+
+        # Front the engine with the continuous-batching scheduler; it
+        # owns the inner engine so one shutdown() releases both.
+        serving = ServingEngine(sim.engine, owns_inner=True)
+        sim.set_engine(serving)
     try:
         from bcg_tpu.runtime.profiler import jax_trace
 
         with jax_trace(args.profile_dir):
             sim.run()
+        if serving is not None and config.verbose:
+            import json as _json
+
+            print("[Serving Scheduler]")
+            print(_json.dumps(serving.stats(), indent=2))
     finally:
         sim.engine.shutdown()
         sim.close()

@@ -21,7 +21,14 @@ progress even when retries desynchronize the phase structure.
 Participants MUST call :meth:`retire` when their game ends (or crashes) —
 a missing retire would leave the barrier waiting for a thread that will
 never call again.  ``run_concurrent_simulations`` below handles that
-bookkeeping, and is what :mod:`bcg_tpu.experiments` uses.
+bookkeeping (retire in the outermost finally), and the env-flagged
+watchdog (``BCG_TPU_COLLECTIVE_WATCHDOG_S`` + :meth:`watch`) force-
+retires a participant whose worker thread died without retiring, so the
+barrier can no longer hang forever on a crashed thread.
+
+For arrival-driven scheduling WITHOUT barrier semantics (no lockstep, no
+retire bookkeeping, per-request crash isolation) see
+:mod:`bcg_tpu.serve` — this proxy remains the lockstep fallback.
 """
 
 from __future__ import annotations
@@ -30,6 +37,7 @@ import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from bcg_tpu.engine.interface import InferenceEngine, per_row_settings as _rows
+from bcg_tpu.runtime import envflags
 
 
 class _Call:
@@ -56,7 +64,8 @@ class CollectiveEngine(InferenceEngine):
     sharing this proxy; it decreases via :meth:`retire`.
     """
 
-    def __init__(self, engine: InferenceEngine, participants: int):
+    def __init__(self, engine: InferenceEngine, participants: int,
+                 watchdog_s: Optional[int] = None):
         if participants < 1:
             raise ValueError("participants must be >= 1")
         self._engine = engine
@@ -64,20 +73,56 @@ class CollectiveEngine(InferenceEngine):
         self._active = participants
         self._blocked = 0
         self._pending: List[_Call] = []
+        # Watchdog (BCG_TPU_COLLECTIVE_WATCHDOG_S, 0 = off): waiting
+        # callers periodically reap watched threads that died WITHOUT
+        # retiring — a crashed worker can then delay the barrier by at
+        # most one watchdog period instead of hanging it forever.
+        self._watchdog_s = (
+            envflags.get_int("BCG_TPU_COLLECTIVE_WATCHDOG_S")
+            if watchdog_s is None else watchdog_s
+        )
+        self._watched: Dict[threading.Thread, bool] = {}  # thread -> retired
 
     # ------------------------------------------------------------- barrier
+
+    def watch(self, thread: threading.Thread) -> None:
+        """Register a participant's worker thread for the watchdog: if it
+        dies without :meth:`retire`, a waiting caller force-retires it."""
+        with self._cond:
+            self._watched.setdefault(thread, False)
+
+    def _reap_dead_locked(self) -> None:
+        """Force-retire watched threads that died without retiring."""
+        if self._watchdog_s <= 0:
+            return
+        reaped = False
+        for thread, retired in self._watched.items():
+            if not retired and not thread.is_alive():
+                self._watched[thread] = True
+                self._active -= 1
+                reaped = True
+        if reaped and self._active > 0 and self._blocked == self._active \
+                and self._pending:
+            self._dispatch_all_locked()
 
     def _submit(self, sig: Tuple, payload, n_rows: int,
                 temps: List[float], budgets: List[int]) -> List:
         call = _Call(sig, payload, n_rows, temps, budgets)
+        wait_s = 60.0
+        if self._watchdog_s > 0:
+            wait_s = min(wait_s, max(0.05, self._watchdog_s / 4.0))
         with self._cond:
             self._pending.append(call)
             self._blocked += 1
             if self._blocked == self._active:
                 self._dispatch_all_locked()
             while call.results is None and call.error is None:
-                # The timeout is a lost-wakeup safety net, not a timer.
-                self._cond.wait(timeout=60.0)
+                # The timeout is a lost-wakeup safety net (and, with the
+                # watchdog on, the reap cadence) — not a timer.
+                self._cond.wait(timeout=wait_s)
+                if call.results is not None or call.error is not None:
+                    break
+                self._reap_dead_locked()
                 if (call.results is None and call.error is None
                         and self._blocked == self._active and self._pending):
                     self._dispatch_all_locked()
@@ -135,8 +180,17 @@ class CollectiveEngine(InferenceEngine):
         self._cond.notify_all()
 
     def retire(self) -> None:
-        """A participant's game is over; shrink the barrier."""
+        """A participant's game is over; shrink the barrier.
+
+        Idempotent per WATCHED thread: a worker whose thread the
+        watchdog already force-retired (it died mid-``finally``, or a
+        caller raced the reap) must not shrink the barrier twice."""
         with self._cond:
+            me = threading.current_thread()
+            if me in self._watched:
+                if self._watched[me]:
+                    return  # watchdog already retired this participant
+                self._watched[me] = True
             self._active -= 1
             if self._active > 0 and self._blocked == self._active and self._pending:
                 self._dispatch_all_locked()
@@ -204,10 +258,14 @@ def run_concurrent_simulations(
         collective = CollectiveEngine(engine, participants=len(wave))
 
         def worker(idx: int) -> None:
+            # retire() in the OUTERMOST finally: whatever the run does —
+            # raise, SystemExit, a failing result assignment — the
+            # barrier bookkeeping still happens before the thread dies.
             try:
-                results[idx] = run_fns[idx](collective)
-            except BaseException as e:
-                results[idx] = e
+                try:
+                    results[idx] = run_fns[idx](collective)
+                except BaseException as e:
+                    results[idx] = e
             finally:
                 collective.retire()
 
@@ -215,6 +273,10 @@ def run_concurrent_simulations(
             threading.Thread(target=worker, args=(i,), name=f"bcg-sim-{i}")
             for i in wave
         ]
+        # Watched BEFORE start: the watchdog (env-flagged) can then
+        # force-retire any worker whose thread dies without retiring.
+        for t in threads:
+            collective.watch(t)
         for t in threads:
             t.start()
         for t in threads:

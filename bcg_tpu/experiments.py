@@ -154,14 +154,27 @@ def run_preset(
         return go
 
     if concurrency > 1:
-        from bcg_tpu.engine.collective import run_concurrent_simulations
         from bcg_tpu.engine.interface import create_engine
+        from bcg_tpu.runtime import envflags
 
         engine = create_engine(engine_cfg)
         try:
-            outs = run_concurrent_simulations(
-                engine, [make_run(r) for r in range(runs)], concurrency
-            )
+            if envflags.get_bool("BCG_TPU_SERVE"):
+                # Arrival-driven serving scheduler (bcg_tpu/serve): no
+                # lockstep waves — all runs start, at most `concurrency`
+                # execute at once, and a straggler delays only itself.
+                from bcg_tpu.serve import run_serving_simulations
+
+                outs = run_serving_simulations(
+                    engine, [make_run(r) for r in range(runs)],
+                    max_concurrent=concurrency,
+                )
+            else:
+                from bcg_tpu.engine.collective import run_concurrent_simulations
+
+                outs = run_concurrent_simulations(
+                    engine, [make_run(r) for r in range(runs)], concurrency
+                )
         finally:
             engine.shutdown()
         failures = [o for o in outs if isinstance(o, BaseException)]

@@ -97,6 +97,47 @@ _register(
     "Test-suite opt-out of the ~10-minute CPU full-stack bench test "
     "(tests/test_bench_cpu_stack.py).",
 )
+
+# BCG_TPU_SERVE_* — continuous-batching serving subsystem (bcg_tpu/serve).
+_register(
+    "BCG_TPU_SERVE", "bool", False,
+    "Route concurrent games through the arrival-driven ServingEngine "
+    "scheduler (bcg_tpu/serve) instead of the CollectiveEngine lockstep "
+    "barrier.",
+)
+_register(
+    "BCG_TPU_SERVE_LINGER_MS", "int", 10,
+    "Max milliseconds a partial device batch lingers for merge partners "
+    "before the scheduler dispatches it anyway (0 = dispatch "
+    "immediately).",
+)
+_register(
+    "BCG_TPU_SERVE_BUCKET_ROWS", "int", 0,
+    "Explicit device-batch row bucket for the serving scheduler; also "
+    "enables strict admission (oversize requests rejected).  0 derives "
+    "the merge cap from the engine's KV budget (cap_for) instead.",
+)
+_register(
+    "BCG_TPU_SERVE_MAX_QUEUE_ROWS", "int", 4096,
+    "Backpressure watermark: submissions block while the scheduler "
+    "queue holds at least this many rows.",
+)
+_register(
+    "BCG_TPU_SERVE_DEADLINE_MS", "int", 0,
+    "Per-request deadline for serving-scheduler calls; a request still "
+    "queued past it fails with RequestCancelled (0 = no deadline).",
+)
+_register(
+    "BCG_TPU_SERVE_CHECKPOINT_EVERY", "int", 0,
+    "Write a resumable checkpoint every N game rounds (runtime/"
+    "checkpoint.py), independent of --checkpoint-every-round; 0 = off.",
+)
+_register(
+    "BCG_TPU_COLLECTIVE_WATCHDOG_S", "int", 0,
+    "Collective-barrier watchdog period in seconds: force-retire "
+    "participants whose worker thread died without retire() so the "
+    "barrier cannot hang (0 = off).",
+)
 _register(
     "VERBOSE", "bool", False,
     "Force RunLogger console verbosity (reference repo convention).",
@@ -157,6 +198,12 @@ _register(
     "BENCH_PROFILE_DIR", "str", None,
     "Capture a jax.profiler trace of the measured window into this "
     "directory (real backends only).",
+)
+_register(
+    "BENCH_SERVE", "bool", False,
+    "Run the BENCH_CONCURRENCY window through the continuous-batching "
+    "ServingEngine (bcg_tpu/serve) instead of CollectiveEngine waves; "
+    "scheduler stats land in the bench JSON.",
 )
 
 # MB_* microbench knobs (scripts/microbench_prefill.py).

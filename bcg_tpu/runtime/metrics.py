@@ -33,6 +33,19 @@ from typing import Dict, Optional
 # failed boot) — bench.py's error path reads this.
 LAST_BOOT_PHASES: Optional[Dict] = None
 
+# Latest serving-scheduler stats snapshot (bcg_tpu/serve): queue depth,
+# batch occupancy, linger histogram, admission rejections.  Mirrors the
+# LAST_BOOT_PHASES pattern so bench.py / experiment drivers can attach
+# the serving profile to their JSON without holding the scheduler object.
+LAST_SERVE_STATS: Optional[Dict] = None
+
+
+def publish_serve_stats(snapshot: Dict) -> None:
+    """Record the most recent scheduler stats snapshot (called by
+    ``serve.Scheduler`` after each dispatch and at close)."""
+    global LAST_SERVE_STATS
+    LAST_SERVE_STATS = snapshot
+
 
 def _device_memory():
     """(bytes_in_use, peak_bytes_in_use) of device 0, or (None, None)

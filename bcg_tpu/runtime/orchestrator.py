@@ -23,6 +23,7 @@ Differences from the reference (documented improvements):
 
 from __future__ import annotations
 
+import itertools
 import os
 from typing import Dict, List, Optional, Tuple
 
@@ -38,6 +39,7 @@ from bcg_tpu.comm import (
 from bcg_tpu.config import BCGConfig
 from bcg_tpu.engine.interface import InferenceEngine, create_engine
 from bcg_tpu.game import ByzantineConsensusGame
+from bcg_tpu.runtime import envflags
 from bcg_tpu.runtime.logging import RunLogger
 from bcg_tpu.runtime.metrics import build_metrics_payload, save_json_results, save_metrics_csv
 from bcg_tpu.runtime.profiler import SimulationProfiler
@@ -76,6 +78,12 @@ def build_topology(num_agents: int, network_config) -> NetworkTopology:
 class BCGSimulation:
     """Wires game + network + agents + engine and runs the round loop."""
 
+    # Process-unique sim ids: run numbering is derived from saved result
+    # files, so with save_results=False EVERY sim is run "001" — the
+    # uid keeps concurrent games' periodic checkpoints from clobbering
+    # one file (see run_round).
+    _uid_counter = itertools.count(1)
+
     def __init__(
         self,
         config: Optional[BCGConfig] = None,
@@ -92,6 +100,7 @@ class BCGSimulation:
         # resuming so artifacts stay under the original run id.
         json_dir = os.path.join(metrics_cfg.results_dir, "json")
         self.run_number = run_number or self._next_run_number(json_dir)
+        self._sim_uid = next(BCGSimulation._uid_counter)
 
         log_path = None
         if metrics_cfg.save_results:
@@ -556,13 +565,31 @@ class BCGSimulation:
         self.network.end_round_gc(round_num)
         self.profiler.count_round(num_decisions=2 * len(self.agents))
 
-        if self.config.metrics.checkpoint_every_round and self.config.metrics.save_results:
+        # Per-round checkpoints (--checkpoint-every-round) ride the
+        # save_results sinks; BCG_TPU_SERVE_CHECKPOINT_EVERY=N
+        # additionally checkpoints every N rounds regardless of the
+        # result sinks — long serving sweeps (bcg_tpu/serve) survive the
+        # short healthy hardware windows without paying a file write per
+        # round per game.
+        checkpoint_n = envflags.get_int("BCG_TPU_SERVE_CHECKPOINT_EVERY")
+        if (
+            (self.config.metrics.checkpoint_every_round
+             and self.config.metrics.save_results)
+            or (checkpoint_n > 0 and round_num % checkpoint_n == 0)
+        ):
             from bcg_tpu.runtime.checkpoint import save_checkpoint
 
+            # With result sinks OFF, run numbering is not unique (every
+            # sim scans an empty json/ dir and becomes "001") — suffix
+            # the process-unique sim uid so G concurrent games write G
+            # checkpoints instead of clobbering one file.
+            name = (
+                f"run_{self.run_number}.json"
+                if self.config.metrics.save_results
+                else f"run_{self.run_number}_g{self._sim_uid}.json"
+            )
             save_checkpoint(self, os.path.join(
-                self.config.metrics.results_dir,
-                "checkpoints",
-                f"run_{self.run_number}.json",
+                self.config.metrics.results_dir, "checkpoints", name,
             ))
 
         last = self.game.rounds[-1]

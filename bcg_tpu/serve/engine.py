@@ -1,0 +1,150 @@
+"""`InferenceEngine`-conforming proxy over the continuous-batching
+scheduler, plus the serving counterpart of
+``collective.run_concurrent_simulations``.
+
+:class:`ServingEngine` is shared by any number of game threads: each
+call enqueues as an independent request and blocks only on its OWN
+future, so a slow or crashed game never stalls the others (contrast the
+collective barrier, which dispatches only when every active participant
+is blocked).  No ``retire()`` bookkeeping exists to forget — a finished
+game simply stops submitting.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from bcg_tpu.engine.interface import InferenceEngine, per_row_settings as _rows
+from bcg_tpu.serve.scheduler import Scheduler
+
+
+class ServingEngine(InferenceEngine):
+    """Continuous-batching proxy: the serving-stack replacement for
+    :class:`~bcg_tpu.engine.collective.CollectiveEngine`.
+
+    ``owns_inner=True`` makes :meth:`shutdown` also shut the inner
+    engine down (for callers that created the inner engine solely to
+    wrap it); by default the inner engine stays caller-owned, matching
+    the collective proxy's contract.
+    """
+
+    def __init__(self, engine: InferenceEngine, *, owns_inner: bool = False,
+                 scheduler: Optional[Scheduler] = None, **scheduler_kwargs):
+        self._engine = engine
+        self._owns_inner = owns_inner
+        self.scheduler = scheduler or Scheduler(engine, **scheduler_kwargs)
+
+    # --------------------------------------------------- InferenceEngine API
+
+    def batch_generate_json(self, prompts, temperature=0.8, max_tokens=512):
+        if not prompts:
+            return []
+        n = len(prompts)
+        # One signature for ALL guided calls: temperature and budget ride
+        # per-row, so a game mid-decide merges with a game mid-vote.
+        return self.scheduler.submit_and_wait(
+            ("json",), list(prompts),
+            _rows(temperature, n, float), _rows(max_tokens, n, int),
+        )
+
+    def generate_json(self, prompt, schema, temperature=0.0, max_tokens=512,
+                      system_prompt=None) -> Dict[str, Any]:
+        return self.batch_generate_json(
+            [(system_prompt or "", prompt, schema)], temperature, max_tokens
+        )[0]
+
+    def batch_generate(self, prompts, temperature=0.0, max_tokens=256, top_p=1.0):
+        if not prompts:
+            return []
+        n = len(prompts)
+        return self.scheduler.submit_and_wait(
+            ("free", float(top_p)), list(prompts),
+            _rows(temperature, n, float), _rows(max_tokens, n, int),
+        )
+
+    def generate(self, prompt, temperature=0.0, max_tokens=256, top_p=1.0,
+                 system_prompt=None) -> str:
+        if system_prompt is not None:
+            # Chat formatting is model-specific and lives in the inner
+            # engine — delegate directly (generate() is off the game's
+            # hot path), serialized against in-flight device batches via
+            # the scheduler's device lock.
+            return self.scheduler.run_exclusive(
+                lambda: self._engine.generate(
+                    prompt, temperature, max_tokens, top_p,
+                    system_prompt=system_prompt,
+                )
+            )
+        return self.batch_generate([prompt], temperature, max_tokens, top_p)[0]
+
+    def shutdown(self) -> None:
+        self.scheduler.close()
+        if self._owns_inner:
+            self._engine.shutdown()
+
+    # -------------------------------------------------------------- stats
+
+    def stats(self) -> Dict[str, Any]:
+        """Live scheduler counters (queue depth, batch occupancy, linger
+        histogram, admission rejections)."""
+        return self.scheduler.snapshot()
+
+
+def run_serving_simulations(
+    engine: InferenceEngine,
+    run_fns: List[Callable[[InferenceEngine], Any]],
+    max_concurrent: Optional[int] = None,
+    serving: Optional[ServingEngine] = None,
+    **scheduler_kwargs,
+) -> List[Any]:
+    """Run ``run_fns`` (each ``fn(engine) -> result``) concurrently against
+    one shared :class:`ServingEngine`.
+
+    Unlike ``run_concurrent_simulations`` there are no lockstep waves: all
+    games run at their own pace and the scheduler merges whatever calls
+    coincide within the linger window.  ``max_concurrent`` bounds the
+    games running AT ONCE (the KV-memory analog of the collective wave
+    size) via a semaphore — a finished game's slot is reused immediately
+    instead of waiting for its whole wave to drain.
+
+    Results keep input order; a failed run stores its exception object in
+    its slot (crash isolation: the scheduler and every other game keep
+    going).
+
+    Pass a pre-built ``serving`` proxy to share/inspect its scheduler;
+    it then stays OPEN after the call (caller-owned), whereas an
+    internally built one is closed on return.
+    """
+    caller_owned = serving is not None
+    if serving is None:
+        serving = ServingEngine(engine, **scheduler_kwargs)
+    gate = (
+        threading.BoundedSemaphore(max_concurrent)
+        if max_concurrent and max_concurrent < len(run_fns) else None
+    )
+    results: List[Any] = [None] * len(run_fns)
+
+    def worker(idx: int) -> None:
+        if gate is not None:
+            gate.acquire()
+        try:
+            results[idx] = run_fns[idx](serving)
+        except BaseException as e:
+            results[idx] = e
+        finally:
+            if gate is not None:
+                gate.release()
+
+    threads = [
+        threading.Thread(target=worker, args=(i,), name=f"bcg-serve-{i}")
+        for i in range(len(run_fns))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if not caller_owned:
+        # The inner engine stays caller-owned; only the scheduler closes.
+        serving.scheduler.close()
+    return results

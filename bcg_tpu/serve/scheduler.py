@@ -1,0 +1,490 @@
+"""Arrival-driven continuous-batching scheduler.
+
+:class:`bcg_tpu.engine.collective.CollectiveEngine` batches by BARRIER:
+dispatch waits until every active participant is blocked, so one slow or
+crashed game stalls the whole wave (and a missing ``retire()`` hangs it
+forever).  :class:`Scheduler` replaces barrier semantics with a request
+queue and a dispatch loop: each engine call enqueues as an independent
+:class:`Request`; a single scheduler thread forms device batches whenever
+a shape bucket fills **or** the oldest pending request has lingered past
+``BCG_TPU_SERVE_LINGER_MS`` — it never waits on participants that are
+not blocked on a call.  Games that crash simply stop submitting; their
+failure reaches only their own futures.
+
+Batch formation reuses the signature mechanics
+``CollectiveEngine._dispatch_all_locked`` proved out: every guided call
+shares one ``("json",)`` signature (temperature and token budget ride
+PER ROW, so a game mid-decide merges with a game mid-vote); free-text
+calls group by top_p.
+
+Memory safety: the merge cap is KV-budget-aware.  When the inner engine
+exposes ``cap_for`` (``engine/jax_engine.py``), the scheduler never merges
+a batch past the row count the engine's HBM budget affords at the
+worst-case decode window — the same accounting ``_check_kv_budget`` warns
+on — so admitted concurrency cannot overcommit HBM.  A single request
+larger than the cap is dispatched alone (the engine's own
+``_provisioned_row_cap`` chunks it, exactly as the collective path relies
+on) unless the cap was set explicitly (``strict_admission``), in which
+case it is REJECTED with :class:`AdmissionRejected` — an operator-set
+bucket is a serving contract, not a hint.
+
+Locking discipline: the queue condition is only ever held around QUEUE
+STATE; the inner engine runs outside it, guarded by a dedicated device
+lock that is never held while waiting on game progress.  The static rule
+``BCG-LOCK-CALL`` (``bcg_tpu/analysis/rules.py``) enforces this shape for
+future edits — an engine call under a scheduler/collective lock is the
+deadlock class this module exists to retire.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from bcg_tpu.runtime import envflags
+
+# Linger-histogram bucket upper bounds in milliseconds (last bucket is
+# open-ended).  Linger = enqueue -> dispatch-start wait per request.
+_LINGER_BUCKETS_MS = (1, 2, 5, 10, 20, 50, 100)
+
+
+class AdmissionRejected(RuntimeError):
+    """Request refused at admission: it can never fit the configured
+    device bucket (strict mode) so queueing it would just stall it."""
+
+
+class RequestCancelled(TimeoutError):
+    """Request missed its deadline before dispatch (or the scheduler
+    went away while it was queued)."""
+
+
+class SchedulerClosed(RuntimeError):
+    """Submitted to (or queued on) a scheduler that has shut down."""
+
+
+class Request:
+    """One engine call from one participant, completed independently."""
+
+    __slots__ = ("sig", "payload", "n_rows", "temps", "budgets", "deadline",
+                 "enqueued_at", "done", "results", "error")
+
+    def __init__(self, sig: Tuple, payload: List, temps: List[float],
+                 budgets: List[int], deadline: Optional[float]):
+        self.sig = sig
+        self.payload = payload
+        self.n_rows = len(payload)
+        self.temps = temps
+        self.budgets = budgets
+        self.deadline = deadline      # absolute time.monotonic(), or None
+        self.enqueued_at = 0.0
+        self.done = threading.Event()
+        self.results: Optional[List] = None
+        self.error: Optional[BaseException] = None
+
+    def fail(self, error: BaseException) -> None:
+        self.error = error
+        self.done.set()
+
+    def complete(self, results: List) -> None:
+        self.results = results
+        self.done.set()
+
+
+class SchedulerStats:
+    """Counters + linger histogram; mutated only under the scheduler
+    condition, snapshotted for :mod:`bcg_tpu.runtime.metrics`."""
+
+    def __init__(self):
+        self.submitted = 0
+        self.completed = 0
+        self.failed = 0            # engine raised for the request's batch
+        self.cancelled = 0         # deadline expiry / close while queued
+        self.rejected = 0          # strict admission refusals
+        self.dispatches = 0
+        self.dispatched_rows = 0
+        self.merged_dispatches = 0  # dispatches that merged >1 request
+        self.oversize_dispatches = 0
+        self.engine_errors = 0
+        self.backpressure_blocks = 0
+        self.max_queue_rows = 0
+        self.linger_samples = 0
+        self.linger_seconds_total = 0.0
+        self.linger_hist = [0] * (len(_LINGER_BUCKETS_MS) + 1)
+
+    def record_linger(self, seconds: float) -> None:
+        self.linger_samples += 1
+        self.linger_seconds_total += seconds
+        ms = seconds * 1e3
+        for i, bound in enumerate(_LINGER_BUCKETS_MS):
+            if ms <= bound:
+                self.linger_hist[i] += 1
+                return
+        self.linger_hist[-1] += 1
+
+    def snapshot(self, row_cap: Optional[int] = None,
+                 queue_rows: int = 0) -> Dict[str, Any]:
+        done = self.completed + self.failed + self.cancelled + self.rejected
+        hist_keys = [f"<={b}ms" for b in _LINGER_BUCKETS_MS] + [
+            f">{_LINGER_BUCKETS_MS[-1]}ms"
+        ]
+        return {
+            "submitted": self.submitted,
+            "completed": self.completed,
+            "failed": self.failed,
+            "cancelled": self.cancelled,
+            "rejected": self.rejected,
+            "pending": self.submitted - done,  # queued or mid-dispatch
+            "queue_rows": queue_rows,
+            "max_queue_rows": self.max_queue_rows,
+            "dispatches": self.dispatches,
+            "dispatched_rows": self.dispatched_rows,
+            "merged_dispatches": self.merged_dispatches,
+            "oversize_dispatches": self.oversize_dispatches,
+            "engine_errors": self.engine_errors,
+            "backpressure_blocks": self.backpressure_blocks,
+            "row_cap": row_cap,
+            "batch_occupancy": (
+                round(self.dispatched_rows / (self.dispatches * row_cap), 4)
+                if row_cap and self.dispatches else None
+            ),
+            # Mean over DISPATCHED requests only: rejected/cancelled
+            # requests never lingered to dispatch, so counting them
+            # would understate latency exactly under overload.
+            "mean_linger_ms": (
+                round(1e3 * self.linger_seconds_total / self.linger_samples, 3)
+                if self.linger_samples else None
+            ),
+            "linger_hist_ms": dict(zip(hist_keys, self.linger_hist)),
+        }
+
+
+def derive_row_cap(engine) -> Optional[int]:
+    """KV-budget row cap from the inner engine, or None when the engine
+    exposes no budget (fake/stub engines, CPU).  Uses the engine's own
+    ``cap_for`` at the worst-case decode window so the scheduler's merge
+    accounting agrees byte-for-byte with ``_check_kv_budget``."""
+    cap_for = getattr(engine, "cap_for", None)
+    max_len = getattr(engine, "max_model_len", None)
+    if cap_for is None or not max_len:
+        return None
+    return cap_for(int(max_len))
+
+
+class Scheduler:
+    """Request queue + dispatch thread over one inner engine.
+
+    Parameters default from the ``BCG_TPU_SERVE_*`` env flags
+    (:mod:`bcg_tpu.runtime.envflags`); pass explicit values to override.
+
+    ``bucket_rows``: target device-batch rows.  0 (default) derives the
+    cap from the engine's KV budget (:func:`derive_row_cap`); an explicit
+    value also enables ``strict_admission`` unless overridden.
+    """
+
+    def __init__(
+        self,
+        engine,
+        *,
+        linger_ms: Optional[int] = None,
+        bucket_rows: Optional[int] = None,
+        max_queue_rows: Optional[int] = None,
+        deadline_ms: Optional[int] = None,
+        strict_admission: Optional[bool] = None,
+    ):
+        self._engine = engine
+        if linger_ms is None:
+            linger_ms = envflags.get_int("BCG_TPU_SERVE_LINGER_MS")
+        if bucket_rows is None:
+            bucket_rows = envflags.get_int("BCG_TPU_SERVE_BUCKET_ROWS")
+        if max_queue_rows is None:
+            max_queue_rows = envflags.get_int("BCG_TPU_SERVE_MAX_QUEUE_ROWS")
+        if deadline_ms is None:
+            deadline_ms = envflags.get_int("BCG_TPU_SERVE_DEADLINE_MS")
+        self._linger_s = max(0, linger_ms) / 1e3
+        if bucket_rows and bucket_rows > 0:
+            self._row_cap: Optional[int] = int(bucket_rows)
+            explicit_cap = True
+        else:
+            self._row_cap = derive_row_cap(engine)
+            explicit_cap = False
+        self._strict = explicit_cap if strict_admission is None else strict_admission
+        self._max_queue_rows = max(1, max_queue_rows)
+        self._deadline_s = max(0, deadline_ms) / 1e3
+        self.stats = SchedulerStats()
+
+        self._cond = threading.Condition()
+        self._queue: List[Request] = []
+        self._queue_rows = 0
+        self._closed = False
+        # Serializes device access: held ONLY around the inner engine
+        # call itself, never while holding self._cond and never while a
+        # request waits for queue admission — so it cannot participate in
+        # a lock-ordering cycle with game progress.
+        self._device_lock = threading.Lock()
+        self._thread = threading.Thread(
+            target=self._loop, name="bcg-serve-scheduler", daemon=True
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------ submission
+
+    def submit(self, sig: Tuple, payload: List, temps: List[float],
+               budgets: List[int]) -> Request:
+        """Enqueue one call; returns its :class:`Request` future.
+
+        Blocks for queue admission (backpressure) when the queued row
+        count would exceed ``max_queue_rows``; rejects oversize requests
+        under strict admission."""
+        now = time.monotonic()
+        deadline = now + self._deadline_s if self._deadline_s > 0 else None
+        req = Request(sig, payload, temps, budgets, deadline)
+        with self._cond:
+            self.stats.submitted += 1
+            if self._closed:
+                self.stats.cancelled += 1
+                req.fail(SchedulerClosed("scheduler is shut down"))
+                return req
+            if (self._row_cap is not None and self._strict
+                    and req.n_rows > self._row_cap):
+                self.stats.rejected += 1
+                req.fail(AdmissionRejected(
+                    f"request of {req.n_rows} rows exceeds the device "
+                    f"bucket of {self._row_cap} rows"
+                ))
+                return req
+            blocked = False
+            # A lone request larger than the watermark must still admit
+            # once the queue drains (compare against max(watermark, n):
+            # blocking it unconditionally would hang the submitter
+            # forever on an empty queue).
+            watermark = max(self._max_queue_rows, req.n_rows)
+            while (self._queue_rows + req.n_rows > watermark
+                   and not self._closed):
+                if not blocked:
+                    blocked = True
+                    self.stats.backpressure_blocks += 1
+                timeout = None
+                if req.deadline is not None:
+                    timeout = req.deadline - time.monotonic()
+                    if timeout <= 0:
+                        self.stats.cancelled += 1
+                        req.fail(RequestCancelled(
+                            "deadline expired waiting for queue admission"
+                        ))
+                        return req
+                self._cond.wait(timeout if timeout is not None else 1.0)
+                if not self._thread.is_alive() and not self._closed:
+                    # Dead-scheduler detection for admission waiters (the
+                    # submit_and_wait counterpart): a queue that can
+                    # never drain must not block a submitter forever.
+                    self.stats.cancelled += 1
+                    req.fail(SchedulerClosed(
+                        "scheduler thread died while this request waited "
+                        "for queue admission"
+                    ))
+                    return req
+            if self._closed:
+                self.stats.cancelled += 1
+                req.fail(SchedulerClosed("scheduler shut down during admission"))
+                return req
+            req.enqueued_at = time.monotonic()
+            self._queue.append(req)
+            self._queue_rows += req.n_rows
+            self.stats.max_queue_rows = max(
+                self.stats.max_queue_rows, self._queue_rows
+            )
+            self._cond.notify_all()
+        return req
+
+    def submit_and_wait(self, sig: Tuple, payload: List, temps: List[float],
+                        budgets: List[int]) -> List:
+        """Enqueue and block until completion; raises the request's error."""
+        req = self.submit(sig, payload, temps, budgets)
+        while not req.done.wait(timeout=5.0):
+            # Lost-wakeup / dead-scheduler safety net, not a timer: a
+            # request can wait arbitrarily long behind real traffic, but
+            # must not wait forever on a scheduler that died.
+            if not self._thread.is_alive() and not req.done.is_set():
+                raise SchedulerClosed(
+                    "scheduler thread died with this request pending"
+                )
+        if req.error is not None:
+            raise req.error
+        return req.results  # type: ignore[return-value]
+
+    # ---------------------------------------------------------- dispatch loop
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                batch: Optional[List[Request]] = None
+                while batch is None:
+                    if self._closed:
+                        return
+                    now = time.monotonic()
+                    self._cancel_expired_locked(now)
+                    batch = self._form_batch_locked(now)
+                    if batch is None:
+                        self._cond.wait(self._wakeup_timeout_locked(now))
+                if len(batch) > 1:
+                    self.stats.merged_dispatches += 1
+                if (self._row_cap is not None
+                        and sum(r.n_rows for r in batch) > self._row_cap):
+                    self.stats.oversize_dispatches += 1
+                dispatch_t = time.monotonic()
+                for r in batch:
+                    self.stats.record_linger(dispatch_t - r.enqueued_at)
+            self._dispatch(batch)
+            self._publish_stats()
+
+    def _cancel_expired_locked(self, now: float) -> None:
+        expired = [r for r in self._queue
+                   if r.deadline is not None and now >= r.deadline]
+        if not expired:
+            return
+        for r in expired:
+            self.stats.cancelled += 1
+            r.fail(RequestCancelled(
+                f"deadline expired after {now - r.enqueued_at:.3f}s in queue"
+            ))
+        self._queue = [r for r in self._queue if not r.done.is_set()]
+        self._queue_rows = sum(r.n_rows for r in self._queue)
+        self._cond.notify_all()
+
+    def _form_batch_locked(self, now: float) -> Optional[List[Request]]:
+        """Oldest-first over signature groups: dispatch a group when its
+        bucket is full (>= row cap) or its oldest member has lingered past
+        the linger deadline.  Returns the chosen requests, removed from
+        the queue, or None when nothing is ripe yet."""
+        if not self._queue:
+            return None
+        seen: List[Tuple] = []
+        for head in self._queue:
+            if head.sig in seen:
+                continue
+            seen.append(head.sig)
+            group = [r for r in self._queue if r.sig == head.sig]
+            rows = sum(r.n_rows for r in group)
+            full = self._row_cap is not None and rows >= self._row_cap
+            lingered = now - group[0].enqueued_at >= self._linger_s
+            if not (full or lingered):
+                continue
+            batch: List[Request] = []
+            taken = 0
+            for r in group:
+                if (batch and self._row_cap is not None
+                        and taken + r.n_rows > self._row_cap):
+                    break
+                batch.append(r)
+                taken += r.n_rows
+            chosen = set(map(id, batch))
+            self._queue = [r for r in self._queue if id(r) not in chosen]
+            self._queue_rows -= taken
+            self._cond.notify_all()  # backpressure waiters may now fit
+            return batch
+        return None
+
+    def _wakeup_timeout_locked(self, now: float) -> Optional[float]:
+        """Sleep until the earliest linger expiry or request deadline."""
+        if not self._queue:
+            return None
+        wake = min(r.enqueued_at + self._linger_s for r in self._queue)
+        deadlines = [r.deadline for r in self._queue if r.deadline is not None]
+        if deadlines:
+            wake = min(wake, min(deadlines))
+        return max(0.001, wake - now)
+
+    def _dispatch(self, batch: List[Request]) -> None:
+        """Run one merged inner-engine call and scatter results.
+
+        Runs on the scheduler thread with NO scheduler lock held; an
+        engine failure reaches only this batch's futures — the loop and
+        every other queued request keep going (crash-isolated completion).
+        """
+        sig = batch[0].sig
+        merged: List = []
+        temps: List[float] = []
+        budgets: List[int] = []
+        for r in batch:
+            merged.extend(r.payload)
+            temps.extend(r.temps)
+            budgets.extend(r.budgets)
+        # Collapse to scalars when uniform so plain engines (fake, stubs)
+        # that expect scalar settings keep working (collective.py idiom).
+        temperature = temps[0] if len(set(temps)) == 1 else temps
+        max_tokens = budgets[0] if len(set(budgets)) == 1 else budgets
+        try:
+            with self._device_lock:
+                if sig[0] == "json":
+                    # The device lock guards ONLY the engine call; it is
+                    # never held together with the queue cond nor across
+                    # game progress, so the BCG-LOCK-CALL deadlock shape
+                    # (queue state pinned during a device call) cannot
+                    # occur here.
+                    # lint: ignore[BCG-LOCK-CALL]
+                    out = self._engine.batch_generate_json(
+                        merged, temperature=temperature, max_tokens=max_tokens
+                    )
+                else:
+                    # lint: ignore[BCG-LOCK-CALL]  (same device-gate-only discipline)
+                    out = self._engine.batch_generate(
+                        merged, temperature=temperature, max_tokens=max_tokens,
+                        top_p=sig[1],
+                    )
+            pos = 0
+            for r in batch:
+                r.complete(out[pos: pos + r.n_rows])
+                pos += r.n_rows
+            with self._cond:
+                self.stats.completed += len(batch)
+                self.stats.dispatches += 1
+                self.stats.dispatched_rows += len(merged)
+        except BaseException as e:
+            for r in batch:
+                r.fail(e)
+            with self._cond:
+                self.stats.failed += len(batch)
+                self.stats.engine_errors += 1
+                self.stats.dispatches += 1
+                self.stats.dispatched_rows += len(merged)
+
+    def run_exclusive(self, fn):
+        """Run ``fn()`` holding the device lock — for proxy paths that
+        must call the inner engine directly (e.g. chat-formatted
+        ``generate``) without overlapping an in-flight device batch."""
+        with self._device_lock:
+            return fn()
+
+    # ------------------------------------------------------------- lifecycle
+
+    @property
+    def row_cap(self) -> Optional[int]:
+        return self._row_cap
+
+    def queue_depth_rows(self) -> int:
+        with self._cond:
+            return self._queue_rows
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._cond:
+            return self.stats.snapshot(self._row_cap, self._queue_rows)
+
+    def _publish_stats(self) -> None:
+        from bcg_tpu.runtime import metrics
+
+        metrics.publish_serve_stats(self.snapshot())
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Stop the dispatch loop; fail anything still queued.  Idempotent."""
+        with self._cond:
+            if not self._closed:
+                self._closed = True
+                for r in self._queue:
+                    self.stats.cancelled += 1
+                    r.fail(SchedulerClosed("scheduler shut down"))
+                self._queue = []
+                self._queue_rows = 0
+            self._cond.notify_all()
+        self._thread.join(timeout=timeout)
+        self._publish_stats()

@@ -149,10 +149,21 @@ _CONFIG_OVERRIDE_ENVS = (
     "BENCH_FAST_FORWARD", "BENCH_COMPACT_JSON", "BENCH_PREFIX_CACHING",
     "BENCH_SHARED_CORE", "BENCH_PREFILL_CHUNK", "BENCH_SCAN_LAYERS",
     "BENCH_ATTENTION_IMPL", "BENCH_CONCURRENCY", "BENCH_FORCE_CPU",
+    "BENCH_SERVE",
     "BCG_TPU_DISABLE_INT8_DECODE_KERNEL", "BCG_TPU_DISABLE_W4_KERNEL",
     "BCG_TPU_ALLOW_PADDED_GROUP_KERNEL", "BCG_TPU_FINE_SUFFIX",
     "BCG_TPU_W8A16_PREFILL",
 )
+
+
+def _serve_stats_or_none():
+    """Latest serving-scheduler snapshot when BENCH_SERVE ran the
+    window through bcg_tpu/serve; None on the collective path."""
+    if not envflags.get_bool("BENCH_SERVE"):
+        return None
+    from bcg_tpu.runtime import metrics as _metrics
+
+    return _metrics.LAST_SERVE_STATS
 
 
 def _is_default_config() -> bool:
@@ -299,21 +310,33 @@ def _run_attempt(cfg, model: str, backend: str, concurrency: int,
     # less than G sequential runs.  Each round is a thread wave over a
     # fresh CollectiveEngine; terminated games are replaced BETWEEN waves
     # so the merged batch stays G * agents rows (stable compiled shapes).
-    def run_wave(sims) -> None:
-        from bcg_tpu.engine.collective import run_concurrent_simulations
+    # BENCH_SERVE=1 routes the same window through the arrival-driven
+    # serving scheduler (bcg_tpu/serve) instead: no barrier, batches form
+    # on bucket-fill/linger, scheduler stats land in the bench JSON.
+    bench_serve = envflags.get_bool("BENCH_SERVE")
 
+    def run_wave(sims) -> None:
         def make(s):
-            def go(collective):
-                s.set_engine(collective)
+            def go(proxy):
+                s.set_engine(proxy)
                 try:
                     s.run_round()
                 finally:
                     s.set_engine(engine)
             return go
 
-        outs = run_concurrent_simulations(
-            engine, [make(s) for s in sims], len(sims)
-        )
+        if bench_serve:
+            from bcg_tpu.serve import run_serving_simulations
+
+            outs = run_serving_simulations(
+                engine, [make(s) for s in sims]
+            )
+        else:
+            from bcg_tpu.engine.collective import run_concurrent_simulations
+
+            outs = run_concurrent_simulations(
+                engine, [make(s) for s in sims], len(sims)
+            )
         for o in outs:
             if isinstance(o, BaseException):
                 raise o
@@ -548,6 +571,9 @@ def _run_attempt(cfg, model: str, backend: str, concurrency: int,
             # the phase attribution the next boot-time OOM needs
             # (runtime/metrics.py BootPhaseRecorder).
             "boot_phases": getattr(engine, "boot_phases", None),
+            # BENCH_SERVE=1: latest serving-scheduler snapshot (queue
+            # depth, batch occupancy, linger histogram, rejections).
+            "serve_stats": _serve_stats_or_none(),
             "window_decode_steps": window_steps,
             "window_failed_row_fraction": round(failed_fraction, 4),
             "baseline_denominator_dec_per_sec": (

@@ -46,6 +46,7 @@ RULE_FIXTURES = {
     "BCG-ENV-UNREG": ("bad_env_unreg.py", "good_env_unreg.py"),
     "BCG-EXCEPT-BROAD": ("bad_except_broad.py", "good_except_broad.py"),
     "BCG-MUT-DEFAULT": ("bad_mut_default.py", "good_mut_default.py"),
+    "BCG-LOCK-CALL": ("bad_lock_call.py", "good_lock_call.py"),
 }
 
 
@@ -90,6 +91,7 @@ class TestRuleFixtures:
             "BCG-MUT-DEFAULT": 2,
             "BCG-JIT-OUTSHARD": 2,
             "BCG-JIT-DONATE": 1,
+            "BCG-LOCK-CALL": 3,
         }
         for rule_id, want in expected.items():
             bad, _ = RULE_FIXTURES[rule_id]

@@ -341,3 +341,65 @@ class TestExperimentsConcurrency:
                          max_rounds=4, seed=0, concurrency=3)
         assert len(out["per_run"]) == 3
         assert "consensus_rate" in out["aggregate"] or out["aggregate"]
+
+
+class TestWatchdog:
+    def test_dead_thread_without_retire_is_force_retired(self, monkeypatch):
+        """A watched worker that dies WITHOUT retiring (the crash shape
+        the barrier docstring warns about) no longer hangs the barrier:
+        with BCG_TPU_COLLECTIVE_WATCHDOG_S set, a waiting caller reaps it
+        and dispatch proceeds."""
+        monkeypatch.setenv("BCG_TPU_COLLECTIVE_WATCHDOG_S", "1")
+        coll = CollectiveEngine(StubEngine(), participants=2)
+
+        dead = threading.Thread(target=lambda: None)
+        coll.watch(dead)
+        dead.start()
+        dead.join()  # died without retire()
+
+        out = {}
+
+        def worker():
+            out["r"] = coll.batch_generate_json([("s", "u", DECIDE)], 0.5, 300)
+            coll.retire()
+
+        t = threading.Thread(target=worker)
+        coll.watch(t)
+        t.start()
+        t.join(timeout=10)
+        assert not t.is_alive(), "barrier hung despite the watchdog"
+        assert "value" in out["r"][0]
+
+    def test_retire_idempotent_after_force_retire(self, monkeypatch):
+        """A worker whose thread the watchdog already reaped must not
+        shrink the barrier twice when its own retire() still runs."""
+        monkeypatch.setenv("BCG_TPU_COLLECTIVE_WATCHDOG_S", "1")
+        coll = CollectiveEngine(StubEngine(), participants=2)
+        me = threading.current_thread()
+        with coll._cond:
+            coll._watched[me] = True  # simulate: watchdog reaped us
+            coll._active -= 1
+        coll.retire()  # our own (late) retire must be a no-op
+        assert coll._active == 1
+
+    def test_watchdog_off_keeps_legacy_behavior(self):
+        """Default (flag unset): watch() bookkeeping alone must not
+        change barrier arithmetic for normally-retiring workers."""
+        inner = StubEngine()
+        coll = CollectiveEngine(inner, participants=2)
+        results = {}
+
+        def worker(name):
+            results[name] = coll.batch_generate_json(
+                [(f"s-{name}", f"u-{name}", DECIDE)], 0.5, 300)
+            coll.retire()
+
+        ts = [threading.Thread(target=worker, args=(n,)) for n in "ab"]
+        for t in ts:
+            coll.watch(t)
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert inner.calls == [2]
+        assert set(results) == {"a", "b"}
