@@ -634,6 +634,57 @@ def rule_lock_call(ctx: ModuleContext) -> Iterable[Finding]:
             )
 
 
+# ------------------------------------------------ rule: wall-clock durations
+@_rule("BCG-TIME-WALL")
+def rule_time_wall(ctx: ModuleContext) -> Iterable[Finding]:
+    """``time.time()`` used in duration arithmetic — an operand of
+    ``+``/``-`` (elapsed computation, deadline accumulation) or of an
+    ordering comparison (deadline polling).  The wall clock steps under
+    NTP corrections, so a "duration" spanning a step is wrong by the
+    step; use ``time.perf_counter()`` (or ``time.monotonic()``).  Bare
+    timestamp uses — stored or emitted with no arithmetic at the call
+    site — are legitimate and stay unflagged (park deliberate ones that
+    do arithmetic in the baseline with a reason)."""
+    for node in ast.walk(ctx.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and _call_name(node.func) == "time.time"
+            and not node.args
+            and not node.keywords
+        ):
+            continue
+        how = None
+        cur = ctx.parent(node)
+        while cur is not None:
+            if isinstance(cur, ast.BinOp) and isinstance(
+                cur.op, (ast.Add, ast.Sub)
+            ):
+                how = "duration arithmetic (+/-)"
+                break
+            if isinstance(cur, ast.Compare) and any(
+                isinstance(op, (ast.Lt, ast.LtE, ast.Gt, ast.GtE))
+                for op in cur.ops
+            ):
+                how = "deadline comparison"
+                break
+            if isinstance(cur, ast.AugAssign) and isinstance(
+                cur.op, (ast.Add, ast.Sub)
+            ):
+                how = "duration accumulation (+=/-=)"
+                break
+            if isinstance(cur, ast.stmt):
+                break
+            cur = ctx.parent(cur)
+        if how:
+            yield ctx.finding(
+                "BCG-TIME-WALL",
+                node,
+                f"time.time() in {how} — the wall clock steps under "
+                "NTP; use time.perf_counter()/time.monotonic() for "
+                "durations",
+            )
+
+
 # ------------------------------------------------- rule: mutable defaults
 @_rule("BCG-MUT-DEFAULT")
 def rule_mut_default(ctx: ModuleContext) -> Iterable[Finding]:
@@ -674,6 +725,7 @@ ALL_RULES: Sequence = (
     rule_except_broad,
     rule_mut_default,
     rule_lock_call,
+    rule_time_wall,
 )
 
 RULE_IDS: List[str] = [r.rule_id for r in ALL_RULES]

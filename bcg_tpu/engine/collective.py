@@ -37,6 +37,7 @@ import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from bcg_tpu.engine.interface import InferenceEngine, per_row_settings as _rows
+from bcg_tpu.obs import tracer as obs_tracer
 from bcg_tpu.runtime import envflags
 
 
@@ -111,7 +112,12 @@ class CollectiveEngine(InferenceEngine):
         wait_s = 60.0
         if self._watchdog_s > 0:
             wait_s = min(wait_s, max(0.05, self._watchdog_s / 4.0))
-        with self._cond:
+        # Traced as barrier wait: for all but the last-arriving caller
+        # this span IS the time spent blocked on slower participants
+        # (the last arrival's span additionally covers the merged
+        # dispatch it performs — engine spans nest under it).
+        with obs_tracer.span("collective.barrier_wait",
+                             args={"rows": n_rows}), self._cond:
             self._pending.append(call)
             self._blocked += 1
             if self._blocked == self._active:

@@ -49,6 +49,7 @@ from collections import Counter
 from typing import Any, Dict, List, Tuple
 
 from bcg_tpu.engine.interface import InferenceEngine
+from bcg_tpu.obs import tracer as obs_tracer
 
 # Matches per-agent proposal lines in round summaries ("agent_3 value: 17"),
 # not the agent's own "Your current value: N" line.
@@ -135,16 +136,28 @@ class FakeEngine(InferenceEngine):
         return self._respond(system_prompt or "", prompt, schema)
 
     def batch_generate_json(self, prompts, temperature=0.8, max_tokens=512):
+        """Mirrors the JaxEngine span taxonomy (``engine.prefill`` =
+        prompt normalization, ``engine.decode`` = response synthesis) so
+        hermetic serving traces are structurally realistic — the
+        acceptance trace of a FakeEngine game nests the same span names
+        a TPU run would."""
         self.batch_calls += 1
+        with obs_tracer.span("engine.prefill", args={"rows": len(prompts)}):
+            rows = []
+            for system_prompt, user_prompt, schema in prompts:
+                if isinstance(user_prompt, tuple):  # (shared_core, tail)
+                    user_prompt = "".join(user_prompt)
+                rows.append((system_prompt, user_prompt, schema))
         out = []
-        for system_prompt, user_prompt, schema in prompts:
-            self.call_count += 1
-            if isinstance(user_prompt, tuple):  # (shared_core, tail)
-                user_prompt = "".join(user_prompt)
-            if self.call_count <= self.fail_first_n_calls:
-                out.append({"error": "fake_injected_failure", "message": "injected"})
-            else:
-                out.append(self._respond(system_prompt, user_prompt, schema))
+        with obs_tracer.span("engine.decode", args={"rows": len(rows)}):
+            for system_prompt, user_prompt, schema in rows:
+                self.call_count += 1
+                if self.call_count <= self.fail_first_n_calls:
+                    out.append(
+                        {"error": "fake_injected_failure", "message": "injected"}
+                    )
+                else:
+                    out.append(self._respond(system_prompt, user_prompt, schema))
         return out
 
     # ---------------------------------------------------------------- policy

@@ -38,6 +38,7 @@ from bcg_tpu.engine.interface import InferenceEngine, per_row_settings as _per_r
 from bcg_tpu.engine.tokenizer import Tokenizer, tokenizer_for_model
 from bcg_tpu.guided.processor import GuidedBatch, compile_schema
 from bcg_tpu.config import env_flag
+from bcg_tpu.obs import counters as obs_counters, tracer as obs_tracer
 from bcg_tpu.models.configs import (
     LARGE_MODEL_PARAMS,
     ModelSpec,
@@ -578,6 +579,14 @@ class JaxEngine(InferenceEngine):
         self._decode_ring_active = False
         # Calls whose batch the hbm_utilization provisioner chunked.
         self.provision_chunk_events = 0
+        # Compile/retrace accounting (bcg_tpu.obs.counters): per jit
+        # entry point, the set of shape signatures seen — a host-side
+        # mirror of jax.jit's trace cache.  First signature per entry =
+        # expected compile; every FURTHER one increments
+        # engine.retrace.<entry> — a retrace in the steady-state decode
+        # loop is the single most expensive silent regression this
+        # engine has (tens of seconds per compile on a remote chip).
+        self._jit_shapes: Dict[str, set] = {}
         # Pad the token-byte table to the MODEL vocab (embedding tables are
         # padded past the tokenizer vocab, e.g. Qwen3 151669 -> 151936);
         # padding entries are b'' = forbidden, so logits and masks agree.
@@ -1317,6 +1326,23 @@ class JaxEngine(InferenceEngine):
 
         return masked_sample
 
+    def _note_jit_shape(self, entry: str, sig: Tuple) -> None:
+        """Count a compile (and, beyond the first signature per entry
+        point, a RETRACE) into the process-wide counter registry:
+        ``engine.compile.<entry>`` / ``engine.retrace.<entry>``.  Keyed
+        by (entry point, shape signature), incremented exactly once per
+        NEW signature — steady-state serving must show zero retrace
+        movement, and a test provoking one extra shape observes exactly
+        +1 (tests/test_obs.py)."""
+        seen = self._jit_shapes.setdefault(entry, set())
+        if sig in seen:
+            return
+        first = not seen
+        seen.add(sig)
+        obs_counters.inc(f"engine.compile.{entry}")
+        if not first:
+            obs_counters.inc(f"engine.retrace.{entry}")
+
     def _get_decode_loop(self, guided_sig: Tuple, max_new: int,
                          top_p: float = 1.0):
         """Build (or fetch) the compiled guided decode loop for a shape
@@ -1336,6 +1362,7 @@ class JaxEngine(InferenceEngine):
                self.decode_attention_impl)
         if key in self._decode_loops:
             return self._decode_loops[key]
+        self._note_jit_shape("decode_loop", key)
         self._decode_ring_active = ring is not None
 
         spec = self.spec
@@ -1431,6 +1458,7 @@ class JaxEngine(InferenceEngine):
         key = ("ff", guided_sig, int(max_new), float(top_p), chunk_impl)
         if key in self._decode_loops:
             return self._decode_loops[key]
+        self._note_jit_shape("ff_decode_loop", key)
         self._decode_ring_active = ring is not None
 
         spec = self.spec
@@ -1800,85 +1828,96 @@ class JaxEngine(InferenceEngine):
         else:
             decode_slots = max_new + 1
         t0 = time.perf_counter()
-        prepped = None
-        if self.prefix_caching and self._prefix_safe and all(p for p, _, _ in parts):
-            prepped = self._prepare_prefixed_batch(parts, budgets, decode_slots)
-            if prepped is None:
-                self.prefix_fallbacks += 1
-                if not self._prefix_fallback_warned:
-                    import warnings
+        with obs_tracer.span("engine.prefill", args={"rows": B}):
+            prepped = None
+            if self.prefix_caching and self._prefix_safe and all(p for p, _, _ in parts):
+                prepped = self._prepare_prefixed_batch(parts, budgets, decode_slots)
+                if prepped is None:
+                    self.prefix_fallbacks += 1
+                    if not self._prefix_fallback_warned:
+                        import warnings
 
-                    warnings.warn(
-                        "prefix caching disengaged for this batch (prefix "
-                        "too long for the prompt window or unbucketable) — "
-                        "falling back to full-prompt prefill; further "
-                        "fallbacks are counted in engine.prefix_fallbacks",
-                        stacklevel=2,
-                    )
-                    self._prefix_fallback_warned = True
-        if prepped is not None:
-            # The assembled cache arrives ALREADY sharded onto the mesh
-            # layout (_assemble_cache's with_sharding_constraint wrapper,
-            # the same kv_cache_tree_sharding specs _init_cache_sharded
-            # uses for fresh caches).
-            tokens, valid, Ls, cache, prefix_valid, prefix_lens, P, S = prepped
-            first_logits, cache = self._prefill_possibly_chunked(
-                tokens, valid, Ls, cache,
-                prefix_valid=prefix_valid, prefix_lens=prefix_lens,
+                        warnings.warn(
+                            "prefix caching disengaged for this batch (prefix "
+                            "too long for the prompt window or unbucketable) — "
+                            "falling back to full-prompt prefill; further "
+                            "fallbacks are counted in engine.prefix_fallbacks",
+                            stacklevel=2,
+                        )
+                        self._prefix_fallback_warned = True
+            if prepped is not None:
+                # The assembled cache arrives ALREADY sharded onto the mesh
+                # layout (_assemble_cache's with_sharding_constraint wrapper,
+                # the same kv_cache_tree_sharding specs _init_cache_sharded
+                # uses for fresh caches).
+                tokens, valid, Ls, cache, prefix_valid, prefix_lens, P, S = prepped
+                first_logits, cache = self._prefill_possibly_chunked(
+                    tokens, valid, Ls, cache,
+                    prefix_valid=prefix_valid, prefix_lens=prefix_lens,
+                )
+                L = P + Ls
+                valid_mask = np.zeros((B, S), dtype=bool)
+                valid_mask[:, :P] = prefix_valid
+                valid_mask[:, P:L] = valid
+                prompt_lens = (prefix_lens + valid.sum(axis=1)).astype(np.int32)
+            else:
+                full_prompts = [p + c + t for p, c, t in parts]
+                tokens, valid, L = self._prepare_batch(full_prompts, budgets)
+                S = L + decode_slots
+                S += (-S) % self._kv_align  # see _kv_align
+                cache = self._init_cache_sharded(B, S)
+                first_logits, cache = self._prefill_possibly_chunked(
+                    tokens, valid, L, cache
+                )
+                valid_mask = np.zeros((B, S), dtype=bool)
+                valid_mask[:, :L] = valid
+                prompt_lens = valid.sum(axis=1).astype(np.int32)
+            # Compile/retrace accounting: the prefill jit signature is
+            # (path kind, B, token window, cache length) — the shape
+            # tuple that decides whether jax.jit re-traces.
+            self._note_jit_shape(
+                "prefill",
+                (("suffix", B, Ls, P, S) if prepped is not None
+                 else ("full", B, L, S)),
             )
-            L = P + Ls
-            valid_mask = np.zeros((B, S), dtype=bool)
-            valid_mask[:, :P] = prefix_valid
-            valid_mask[:, P:L] = valid
-            prompt_lens = (prefix_lens + valid.sum(axis=1)).astype(np.int32)
-        else:
-            full_prompts = [p + c + t for p, c, t in parts]
-            tokens, valid, L = self._prepare_batch(full_prompts, budgets)
-            S = L + decode_slots
-            S += (-S) % self._kv_align  # see _kv_align
-            cache = self._init_cache_sharded(B, S)
-            first_logits, cache = self._prefill_possibly_chunked(
-                tokens, valid, L, cache
-            )
-            valid_mask = np.zeros((B, S), dtype=bool)
-            valid_mask[:, :L] = valid
-            prompt_lens = valid.sum(axis=1).astype(np.int32)
-        # Always sync here: prefill/decode wall-clock split feeds the
-        # achieved-GB/s / MFU accounting (the extra host round-trip is a
-        # few ms against multi-hundred-ms phases).
-        first_logits.block_until_ready()
+            # Always sync here: prefill/decode wall-clock split feeds the
+            # achieved-GB/s / MFU accounting (the extra host round-trip is a
+            # few ms against multi-hundred-ms phases).
+            first_logits.block_until_ready()
         t1 = time.perf_counter()
 
         self._key, sub = jax.random.split(self._key)
-        if use_ff:
-            loop = self._get_ff_decode_loop(sig_prefix + (B, L), max_new, top_p)
-            out, (_, steps), _cache_out = loop(
-                self.params, cache, first_logits,
-                self._put_batch(valid_mask),
-                self._put_batch(prompt_lens), L,
-                batch.tables, batch.accepting, batch.min_budget,
-                self._put_batch(batch.dfa_ids),
-                self._put_batch(batch.init_states),
-                batch.chain_tok, batch.chain_len, batch.chain_next,
-                self._put_batch(np.asarray(temps, np.float32)),
-                self._put_batch(np.asarray(budgets, np.int32)),
-                sub,
-            )
-        else:
-            loop = self._get_decode_loop(sig_prefix + (B, L), max_new, top_p)
-            out, (_, steps), _cache_out = loop(
-                self.params, cache, first_logits,
-                self._put_batch(valid_mask),
-                self._put_batch(prompt_lens), L,
-                batch.tables, batch.accepting, batch.min_budget,
-                self._put_batch(batch.dfa_ids),
-                self._put_batch(batch.init_states),
-                self._put_batch(np.asarray(temps, np.float32)),
-                self._put_batch(np.asarray(budgets, np.int32)),
-                sub,
-            )
-        del _cache_out  # dropped immediately; exists only for aliasing
-        out_np = np.asarray(out)
+        with obs_tracer.span("engine.decode",
+                             args={"rows": B, "max_new": max_new}):
+            if use_ff:
+                loop = self._get_ff_decode_loop(sig_prefix + (B, L), max_new, top_p)
+                out, (_, steps), _cache_out = loop(
+                    self.params, cache, first_logits,
+                    self._put_batch(valid_mask),
+                    self._put_batch(prompt_lens), L,
+                    batch.tables, batch.accepting, batch.min_budget,
+                    self._put_batch(batch.dfa_ids),
+                    self._put_batch(batch.init_states),
+                    batch.chain_tok, batch.chain_len, batch.chain_next,
+                    self._put_batch(np.asarray(temps, np.float32)),
+                    self._put_batch(np.asarray(budgets, np.int32)),
+                    sub,
+                )
+            else:
+                loop = self._get_decode_loop(sig_prefix + (B, L), max_new, top_p)
+                out, (_, steps), _cache_out = loop(
+                    self.params, cache, first_logits,
+                    self._put_batch(valid_mask),
+                    self._put_batch(prompt_lens), L,
+                    batch.tables, batch.accepting, batch.min_budget,
+                    self._put_batch(batch.dfa_ids),
+                    self._put_batch(batch.init_states),
+                    self._put_batch(np.asarray(temps, np.float32)),
+                    self._put_batch(np.asarray(budgets, np.int32)),
+                    sub,
+                )
+            del _cache_out  # dropped immediately; exists only for aliasing
+            out_np = np.asarray(out)
         t2 = time.perf_counter()
         if not self._first_call_recorded:
             # Boot breakdown's final phase: the first serving call pays

@@ -1,0 +1,18 @@
+"""Observability: span tracer + counter/gauge registry.
+
+``bcg_tpu.obs.tracer`` — nestable, cross-thread spans with explicit
+parent handoff, ring-buffered, exported as Chrome trace-event JSON
+(Perfetto-loadable; ``scripts/trace_report.py`` prints the latency
+table + top counters from an export).  ``bcg_tpu.obs.counters`` — the
+single process-wide counter/gauge registry (compile/retrace accounting,
+serve linger buckets) with ``snapshot()``/``delta()`` for tests and
+bench JSON.
+
+Neither module imports jax: flag-only consumers (bench.py's error
+path) stay light.  Enable tracing with ``BCG_TPU_TRACE=1``; see
+DESIGN.md "Observability" for the span taxonomy.
+"""
+
+from bcg_tpu.obs import counters, tracer  # noqa: F401
+
+__all__ = ["counters", "tracer"]

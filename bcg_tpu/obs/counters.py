@@ -1,0 +1,182 @@
+"""Process-wide counter/gauge registry.
+
+One :data:`REGISTRY` per process, holding named monotonic
+:class:`Counter`\\ s and settable :class:`Gauge`\\ s.  Layers increment
+into it directly (the serve scheduler's linger buckets, the engine's
+compile/retrace accounting); consumers read it three ways:
+
+* ``snapshot()`` — flat ``{name: value}`` dict of every counter and
+  gauge, the form ``bench.py`` attaches to its JSON (success AND error);
+* ``delta(before)`` — counter movement since an earlier ``snapshot()``,
+  the form tests assert on ("this scripted run incremented
+  ``engine.retrace.decode_loop`` by exactly 1");
+* per-instance baselines — a consumer that needs *its own* share of a
+  process-wide counter (e.g. one scheduler's linger histogram while
+  another may have run earlier in the process) records ``value(name)`` at
+  construction and subtracts it at read time.
+
+Counters are strictly monotonic (``inc`` rejects negative amounts):
+a counter that can go down is a gauge, and mixing the two breaks
+``delta()``'s "movement since" semantics.  No jax import — this module
+must stay loadable by flag-only consumers (bench.py's error path).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Union
+
+Number = Union[int, float]
+
+
+class Counter:
+    """Named monotonic counter."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value: Number = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: Number = 1) -> None:
+        if n < 0:
+            raise ValueError(
+                f"counter {self.name!r}: inc({n}) — counters are "
+                "monotonic; use a gauge for values that go down"
+            )
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> Number:
+        return self._value
+
+
+class Gauge:
+    """Named point-in-time value (last set wins)."""
+
+    __slots__ = ("name", "_value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value: Number = 0
+
+    def set(self, value: Number) -> None:
+        self._value = value
+
+    @property
+    def value(self) -> Number:
+        return self._value
+
+
+class Registry:
+    """Name -> Counter/Gauge map; create-on-first-use accessors."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            if name in self._gauges:
+                raise TypeError(f"{name!r} is registered as a gauge")
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter(name)
+            return c
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            if name in self._counters:
+                raise TypeError(f"{name!r} is registered as a counter")
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = Gauge(name)
+            return g
+
+    def inc(self, name: str, n: Number = 1) -> None:
+        self.counter(name).inc(n)
+
+    def set_gauge(self, name: str, value: Number) -> None:
+        self.gauge(name).set(value)
+
+    def value(self, name: str, default: Number = 0) -> Number:
+        """Current value of a counter or gauge; ``default`` when the
+        name was never touched (reading must not create entries — a
+        baseline capture loop over candidate names stays side-effect
+        free)."""
+        with self._lock:
+            if name in self._counters:
+                return self._counters[name].value
+            if name in self._gauges:
+                return self._gauges[name].value
+        return default
+
+    def snapshot(self) -> Dict[str, Number]:
+        """Flat ``{name: value}`` of every counter and gauge, sorted by
+        name (stable JSON diffs)."""
+        with self._lock:
+            out = {n: c.value for n, c in self._counters.items()}
+            out.update({n: g.value for n, g in self._gauges.items()})
+        return dict(sorted(out.items()))
+
+    def delta(self, before: Dict[str, Number]) -> Dict[str, Number]:
+        """COUNTER movement since ``before`` (a prior ``snapshot()``),
+        nonzero entries only.  Gauges are excluded: a gauge's change is
+        not "an amount of work done" and would pollute assertions like
+        "exactly +1 retrace"."""
+        with self._lock:
+            current = {n: c.value for n, c in self._counters.items()}
+        out = {
+            n: v - before.get(n, 0)
+            for n, v in current.items()
+            if v - before.get(n, 0) != 0
+        }
+        return dict(sorted(out.items()))
+
+    def reset(self) -> None:
+        """Drop every counter and gauge — TEST-ONLY (live consumers
+        holding baseline values would see negative deltas)."""
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+
+
+# The single process-wide registry.
+REGISTRY = Registry()
+
+
+# Module-level conveniences over REGISTRY (the call-site idiom:
+# ``obs_counters.inc("engine.retrace.decode_loop")``).
+def counter(name: str) -> Counter:
+    return REGISTRY.counter(name)
+
+
+def gauge(name: str) -> Gauge:
+    return REGISTRY.gauge(name)
+
+
+def inc(name: str, n: Number = 1) -> None:
+    REGISTRY.inc(name, n)
+
+
+def set_gauge(name: str, value: Number) -> None:
+    REGISTRY.set_gauge(name, value)
+
+
+def value(name: str, default: Number = 0) -> Number:
+    return REGISTRY.value(name, default)
+
+
+def snapshot() -> Dict[str, Number]:
+    return REGISTRY.snapshot()
+
+
+def delta(before: Dict[str, Number]) -> Dict[str, Number]:
+    return REGISTRY.delta(before)
+
+
+def reset() -> None:
+    REGISTRY.reset()

@@ -98,6 +98,25 @@ _register(
     "(tests/test_bench_cpu_stack.py).",
 )
 
+# BCG_TPU_TRACE* — span tracer / observability (bcg_tpu/obs).
+_register(
+    "BCG_TPU_TRACE", "bool", False,
+    "Enable the span tracer (bcg_tpu/obs): orchestrator/serving/engine "
+    "spans are ring-buffered and exportable as Chrome trace-event JSON "
+    "(Perfetto; scripts/trace_report.py prints the latency table).",
+)
+_register(
+    "BCG_TPU_TRACE_OUT", "str", None,
+    "Path the tracer exports its Chrome trace JSON to at process exit "
+    "(setting it implies BCG_TPU_TRACE).",
+)
+_register(
+    "BCG_TPU_TRACE_RING", "int", 65536,
+    "Span-event ring-buffer capacity; the oldest events are evicted "
+    "beyond it (the summarize() latency table is NOT subject to "
+    "eviction).",
+)
+
 # BCG_TPU_SERVE_* — continuous-batching serving subsystem (bcg_tpu/serve).
 _register(
     "BCG_TPU_SERVE", "bool", False,

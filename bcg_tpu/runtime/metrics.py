@@ -48,13 +48,27 @@ def publish_serve_stats(snapshot: Dict) -> None:
 
 
 def _device_memory():
-    """(bytes_in_use, peak_bytes_in_use) of device 0, or (None, None)
-    where the backend exposes no allocator stats (CPU)."""
+    """(bytes_in_use, peak_bytes_in_use) as the MAX across all devices,
+    or (None, None) where the backend exposes no allocator stats (CPU).
+
+    Max, not device 0: sharded boots balance most tensors but the
+    head-divisibility guards replicate some leaves unevenly, and a
+    multi-chip mesh's peak lives on whichever device carries the extra
+    share — reading only device 0 under-reported the true high-water
+    mark on exactly the boots the recorder exists to diagnose."""
     try:
         import jax
 
-        stats = jax.devices()[0].memory_stats() or {}
-        return stats.get("bytes_in_use"), stats.get("peak_bytes_in_use")
+        in_use = peak = None
+        for dev in jax.devices():
+            stats = dev.memory_stats() or {}
+            b = stats.get("bytes_in_use")
+            p = stats.get("peak_bytes_in_use")
+            if b is not None:
+                in_use = b if in_use is None else max(in_use, b)
+            if p is not None:
+                peak = p if peak is None else max(peak, p)
+        return in_use, peak
     except (ImportError, IndexError, AttributeError, NotImplementedError,
             RuntimeError):
         return None, None

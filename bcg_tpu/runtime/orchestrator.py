@@ -39,6 +39,7 @@ from bcg_tpu.comm import (
 from bcg_tpu.config import BCGConfig
 from bcg_tpu.engine.interface import InferenceEngine, create_engine
 from bcg_tpu.game import ByzantineConsensusGame
+from bcg_tpu.obs import tracer as obs_tracer
 from bcg_tpu.runtime import envflags
 from bcg_tpu.runtime.logging import RunLogger
 from bcg_tpu.runtime.metrics import build_metrics_payload, save_json_results, save_metrics_csv
@@ -463,7 +464,20 @@ class BCGSimulation:
     # ------------------------------------------------------------- round loop
 
     def run_round(self) -> None:
-        """One full consensus round (reference main.py:517-658)."""
+        """One full consensus round (reference main.py:517-658).
+
+        Traced as a ``round`` span (BCG_TPU_TRACE=1); the profiler's
+        phase blocks below open ``decide``/``broadcast``/``receive``/
+        ``vote`` child spans, so one game round reads as one nested
+        slice group in a Perfetto trace.
+        """
+        with obs_tracer.span(
+            "round",
+            args={"round": self.game.current_round, "sim": self._sim_uid},
+        ):
+            self._run_round()
+
+    def _run_round(self) -> None:
         round_num = self.game.current_round
         self.logger.log("=" * 60)
         self.logger.log(f"Round {round_num}")

@@ -3,32 +3,46 @@
 The reference has no timing instrumentation at all (SURVEY.md §5.1).  This
 collects per-phase wall time and derives the driver's headline metrics —
 rounds/sec and agent-decisions/sec — plus optional ``jax.profiler`` traces.
+
+Phase timing DELEGATES to the span tracer (:mod:`bcg_tpu.obs.tracer`):
+each ``phase()`` opens a span named after the phase, so with
+``BCG_TPU_TRACE=1`` the decide/vote/broadcast phases appear nested under
+the orchestrator's ``round`` span in the exported Chrome trace, and the
+per-phase accumulation (``phase_seconds``/``phase_counts``, which feed
+the metrics CSV) comes out of the same :class:`~bcg_tpu.obs.tracer.
+SpanAggregator` machinery instead of a private dict pair.  With tracing
+off the span degrades to a timed-only block — the profiler's numbers do
+not depend on the tracer being enabled.
 """
 
 from __future__ import annotations
 
 import contextlib
 import time
-from collections import defaultdict
 from typing import Dict, Optional
+
+from bcg_tpu.obs.tracer import SpanAggregator, span as _span
 
 
 class SimulationProfiler:
     def __init__(self):
-        self.phase_seconds: Dict[str, float] = defaultdict(float)
-        self.phase_counts: Dict[str, int] = defaultdict(int)
+        self._agg = SpanAggregator()
         self.rounds = 0
         self.decisions = 0  # LLM-made agent decisions (decide + vote calls)
         self._start = time.perf_counter()
 
     @contextlib.contextmanager
     def phase(self, name: str):
-        t0 = time.perf_counter()
-        try:
+        with _span(name, aggregate=self._agg):
             yield
-        finally:
-            self.phase_seconds[name] += time.perf_counter() - t0
-            self.phase_counts[name] += 1
+
+    @property
+    def phase_seconds(self) -> Dict[str, float]:
+        return self._agg.totals()
+
+    @property
+    def phase_counts(self) -> Dict[str, int]:
+        return self._agg.counts()
 
     def count_round(self, num_decisions: int) -> None:
         self.rounds += 1
@@ -46,8 +60,8 @@ class SimulationProfiler:
             "decisions": self.decisions,
             "rounds_per_sec": self.rounds / total if total > 0 else 0.0,
             "decisions_per_sec": self.decisions / total if total > 0 else 0.0,
-            "phase_seconds": dict(self.phase_seconds),
-            "phase_counts": dict(self.phase_counts),
+            "phase_seconds": self.phase_seconds,
+            "phase_counts": self.phase_counts,
         }
 
 

@@ -42,11 +42,19 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from bcg_tpu.obs import counters as obs_counters, tracer as obs_tracer
+from bcg_tpu.obs.tracer import SpanAggregator
 from bcg_tpu.runtime import envflags
 
 # Linger-histogram bucket upper bounds in milliseconds (last bucket is
 # open-ended).  Linger = enqueue -> dispatch-start wait per request.
+# The histogram itself lives in the process-wide counter registry
+# (bcg_tpu.obs.counters) under these names; SchedulerStats snapshots
+# its own share via construction-time baselines.
 _LINGER_BUCKETS_MS = (1, 2, 5, 10, 20, 50, 100)
+_LINGER_COUNTERS = tuple(
+    f"serve.linger_le_{b}ms" for b in _LINGER_BUCKETS_MS
+) + (f"serve.linger_gt_{_LINGER_BUCKETS_MS[-1]}ms",)
 
 
 class AdmissionRejected(RuntimeError):
@@ -67,7 +75,7 @@ class Request:
     """One engine call from one participant, completed independently."""
 
     __slots__ = ("sig", "payload", "n_rows", "temps", "budgets", "deadline",
-                 "enqueued_at", "done", "results", "error")
+                 "enqueued_at", "done", "results", "error", "span")
 
     def __init__(self, sig: Tuple, payload: List, temps: List[float],
                  budgets: List[int], deadline: Optional[float]):
@@ -81,6 +89,10 @@ class Request:
         self.done = threading.Event()
         self.results: Optional[List] = None
         self.error: Optional[BaseException] = None
+        # Submitter-side span handle (the explicit cross-thread parent
+        # for the dispatch thread's queue_wait/batch_form/device spans);
+        # None when tracing is off or the submitter ran unspanned.
+        self.span = None
 
     def fail(self, error: BaseException) -> None:
         self.error = error
@@ -92,8 +104,19 @@ class Request:
 
 
 class SchedulerStats:
-    """Counters + linger histogram; mutated only under the scheduler
-    condition, snapshotted for :mod:`bcg_tpu.runtime.metrics`."""
+    """Counters + per-stage latency; mutated only under the scheduler
+    condition, snapshotted for :mod:`bcg_tpu.runtime.metrics`.
+
+    The linger histogram lives in the PROCESS-WIDE counter registry
+    (:mod:`bcg_tpu.obs.counters`, the ``serve.linger_*`` buckets) —
+    this instance records construction-time baselines and snapshots its
+    own share as deltas, so per-scheduler numbers stay correct when
+    several schedulers run in one process (sequentially; concurrent
+    schedulers share the registry totals).  Stage latency
+    (queue_wait/admission/batch_form/device/scatter) accumulates in a
+    :class:`~bcg_tpu.obs.tracer.SpanAggregator` that the tracer spans
+    feed — one timing implementation for the trace and the snapshot.
+    """
 
     def __init__(self):
         self.submitted = 0
@@ -108,19 +131,17 @@ class SchedulerStats:
         self.engine_errors = 0
         self.backpressure_blocks = 0
         self.max_queue_rows = 0
-        self.linger_samples = 0
-        self.linger_seconds_total = 0.0
-        self.linger_hist = [0] * (len(_LINGER_BUCKETS_MS) + 1)
+        self.lat = SpanAggregator()
+        self._linger_base = [obs_counters.value(n) for n in _LINGER_COUNTERS]
 
     def record_linger(self, seconds: float) -> None:
-        self.linger_samples += 1
-        self.linger_seconds_total += seconds
+        self.lat.add("queue_wait", seconds)
         ms = seconds * 1e3
         for i, bound in enumerate(_LINGER_BUCKETS_MS):
             if ms <= bound:
-                self.linger_hist[i] += 1
+                obs_counters.inc(_LINGER_COUNTERS[i])
                 return
-        self.linger_hist[-1] += 1
+        obs_counters.inc(_LINGER_COUNTERS[-1])
 
     def snapshot(self, row_cap: Optional[int] = None,
                  queue_rows: int = 0) -> Dict[str, Any]:
@@ -128,6 +149,12 @@ class SchedulerStats:
         hist_keys = [f"<={b}ms" for b in _LINGER_BUCKETS_MS] + [
             f">{_LINGER_BUCKETS_MS[-1]}ms"
         ]
+        hist = [
+            obs_counters.value(name) - base
+            for name, base in zip(_LINGER_COUNTERS, self._linger_base)
+        ]
+        lat_table = self.lat.table()
+        queue_wait = lat_table.get("queue_wait")
         return {
             "submitted": self.submitted,
             "completed": self.completed,
@@ -152,10 +179,18 @@ class SchedulerStats:
             # requests never lingered to dispatch, so counting them
             # would understate latency exactly under overload.
             "mean_linger_ms": (
-                round(1e3 * self.linger_seconds_total / self.linger_samples, 3)
-                if self.linger_samples else None
+                queue_wait["mean_ms"] if queue_wait else None
             ),
-            "linger_hist_ms": dict(zip(hist_keys, self.linger_hist)),
+            "linger_hist_ms": dict(zip(hist_keys, hist)),
+            # Per-stage latency breakdown (count/total/mean/p50/p95 ms):
+            # queue_wait = enqueue->dispatch, admission = backpressure
+            # wait in submit, batch_form = merge assembly, device = the
+            # inner engine call (incl. device-lock wait), scatter =
+            # result distribution.
+            "latency_ms": {
+                name.split(".", 1)[-1]: row
+                for name, row in lat_table.items()
+            },
         }
 
 
@@ -239,6 +274,12 @@ class Scheduler:
         now = time.monotonic()
         deadline = now + self._deadline_s if self._deadline_s > 0 else None
         req = Request(sig, payload, temps, budgets, deadline)
+        # Cross-thread parent handoff: the dispatch thread parents its
+        # queue_wait/batch_form/device spans to the submitter's
+        # innermost open span (the serve.request span when called via
+        # submit_and_wait, or whatever phase span the game thread holds).
+        req.span = obs_tracer.current()
+        obs_counters.inc("serve.requests")
         with self._cond:
             self.stats.submitted += 1
             if self._closed:
@@ -259,31 +300,35 @@ class Scheduler:
             # blocking it unconditionally would hang the submitter
             # forever on an empty queue).
             watermark = max(self._max_queue_rows, req.n_rows)
-            while (self._queue_rows + req.n_rows > watermark
-                   and not self._closed):
-                if not blocked:
-                    blocked = True
-                    self.stats.backpressure_blocks += 1
-                timeout = None
-                if req.deadline is not None:
-                    timeout = req.deadline - time.monotonic()
-                    if timeout <= 0:
+            with obs_tracer.span("serve.admission", parent=req.span,
+                                 aggregate=self.stats.lat,
+                                 args={"rows": req.n_rows}):
+                while (self._queue_rows + req.n_rows > watermark
+                       and not self._closed):
+                    if not blocked:
+                        blocked = True
+                        self.stats.backpressure_blocks += 1
+                    timeout = None
+                    if req.deadline is not None:
+                        timeout = req.deadline - time.monotonic()
+                        if timeout <= 0:
+                            self.stats.cancelled += 1
+                            req.fail(RequestCancelled(
+                                "deadline expired waiting for queue admission"
+                            ))
+                            return req
+                    self._cond.wait(timeout if timeout is not None else 1.0)
+                    if not self._thread.is_alive() and not self._closed:
+                        # Dead-scheduler detection for admission waiters
+                        # (the submit_and_wait counterpart): a queue that
+                        # can never drain must not block a submitter
+                        # forever.
                         self.stats.cancelled += 1
-                        req.fail(RequestCancelled(
-                            "deadline expired waiting for queue admission"
+                        req.fail(SchedulerClosed(
+                            "scheduler thread died while this request "
+                            "waited for queue admission"
                         ))
                         return req
-                self._cond.wait(timeout if timeout is not None else 1.0)
-                if not self._thread.is_alive() and not self._closed:
-                    # Dead-scheduler detection for admission waiters (the
-                    # submit_and_wait counterpart): a queue that can
-                    # never drain must not block a submitter forever.
-                    self.stats.cancelled += 1
-                    req.fail(SchedulerClosed(
-                        "scheduler thread died while this request waited "
-                        "for queue admission"
-                    ))
-                    return req
             if self._closed:
                 self.stats.cancelled += 1
                 req.fail(SchedulerClosed("scheduler shut down during admission"))
@@ -299,16 +344,23 @@ class Scheduler:
 
     def submit_and_wait(self, sig: Tuple, payload: List, temps: List[float],
                         budgets: List[int]) -> List:
-        """Enqueue and block until completion; raises the request's error."""
-        req = self.submit(sig, payload, temps, budgets)
-        while not req.done.wait(timeout=5.0):
-            # Lost-wakeup / dead-scheduler safety net, not a timer: a
-            # request can wait arbitrarily long behind real traffic, but
-            # must not wait forever on a scheduler that died.
-            if not self._thread.is_alive() and not req.done.is_set():
-                raise SchedulerClosed(
-                    "scheduler thread died with this request pending"
-                )
+        """Enqueue and block until completion; raises the request's error.
+
+        The whole submit→complete lifetime is one ``serve.request`` span
+        on the CALLING thread (balanced there); the dispatch-side spans
+        reference it across the thread boundary via ``Request.span``.
+        """
+        with obs_tracer.span("serve.request",
+                             args={"rows": len(payload), "sig": str(sig)}):
+            req = self.submit(sig, payload, temps, budgets)
+            while not req.done.wait(timeout=5.0):
+                # Lost-wakeup / dead-scheduler safety net, not a timer: a
+                # request can wait arbitrarily long behind real traffic,
+                # but must not wait forever on a scheduler that died.
+                if not self._thread.is_alive() and not req.done.is_set():
+                    raise SchedulerClosed(
+                        "scheduler thread died with this request pending"
+                    )
         if req.error is not None:
             raise req.error
         return req.results  # type: ignore[return-value]
@@ -334,7 +386,16 @@ class Scheduler:
                     self.stats.oversize_dispatches += 1
                 dispatch_t = time.monotonic()
                 for r in batch:
-                    self.stats.record_linger(dispatch_t - r.enqueued_at)
+                    wait_s = dispatch_t - r.enqueued_at
+                    self.stats.record_linger(wait_s)
+                    # The wait's endpoints live on two threads (enqueue
+                    # on the submitter, dispatch here), so it exports as
+                    # one complete (X) event parented to the request's
+                    # submitter-side span.
+                    obs_tracer.complete(
+                        "serve.queue_wait", wait_s, parent=r.span,
+                        args={"rows": r.n_rows},
+                    )
             self._dispatch(batch)
             self._publish_stats()
 
@@ -403,43 +464,62 @@ class Scheduler:
         every other queued request keep going (crash-isolated completion).
         """
         sig = batch[0].sig
-        merged: List = []
-        temps: List[float] = []
-        budgets: List[int] = []
-        for r in batch:
-            merged.extend(r.payload)
-            temps.extend(r.temps)
-            budgets.extend(r.budgets)
-        # Collapse to scalars when uniform so plain engines (fake, stubs)
-        # that expect scalar settings keep working (collective.py idiom).
-        temperature = temps[0] if len(set(temps)) == 1 else temps
-        max_tokens = budgets[0] if len(set(budgets)) == 1 else budgets
-        try:
-            with self._device_lock:
-                if sig[0] == "json":
-                    # The device lock guards ONLY the engine call; it is
-                    # never held together with the queue cond nor across
-                    # game progress, so the BCG-LOCK-CALL deadlock shape
-                    # (queue state pinned during a device call) cannot
-                    # occur here.
-                    # lint: ignore[BCG-LOCK-CALL]
-                    out = self._engine.batch_generate_json(
-                        merged, temperature=temperature, max_tokens=max_tokens
-                    )
-                else:
-                    # lint: ignore[BCG-LOCK-CALL]  (same device-gate-only discipline)
-                    out = self._engine.batch_generate(
-                        merged, temperature=temperature, max_tokens=max_tokens,
-                        top_p=sig[1],
-                    )
-            pos = 0
+        # Dispatch-side spans parent to the OLDEST request in the batch
+        # (batch[0] — _form_batch_locked picks oldest-first): one
+        # lineage anchor per merged batch; per-request attribution rides
+        # the queue_wait events above.
+        anchor = batch[0].span
+        with obs_tracer.span("serve.batch_form", parent=anchor,
+                             aggregate=self.stats.lat,
+                             args={"requests": len(batch)}):
+            merged: List = []
+            temps: List[float] = []
+            budgets: List[int] = []
             for r in batch:
-                r.complete(out[pos: pos + r.n_rows])
-                pos += r.n_rows
+                merged.extend(r.payload)
+                temps.extend(r.temps)
+                budgets.extend(r.budgets)
+            # Collapse to scalars when uniform so plain engines (fake,
+            # stubs) that expect scalar settings keep working
+            # (collective.py idiom).
+            temperature = temps[0] if len(set(temps)) == 1 else temps
+            max_tokens = budgets[0] if len(set(budgets)) == 1 else budgets
+        try:
+            with obs_tracer.span("serve.device", parent=anchor,
+                                 aggregate=self.stats.lat,
+                                 args={"rows": len(merged),
+                                       "requests": len(batch)}):
+                with self._device_lock:
+                    if sig[0] == "json":
+                        # The device lock guards ONLY the engine call; it
+                        # is never held together with the queue cond nor
+                        # across game progress, so the BCG-LOCK-CALL
+                        # deadlock shape (queue state pinned during a
+                        # device call) cannot occur here.
+                        # lint: ignore[BCG-LOCK-CALL]
+                        out = self._engine.batch_generate_json(
+                            merged, temperature=temperature,
+                            max_tokens=max_tokens,
+                        )
+                    else:
+                        # lint: ignore[BCG-LOCK-CALL]  (same device-gate-only discipline)
+                        out = self._engine.batch_generate(
+                            merged, temperature=temperature,
+                            max_tokens=max_tokens, top_p=sig[1],
+                        )
+            with obs_tracer.span("serve.scatter", parent=anchor,
+                                 aggregate=self.stats.lat,
+                                 args={"requests": len(batch)}):
+                pos = 0
+                for r in batch:
+                    r.complete(out[pos: pos + r.n_rows])
+                    pos += r.n_rows
             with self._cond:
                 self.stats.completed += len(batch)
                 self.stats.dispatches += 1
                 self.stats.dispatched_rows += len(merged)
+            obs_counters.inc("serve.dispatches")
+            obs_counters.inc("serve.dispatched_rows", len(merged))
         except BaseException as e:
             for r in batch:
                 r.fail(e)
@@ -448,6 +528,9 @@ class Scheduler:
                 self.stats.engine_errors += 1
                 self.stats.dispatches += 1
                 self.stats.dispatched_rows += len(merged)
+            obs_counters.inc("serve.dispatches")
+            obs_counters.inc("serve.dispatched_rows", len(merged))
+            obs_counters.inc("serve.engine_errors")
 
     def run_exclusive(self, fn):
         """Run ``fn()`` holding the device lock — for proxy paths that

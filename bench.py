@@ -166,6 +166,29 @@ def _serve_stats_or_none():
     return _metrics.LAST_SERVE_STATS
 
 
+def _obs_payload() -> dict:
+    """Observability attachments for the bench JSON — counters always
+    (compile/retrace accounting, serve linger buckets), span summary
+    when tracing ran (BCG_TPU_TRACE).  Attached on success AND error:
+    a failed run's counters are exactly the forensics a mid-wave crash
+    otherwise loses."""
+    out = {}
+    try:
+        from bcg_tpu.obs import counters as _counters, tracer as _tracer
+
+        snap = _counters.snapshot()
+        if snap:
+            out["counters"] = snap
+        summary = _tracer.summarize()
+        if summary:
+            out["span_summary"] = summary
+    except Exception:
+        # Inside the never-rc=1 contract: observability must not be able
+        # to take the result line down with it.
+        pass
+    return out
+
+
 def _is_default_config() -> bool:
     return not any(envflags.is_set(v) for v in _CONFIG_OVERRIDE_ENVS)
 
@@ -182,6 +205,16 @@ def _error_result(exc: BaseException, retried: bool) -> dict:
                     else "; not retried (non-transient)"),
         "traceback_tail": "".join(tb)[-1000:],
     }
+    out.update(_obs_payload())
+    # Serving profile of the failed attempt: only boot phases used to
+    # survive a failed run — a mid-wave crash lost the scheduler stats
+    # the wave had already published to LAST_SERVE_STATS.
+    try:
+        serve_stats = _serve_stats_or_none()
+        if serve_stats:
+            out["serve_stats"] = serve_stats
+    except Exception:
+        pass
     # Boot-phase breakdown of the failed attempt (engine boots record
     # into runtime.metrics.LAST_BOOT_PHASES even when construction
     # dies mid-phase): a RESOURCE_EXHAUSTED error line now names the
@@ -260,8 +293,10 @@ def _teardown_live_engines() -> None:
         return
     if not limit:
         return
-    deadline = time.time() + 90
-    while time.time() < deadline:
+    # monotonic, not time.time(): this is a duration wait, and the wall
+    # clock can step under NTP (BCG-TIME-WALL).
+    deadline = time.monotonic() + 90
+    while time.monotonic() < deadline:
         try:
             used = (dev.memory_stats() or {}).get("bytes_in_use", 0)
         except Exception:
@@ -586,6 +621,7 @@ def _run_attempt(cfg, model: str, backend: str, concurrency: int,
         },
     }
     result["extra"].update(perf)
+    result["extra"].update(_obs_payload())
     return result
 
 

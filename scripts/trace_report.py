@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Latency report from an exported Chrome trace (bcg_tpu.obs.tracer).
+
+``python scripts/trace_report.py TRACE.json [--top N]``
+
+Prints a per-span-name latency table (count / total / p50 / p95, sorted
+hottest-first) rebuilt from the trace's B/E and X events, followed by
+the top counters the exporter embedded under ``otherData.counters``
+(compile/retrace accounting, serve linger buckets).  Self-contained —
+no bcg_tpu import — so a trace copied off a TPU host can be read
+anywhere; the in-process equivalent is ``tracer.summarize()``.
+
+Note one deliberate asymmetry: ``summarize()`` covers the whole run
+(its accumulator is not ring-evicted), while this report sees only the
+events that survived the ``BCG_TPU_TRACE_RING`` window.  Unbalanced
+events at the ring edge (a B whose E was evicted, or vice versa) are
+dropped and counted in the footer rather than silently merged.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+from typing import Dict, List, Tuple
+
+
+def load_trace(path: str) -> dict:
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, list):  # bare event-array form is also legal
+        return {"traceEvents": data, "otherData": {}}
+    return data
+
+
+def span_durations(events: List[dict]) -> Tuple[Dict[str, List[float]], int]:
+    """{name: [duration_us, ...]} from B/E pairs (per-thread stacks) and
+    X events; returns (durations, dropped_unbalanced)."""
+    durations: Dict[str, List[float]] = defaultdict(list)
+    stacks: Dict[int, List[dict]] = defaultdict(list)
+    dropped = 0
+    for ev in events:
+        ph = ev.get("ph")
+        if ph == "X":
+            if "dur" in ev:
+                durations[ev["name"]].append(float(ev["dur"]))
+            continue
+        if ph == "B":
+            stacks[ev.get("tid", 0)].append(ev)
+        elif ph == "E":
+            stack = stacks[ev.get("tid", 0)]
+            # Pop to the matching B (tolerate ring-evicted partners).
+            while stack and stack[-1]["name"] != ev["name"]:
+                stack.pop()
+                dropped += 1
+            if not stack:
+                dropped += 1
+                continue
+            begin = stack.pop()
+            durations[ev["name"]].append(
+                float(ev["ts"]) - float(begin["ts"])
+            )
+    dropped += sum(len(s) for s in stacks.values())  # Bs without an E
+    return durations, dropped
+
+
+def _percentile(ordered: List[float], q: float) -> float:
+    if not ordered:
+        return 0.0
+    idx = max(0, min(len(ordered) - 1, int(round(q * (len(ordered) - 1)))))
+    return ordered[idx]
+
+
+def render_report(trace: dict, top: int = 20) -> str:
+    events = trace.get("traceEvents", [])
+    durations, dropped = span_durations(
+        [e for e in events if e.get("ph") in ("B", "E", "X")]
+    )
+    lines: List[str] = []
+    rows = []
+    for name, durs in durations.items():
+        ordered = sorted(durs)
+        total = sum(durs)
+        rows.append((
+            name, len(durs), total / 1e3,
+            _percentile(ordered, 0.50) / 1e3,
+            _percentile(ordered, 0.95) / 1e3,
+        ))
+    rows.sort(key=lambda r: -r[2])
+    if rows:
+        name_w = max(len("span"), max(len(r[0]) for r in rows))
+        lines.append("== span latency (hottest first) ==")
+        lines.append(
+            f"{'span':<{name_w}}  {'count':>7}  {'total_ms':>10}  "
+            f"{'p50_ms':>9}  {'p95_ms':>9}"
+        )
+        for name, count, total, p50, p95 in rows:
+            lines.append(
+                f"{name:<{name_w}}  {count:>7}  {total:>10.3f}  "
+                f"{p50:>9.3f}  {p95:>9.3f}"
+            )
+    else:
+        lines.append("== span latency: no spans in trace ==")
+    if dropped:
+        lines.append(
+            f"(dropped {dropped} unbalanced event(s) at the ring edge)"
+        )
+    counters = (trace.get("otherData") or {}).get("counters") or {}
+    ranked = sorted(
+        counters.items(), key=lambda kv: (-kv[1], kv[0])
+    )[:max(0, top)]
+    if ranked:
+        lines.append("")
+        lines.append(f"== top counters (of {len(counters)}) ==")
+        val_w = max(len(f"{v}") for _, v in ranked)
+        for name, value in ranked:
+            lines.append(f"{value:>{val_w}}  {name}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Latency table + top counters from a bcg_tpu Chrome "
+        "trace export (BCG_TPU_TRACE_OUT / tracer.export())."
+    )
+    parser.add_argument("trace", help="path to the exported trace JSON")
+    parser.add_argument("--top", type=int, default=20,
+                        help="counters to show (default 20)")
+    args = parser.parse_args(argv)
+    try:
+        trace = load_trace(args.trace)
+    except (OSError, json.JSONDecodeError) as exc:
+        print(f"trace_report: cannot read {args.trace}: {exc}",
+              file=sys.stderr)
+        return 1
+    print(render_report(trace, top=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -47,6 +47,7 @@ RULE_FIXTURES = {
     "BCG-EXCEPT-BROAD": ("bad_except_broad.py", "good_except_broad.py"),
     "BCG-MUT-DEFAULT": ("bad_mut_default.py", "good_mut_default.py"),
     "BCG-LOCK-CALL": ("bad_lock_call.py", "good_lock_call.py"),
+    "BCG-TIME-WALL": ("bad_time_wall.py", "good_time_wall.py"),
 }
 
 
@@ -92,6 +93,7 @@ class TestRuleFixtures:
             "BCG-JIT-OUTSHARD": 2,
             "BCG-JIT-DONATE": 1,
             "BCG-LOCK-CALL": 3,
+            "BCG-TIME-WALL": 3,
         }
         for rule_id, want in expected.items():
             bad, _ = RULE_FIXTURES[rule_id]

@@ -1,0 +1,515 @@
+"""Unified observability (bcg_tpu/obs): span tracer + counter registry.
+
+Covers the ISSUE-4 acceptance surface: balanced-span invariant (every B
+has an E, nesting valid), cross-thread parent handoff, Chrome-trace
+JSON schema, counter ``delta()`` accounting over a scripted FakeEngine
+serving run, compile/retrace counters incrementing exactly once per new
+shape signature (steady-state decode: zero), and the disabled-tracer
+overhead bound against the straggler micro-benchmark scenario.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from bcg_tpu.api import run_simulation
+from bcg_tpu.engine.fake import FakeEngine
+from bcg_tpu.engine.interface import InferenceEngine
+from bcg_tpu.obs import counters as obs_counters, tracer as obs_tracer
+from bcg_tpu.obs.tracer import SpanAggregator, Tracer
+from bcg_tpu.serve.engine import ServingEngine, run_serving_simulations
+
+DECIDE = {
+    "type": "object",
+    "properties": {"value": {"type": "integer", "minimum": 0, "maximum": 50}},
+}
+
+
+@pytest.fixture
+def traced(monkeypatch):
+    monkeypatch.setenv("BCG_TPU_TRACE", "1")
+    monkeypatch.delenv("BCG_TPU_TRACE_OUT", raising=False)
+    monkeypatch.delenv("BCG_TPU_TRACE_RING", raising=False)
+    obs_tracer.reset()
+    yield obs_tracer.get_tracer()
+    obs_tracer.reset()
+
+
+@pytest.fixture
+def untraced(monkeypatch):
+    monkeypatch.delenv("BCG_TPU_TRACE", raising=False)
+    monkeypatch.delenv("BCG_TPU_TRACE_OUT", raising=False)
+    obs_tracer.reset()
+    yield
+    obs_tracer.reset()
+
+
+def validate_balance(events):
+    """Assert the balanced-span invariant — every B closed by an E at
+    its thread's stack top — and return {span_id: B-or-X event}."""
+    stacks = {}
+    spans = {}
+    for ev in events:
+        ph = ev["ph"]
+        if ph == "M":
+            continue
+        args = ev.get("args", {})
+        if ph == "B":
+            stacks.setdefault(ev["tid"], []).append(args["span_id"])
+            spans[args["span_id"]] = ev
+        elif ph == "E":
+            stack = stacks.get(ev["tid"])
+            assert stack, f"E without an open B on its thread: {ev}"
+            assert stack.pop() == args["span_id"], f"unbalanced E: {ev}"
+        elif ph == "X":
+            assert "dur" in ev, f"X event without dur: {ev}"
+            spans[args["span_id"]] = ev
+    leftovers = {tid: s for tid, s in stacks.items() if s}
+    assert not leftovers, f"B events never closed: {leftovers}"
+    return spans
+
+
+class TestTracer:
+    def test_balanced_nested_spans_and_parents(self, traced):
+        with obs_tracer.span("outer") as outer:
+            with obs_tracer.span("inner"):
+                pass
+            with pytest.raises(RuntimeError):
+                with obs_tracer.span("failing"):
+                    raise RuntimeError("boom")
+        data = traced.export()
+        spans = validate_balance(data["traceEvents"])
+        by_name = {ev["name"]: ev for ev in spans.values()}
+        assert by_name["inner"]["args"]["parent_id"] == outer.span_id
+        assert by_name["failing"]["args"]["parent_id"] == outer.span_id
+        assert "parent_id" not in by_name["outer"]["args"]
+        # The failing span still closed (its E carries the failure mark).
+        failed_ends = [
+            ev for ev in data["traceEvents"]
+            if ev["ph"] == "E" and ev.get("args", {}).get("failed")
+        ]
+        assert len(failed_ends) == 1
+
+    def test_cross_thread_parent_handoff(self, traced):
+        with obs_tracer.span("request") as handle:
+            def worker():
+                with obs_tracer.span("device", parent=handle):
+                    obs_tracer.complete("queue_wait", 0.002, parent=handle)
+
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+        spans = validate_balance(traced.export()["traceEvents"])
+        by_name = {ev["name"]: ev for ev in spans.values()}
+        req, dev, qw = (by_name[n] for n in ("request", "device", "queue_wait"))
+        assert dev["args"]["parent_id"] == req["args"]["span_id"]
+        assert qw["args"]["parent_id"] == req["args"]["span_id"]
+        assert dev["tid"] != req["tid"]  # the handoff crossed threads
+
+    def test_ring_buffer_evicts_but_summary_survives(self):
+        tracer = Tracer(ring_capacity=32)
+        for _ in range(100):
+            with tracer.span("tick"):
+                pass
+        assert len(tracer.events()) <= 32
+        assert tracer.summarize()["tick"]["count"] == 100
+
+    def test_summarize_percentiles(self):
+        tracer = Tracer()
+        for ms in range(1, 101):
+            tracer.complete("op", ms / 1e3)
+        row = tracer.summarize()["op"]
+        assert row["count"] == 100
+        assert abs(row["p50_ms"] - 50) <= 2
+        assert abs(row["p95_ms"] - 95) <= 2
+        assert row["total_ms"] == pytest.approx(5050, rel=0.01)
+
+    def test_chrome_trace_schema(self, traced, tmp_path):
+        with obs_tracer.span("alpha", args={"k": 1}):
+            obs_tracer.complete("beta", 0.001)
+        path = tmp_path / "trace.json"
+        traced.export(str(path))
+        data = json.loads(path.read_text())
+        assert isinstance(data["traceEvents"], list) and data["traceEvents"]
+        for ev in data["traceEvents"]:
+            assert ev["ph"] in ("B", "E", "X", "M")
+            assert isinstance(ev["name"], str)
+            assert "pid" in ev and "tid" in ev
+            if ev["ph"] != "M":
+                assert isinstance(ev["ts"], (int, float))
+        # Thread-name metadata present (Perfetto labels the lanes).
+        assert any(ev["ph"] == "M" for ev in data["traceEvents"])
+        # Counters ride along so one file is the full observability state.
+        assert "counters" in data["otherData"]
+
+    def test_disabled_span_is_shared_noop(self, untraced):
+        assert obs_tracer.get_tracer() is None
+        cm1 = obs_tracer.span("a")
+        cm2 = obs_tracer.span("b")
+        assert cm1 is cm2  # the shared no-op singleton — zero allocation
+        with cm1 as handle:
+            assert handle is None
+        assert obs_tracer.current() is None
+        obs_tracer.complete("c", 0.1)  # must not raise
+
+    def test_trace_out_implies_enabled_and_flush_writes(
+        self, monkeypatch, tmp_path
+    ):
+        out = tmp_path / "exported.json"
+        monkeypatch.delenv("BCG_TPU_TRACE", raising=False)
+        monkeypatch.setenv("BCG_TPU_TRACE_OUT", str(out))
+        obs_tracer.reset()
+        try:
+            assert obs_tracer.enabled()
+            with obs_tracer.span("only"):
+                pass
+            assert obs_tracer.flush() == str(out)
+            data = json.loads(out.read_text())
+            assert any(ev["name"] == "only" for ev in data["traceEvents"])
+        finally:
+            obs_tracer.reset()
+
+
+class TestCounters:
+    def test_counter_gauge_snapshot_delta(self):
+        base = obs_counters.snapshot()
+        obs_counters.inc("test_obs.widgets")
+        obs_counters.inc("test_obs.widgets", 2)
+        obs_counters.set_gauge("test_obs.depth", 7)
+        snap = obs_counters.snapshot()
+        assert snap["test_obs.widgets"] - base.get("test_obs.widgets", 0) == 3
+        assert snap["test_obs.depth"] == 7
+        d = obs_counters.delta(base)
+        assert d["test_obs.widgets"] == 3
+        assert "test_obs.depth" not in d  # gauges excluded from delta
+
+    def test_counters_are_monotonic(self):
+        with pytest.raises(ValueError):
+            obs_counters.inc("test_obs.widgets", -1)
+
+    def test_counter_gauge_name_clash_rejected(self):
+        obs_counters.inc("test_obs.clash")
+        with pytest.raises(TypeError):
+            obs_counters.gauge("test_obs.clash")
+
+    def test_value_read_does_not_create(self):
+        assert obs_counters.value("test_obs.never_touched") == 0
+        assert "test_obs.never_touched" not in obs_counters.snapshot()
+
+
+class TestServeCounters:
+    def test_delta_accounts_scripted_fake_run(self, untraced):
+        """Scripted FakeEngine run: exact request/row movement in the
+        process-wide registry (the satellite's delta() criterion)."""
+        before = obs_counters.snapshot()
+        serve = ServingEngine(FakeEngine(seed=0), linger_ms=0)
+        for i in range(3):
+            out = serve.batch_generate_json(
+                [("sys", f"Your current value: {i}", DECIDE)], 0.5, 64
+            )
+            assert len(out) == 1
+        serve.shutdown()
+        moved = obs_counters.delta(before)
+        assert moved["serve.requests"] == 3
+        assert moved["serve.dispatched_rows"] == 3
+        assert 1 <= moved["serve.dispatches"] <= 3
+        linger = sum(v for k, v in moved.items()
+                     if k.startswith("serve.linger_"))
+        assert linger == 3  # one bucketed linger sample per dispatch
+
+    def test_snapshot_latency_breakdown_and_hist_isolation(self, untraced):
+        first = ServingEngine(FakeEngine(seed=0), linger_ms=0)
+        first.batch_generate_json([("s", "u1", DECIDE)])
+        first.batch_generate_json([("s", "u2", DECIDE)])
+        snap1 = first.scheduler.snapshot()
+        first.shutdown()
+        assert sum(snap1["linger_hist_ms"].values()) == 2
+        lat = snap1["latency_ms"]
+        for stage in ("queue_wait", "admission", "batch_form", "device",
+                      "scatter"):
+            assert lat[stage]["count"] >= 1, stage
+            assert set(lat[stage]) == {
+                "count", "total_ms", "mean_ms", "p50_ms", "p95_ms"
+            }
+        assert snap1["mean_linger_ms"] == lat["queue_wait"]["mean_ms"]
+        # A second scheduler's histogram is ITS OWN share of the
+        # process-wide counters (construction-time baselines), not the
+        # accumulated process total.
+        second = ServingEngine(FakeEngine(seed=0), linger_ms=0)
+        second.batch_generate_json([("s", "u3", DECIDE)])
+        snap2 = second.scheduler.snapshot()
+        second.shutdown()
+        assert sum(snap2["linger_hist_ms"].values()) == 1
+
+
+class TestDeviceMemoryMax:
+    """Satellite: runtime.metrics._device_memory takes the MAX across
+    all devices (device-0-only under-reported multi-chip peaks)."""
+
+    class _Dev:
+        def __init__(self, stats):
+            self._stats = stats
+
+        def memory_stats(self):
+            return self._stats
+
+    def test_max_across_devices(self, monkeypatch):
+        import jax
+
+        from bcg_tpu.runtime import metrics
+
+        devs = [
+            self._Dev({"bytes_in_use": 100, "peak_bytes_in_use": 300}),
+            self._Dev({"bytes_in_use": 700, "peak_bytes_in_use": 900}),
+            self._Dev({"bytes_in_use": 50, "peak_bytes_in_use": 60}),
+        ]
+        monkeypatch.setattr(jax, "devices", lambda: devs)
+        assert metrics._device_memory() == (700, 900)
+
+    def test_statless_backend_falls_back_to_none(self, monkeypatch):
+        import jax
+
+        from bcg_tpu.runtime import metrics
+
+        monkeypatch.setattr(jax, "devices", lambda: [self._Dev(None)])
+        assert metrics._device_memory() == (None, None)
+
+
+class TestAcceptanceTrace:
+    """ISSUE-4 acceptance: a traced FakeEngine serving run exports a
+    Chrome trace with balanced, correctly-parented spans for at least
+    round, decide, queue_wait, batch_form, device, prefill/decode."""
+
+    REQUIRED = {
+        "round", "decide", "vote", "serve.request", "serve.queue_wait",
+        "serve.batch_form", "serve.device", "serve.scatter",
+        "engine.prefill", "engine.decode",
+    }
+
+    def _run_games(self):
+        def make(i):
+            def go(engine):
+                return run_simulation(
+                    n_agents=3, byzantine_count=0, max_rounds=2,
+                    backend="fake", seed=i, engine=engine,
+                )
+            return go
+
+        outs = run_serving_simulations(
+            FakeEngine(seed=0, policy="stubborn"),
+            [make(i) for i in range(2)], linger_ms=1,
+        )
+        assert all(isinstance(o, dict) for o in outs), outs
+
+    def test_traced_serving_game_trace(self, traced, tmp_path):
+        self._run_games()
+        path = tmp_path / "game.json"
+        data = traced.export(str(path))
+        events = data["traceEvents"]
+        spans = validate_balance(events)
+        names = {ev["name"] for ev in spans.values()}
+        missing = self.REQUIRED - names
+        assert not missing, f"span names missing from trace: {missing}"
+
+        by_id = spans
+        def parent_name(ev):
+            pid = ev["args"].get("parent_id")
+            return by_id[pid]["name"] if pid in by_id else None
+
+        for ev in spans.values():
+            if ev["name"] == "decide":
+                assert parent_name(ev) == "round"
+            if ev["name"] == "serve.queue_wait":
+                # Cross-thread handoff: the X event on the scheduler
+                # thread points back at the submitter's request span.
+                assert parent_name(ev) == "serve.request"
+            if ev["name"] == "serve.device":
+                assert parent_name(ev) == "serve.request"
+            if ev["name"] == "engine.prefill":
+                # FakeEngine runs inside the scheduler's device span —
+                # thread-local nesting parents it there.
+                assert parent_name(ev) == "serve.device"
+        # The request spans live on game threads, the device spans on
+        # the dispatch thread — the parent links crossed threads.
+        req_tids = {ev["tid"] for ev in spans.values()
+                    if ev["name"] == "serve.request"}
+        dev_tids = {ev["tid"] for ev in spans.values()
+                    if ev["name"] == "serve.device"}
+        assert req_tids and dev_tids and not (req_tids & dev_tids)
+        # summarize(): per-name latency table over the run.
+        table = traced.summarize()
+        assert table["round"]["count"] == 4  # 2 games x 2 rounds
+        assert {"count", "total_ms", "mean_ms", "p50_ms", "p95_ms"} == set(
+            table["round"]
+        )
+
+
+class TestProfilerDelegation:
+    def test_phases_become_spans_when_traced(self, traced):
+        from bcg_tpu.runtime.profiler import SimulationProfiler
+
+        prof = SimulationProfiler()
+        with prof.phase("decide"):
+            pass
+        names = [e[1] for e in traced.events()]
+        assert "decide" in names
+        assert prof.phase_counts["decide"] == 1
+
+    def test_phases_accumulate_untraced(self, untraced):
+        from bcg_tpu.runtime.profiler import SimulationProfiler
+
+        prof = SimulationProfiler()
+        with prof.phase("vote"):
+            time.sleep(0.005)
+        assert prof.phase_counts["vote"] == 1
+        assert prof.phase_seconds["vote"] >= 0.005
+        assert prof.summary()["phase_counts"]["vote"] == 1
+
+
+class TestRetraceCounters:
+    """Compile/retrace accounting: exactly +1 per NEW shape signature,
+    zero in steady state (the single most expensive silent regression
+    this engine has)."""
+
+    VOTE = {
+        "type": "object",
+        "properties": {
+            "decision": {"type": "string", "enum": ["stop", "continue"]}
+        },
+        "required": ["decision"],
+        "additionalProperties": False,
+    }
+
+    def test_steady_state_zero_then_new_shape_exactly_one(self):
+        from bcg_tpu.config import EngineConfig
+        from bcg_tpu.engine.jax_engine import JaxEngine
+
+        engine = JaxEngine(EngineConfig(
+            backend="jax", model_name="bcg-tpu/tiny-test", max_model_len=512,
+        ))
+        prompts = [("sys", "vote please", self.VOTE)]
+        engine.batch_generate_json(prompts, temperature=0.0, max_tokens=16)
+        after_first = obs_counters.snapshot()
+        # Steady state: identical shapes -> ZERO engine.* movement.
+        engine.batch_generate_json(prompts, temperature=0.0, max_tokens=16)
+        steady = {
+            k: v for k, v in obs_counters.delta(after_first).items()
+            if k.startswith("engine.")
+        }
+        assert steady == {}, f"steady-state decode retraced: {steady}"
+        # A new token budget is a new decode-loop signature: exactly +1
+        # compile AND +1 retrace on the matching counter.
+        before_new = obs_counters.snapshot()
+        engine.batch_generate_json(prompts, temperature=0.0, max_tokens=24)
+        moved = obs_counters.delta(before_new)
+        assert moved.get("engine.retrace.decode_loop") == 1, moved
+        assert moved.get("engine.compile.decode_loop") == 1, moved
+        # ... and once counted, the signature never counts again.
+        before_repeat = obs_counters.snapshot()
+        engine.batch_generate_json(prompts, temperature=0.0, max_tokens=24)
+        repeat = {
+            k: v for k, v in obs_counters.delta(before_repeat).items()
+            if k.startswith("engine.")
+        }
+        assert repeat == {}, repeat
+        engine.shutdown()
+
+
+class _DelayedCalls(InferenceEngine):
+    """Per-call host-side delay in front of a shared proxy (the
+    straggler micro-benchmark's workload shape, tests/test_serve.py)."""
+
+    def __init__(self, engine, delay):
+        self._engine = engine
+        self._delay = delay
+
+    def batch_generate_json(self, prompts, temperature=0.8, max_tokens=512):
+        time.sleep(self._delay)
+        return self._engine.batch_generate_json(prompts, temperature, max_tokens)
+
+    def generate_json(self, prompt, schema, temperature=0.0, max_tokens=512,
+                      system_prompt=None):
+        time.sleep(self._delay)
+        return self._engine.generate_json(
+            prompt, schema, temperature, max_tokens, system_prompt=system_prompt
+        )
+
+    def generate(self, prompt, temperature=0.0, max_tokens=256, top_p=1.0,
+                 system_prompt=None):
+        return self._engine.generate(
+            prompt, temperature, max_tokens, top_p, system_prompt=system_prompt
+        )
+
+    def batch_generate(self, prompts, temperature=0.0, max_tokens=256,
+                       top_p=1.0):
+        return self._engine.batch_generate(prompts, temperature, max_tokens,
+                                           top_p)
+
+    def shutdown(self):
+        pass
+
+
+class TestDisabledOverhead:
+    """ISSUE-4 acceptance: BCG_TPU_TRACE=0 adds <5% wall-clock to the
+    straggler micro-benchmark scenario.
+
+    Measured as (spans the scenario emits) x (per-call cost of a
+    disabled span), against the scenario's disabled wall-clock — the
+    instrumentation is compiled in either way, so the disabled cost IS
+    the number of no-op span entries times their unit cost."""
+
+    FAST = 0.005
+    GAMES, ROUNDS = 8, 2
+
+    def _run_scenario(self):
+        def make(i):
+            delay = self.FAST * 10 if i == 0 else self.FAST
+
+            def go(engine):
+                return run_simulation(
+                    n_agents=4, byzantine_count=0, max_rounds=self.ROUNDS,
+                    backend="fake", seed=i,
+                    engine=_DelayedCalls(engine, delay),
+                )
+            return go
+
+        t0 = time.perf_counter()
+        outs = run_serving_simulations(
+            FakeEngine(seed=0, policy="stubborn"),
+            [make(i) for i in range(self.GAMES)],
+            max_concurrent=4, linger_ms=1,
+        )
+        assert all(isinstance(o, dict) for o in outs)
+        return time.perf_counter() - t0
+
+    def test_disabled_overhead_bound(self, untraced, monkeypatch):
+        # Unit cost of the disabled fast path.
+        probes = 20_000
+        t0 = time.perf_counter()
+        for _ in range(probes):
+            with obs_tracer.span("probe"):
+                pass
+        per_span = (time.perf_counter() - t0) / probes
+
+        # Scenario wall-clock with the tracer disabled (the shipped
+        # default path).
+        wall = self._run_scenario()
+
+        # Span volume of the SAME scenario, counted by running it traced.
+        monkeypatch.setenv("BCG_TPU_TRACE", "1")
+        obs_tracer.reset()
+        try:
+            self._run_scenario()
+            events = obs_tracer.get_tracer().events()
+            span_calls = sum(1 for e in events if e[0] in ("B", "X"))
+        finally:
+            obs_tracer.reset()
+
+        overhead = span_calls * per_span
+        assert overhead < 0.05 * wall, (
+            f"disabled tracer overhead {overhead * 1e3:.2f}ms is not <5% of "
+            f"the {wall * 1e3:.0f}ms straggler scenario "
+            f"({span_calls} spans x {per_span * 1e9:.0f}ns)"
+        )
