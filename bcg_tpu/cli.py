@@ -75,6 +75,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "needed for 8B-class compiles)")
     p.add_argument("--fast-forward", action="store_true",
                    help="Forced-chain fast-forward decoding (skeleton tokens ride the sampled token's weight pass)")
+    p.add_argument("--spec-decode", action="store_true",
+                   help="Prompt-lookup speculative decoding: n-gram drafts from the "
+                        "row's own history verified K+1 tokens per weight pass "
+                        "(token-identical at temperature 0; supersedes --fast-forward)")
     p.add_argument("--compact-json", action="store_true",
                    help="Compact-JSON generation grammar (no inter-token whitespace)")
     p.add_argument("--shared-core-votes", action="store_true",
@@ -152,6 +156,8 @@ def config_from_args(args) -> BCGConfig:
         engine = dataclasses.replace(engine, fine_suffix_buckets=True)
     if args.fast_forward:
         engine = dataclasses.replace(engine, decode_fast_forward=True)
+    if args.spec_decode:
+        engine = dataclasses.replace(engine, spec_decode=True)
     if args.compact_json:
         engine = dataclasses.replace(engine, guided_compact_json=True)
     if args.fault_rate is not None:

@@ -124,6 +124,19 @@ class EngineConfig:
     # (compacted writes); composes with kv_cache_dtype="int8" via the
     # Pallas chunk decode kernel.
     decode_fast_forward: bool = False
+    # Prompt-lookup speculative decoding (engine/speculative.py): each
+    # iteration drafts up to spec_k continuation tokens by n-gram lookup
+    # against the row's own token history (prompt + output so far), with
+    # the DFA's forced chains as the always-accepted fallback, and
+    # verifies the whole draft in one K+1-position forward pass.
+    # Token-identical to the plain loop at temperature 0; standard
+    # rejection sampling (distribution-preserving) above it.  Takes
+    # precedence over decode_fast_forward when both are set (its drafter
+    # subsumes forced chains).  Env overrides: BCG_TPU_SPEC /
+    # BCG_TPU_SPEC_K / BCG_TPU_SPEC_NGRAM.
+    spec_decode: bool = False
+    spec_k: int = 4
+    spec_ngram: int = 3
     # Compact-JSON generation grammar: no inter-token whitespace (fewer
     # decoded tokens, longer forced chains).  Output is still valid JSON;
     # off by default for byte-compatibility with the reference's

@@ -49,7 +49,7 @@ from collections import Counter
 from typing import Any, Dict, List, Tuple
 
 from bcg_tpu.engine.interface import InferenceEngine
-from bcg_tpu.obs import tracer as obs_tracer
+from bcg_tpu.obs import counters as obs_counters, tracer as obs_tracer
 
 # Matches per-agent proposal lines in round summaries ("agent_3 value: 17"),
 # not the agent's own "Your current value: N" line.
@@ -158,7 +158,49 @@ class FakeEngine(InferenceEngine):
                     )
                 else:
                     out.append(self._respond(system_prompt, user_prompt, schema))
+        self._mirror_speculation(rows, out)
         return out
+
+    def _mirror_speculation(self, rows, results) -> None:
+        """Hermetic mirror of the JaxEngine speculative-decoding
+        control flow (BCG_TPU_SPEC): run the REAL prompt-lookup
+        reference drafter (engine/speculative.py, the same oracle the
+        device drafter is conformance-tested against) over
+        character-level tokens of prompt + response, accepting exactly
+        the draft prefixes that agree with the actual response — so
+        hermetic traces and serving stats carry structurally realistic
+        ``engine.spec.*`` counters and the ``engine.spec_verify`` span
+        without a device."""
+        from bcg_tpu.runtime.envflags import get_bool, get_int
+
+        if not get_bool("BCG_TPU_SPEC"):
+            return
+        import json as _json
+
+        from bcg_tpu.engine.speculative import spec_mirror_np
+
+        n = get_int("BCG_TPU_SPEC_NGRAM")
+        k = get_int("BCG_TPU_SPEC_K")
+        with obs_tracer.span(
+            "engine.spec_verify", args={"rows": len(rows), "k": k, "ngram": n}
+        ):
+            drafted = accepted = 0
+            for (system_prompt, user_prompt, _), result in zip(rows, results):
+                # The reference drafter is an O(history x output) pure-
+                # Python oracle; cap the scanned history so a long-prompt
+                # hermetic run stays milliseconds per row (echoes worth
+                # drafting are recent anyway).
+                d, a, _iters = spec_mirror_np(
+                    list((system_prompt + user_prompt).encode()[-4096:]),
+                    list(_json.dumps(result).encode()),
+                    n, k,
+                )
+                drafted += d
+                accepted += a
+        if drafted:
+            obs_counters.inc("engine.spec.drafted", drafted)
+            obs_counters.inc("engine.spec.accepted", accepted)
+            obs_counters.inc("engine.spec.rejected", drafted - accepted)
 
     # ---------------------------------------------------------------- policy
 

@@ -35,6 +35,11 @@ from bcg_tpu.engine.chat_template import (
     prefix_split_safe,
 )
 from bcg_tpu.engine.interface import InferenceEngine, per_row_settings as _per_row
+from bcg_tpu.engine.speculative import (
+    build_spec_loop,
+    make_masked_sampler as _make_masked_sampler_impl,
+    spec_decode_slots as _spec_decode_slots,
+)
 from bcg_tpu.engine.tokenizer import Tokenizer, tokenizer_for_model
 from bcg_tpu.guided.processor import GuidedBatch, compile_schema
 from bcg_tpu.config import env_flag
@@ -370,13 +375,41 @@ class JaxEngine(InferenceEngine):
         # ops/decode_attention.py chunk_decode_attention); off-TPU the
         # fallback dequantizes the whole cache per step — correct, slow.
         self.fast_forward = bool(getattr(config, "decode_fast_forward", False))
-        if config.quantization == "int8" and not self.fast_forward:
+        # Prompt-lookup speculative decoding (engine/speculative.py):
+        # n-gram drafts against the row's own token history, DFA-walked
+        # at draft time and verified in one K+1-position forward pass.
+        # Supersedes forced-chain fast-forward when both are configured
+        # (the drafter subsumes forced chains as its fallback source).
+        # Env flags override the config fields so bench/sweep A/Bs need
+        # no code change.
+        from bcg_tpu.runtime.envflags import get_int as _get_int, is_set as _is_set
+
+        self.spec_decode = (
+            bool(getattr(config, "spec_decode", False))
+            or env_flag("BCG_TPU_SPEC")
+        )
+        self.spec_k = (
+            _get_int("BCG_TPU_SPEC_K") if _is_set("BCG_TPU_SPEC_K")
+            else int(getattr(config, "spec_k", 4))
+        )
+        self.spec_ngram = (
+            _get_int("BCG_TPU_SPEC_NGRAM") if _is_set("BCG_TPU_SPEC_NGRAM")
+            else int(getattr(config, "spec_ngram", 3))
+        )
+        if self.spec_decode and (self.spec_k < 1 or self.spec_ngram < 1):
+            raise ValueError(
+                f"spec_k={self.spec_k} / spec_ngram={self.spec_ngram}: "
+                "speculative decoding needs both >= 1"
+            )
+        if (config.quantization == "int8" and not self.fast_forward
+                and not self.spec_decode):
             import warnings
 
             # Measured on v5e (BENCH_NOTES.md): W8A8 loses to bf16 in the
             # single-token decode loop (2.27 vs 3.00 dec/s) and only wins
-            # under fast-forward's [B*K, D] chunk shapes.  Configuring the
-            # losing pairing should not be silent.
+            # under the [B*K, D] chunk shapes of fast-forward (and of the
+            # speculative verify pass).  Configuring the losing pairing
+            # should not be silent.
             warnings.warn(
                 "quantization='int8' without decode_fast_forward: int8 "
                 "weights are SLOWER than bfloat16 in the single-token "
@@ -896,7 +929,13 @@ class JaxEngine(InferenceEngine):
         # their (padded) positions must count toward prefill_tokens or
         # miss-heavy windows understate MFU (advisor round-2).
         self.prefill_tokens += Pb
-        entry = {"kv": kv, "valid": valid[0], "len": len(toks), "bucket": Pb}
+        # "toks" rides along for the speculative drafter's history
+        # buffer (prompt-lookup matches against the FULL prompt, and the
+        # prefix tokens are otherwise only present as cached KV).
+        entry = {
+            "kv": kv, "valid": valid[0], "len": len(toks), "bucket": Pb,
+            "toks": np.asarray(toks, dtype=np.int32),
+        }
         # Size-aware LRU.  System prompts embed the agent id ("You are
         # agent_3 ..."), so a 10-agent run holds ~20 DISTINCT prefixes
         # (per agent x per phase) — a small fixed cap would thrash and
@@ -1102,6 +1141,9 @@ class JaxEngine(InferenceEngine):
             "valid": np.concatenate([pv[0], cvalid[0]]),
             "len": e1["len"] + len(core_toks),
             "bucket": Pb,
+            "toks": np.concatenate(
+                [e1["toks"], np.asarray(core_toks, dtype=np.int32)]
+            ),
         }
         entry_bytes = sum(getattr(a, "nbytes", 0) for a in jax.tree.leaves(kv))
         self._prefix_bytes += entry_bytes
@@ -1258,73 +1300,25 @@ class JaxEngine(InferenceEngine):
 
         prefix_valid = np.zeros((B, P), dtype=bool)
         prefix_lens = np.zeros((B,), dtype=np.int32)
+        prefix_toks = []
         for i, (p, c, _) in enumerate(rows):
             e = entries[(p, c)]
             prefix_valid[i, : e["bucket"]] = e["valid"]
             prefix_lens[i] = e["len"]
-        return tokens, valid, Ls, cache, prefix_valid, prefix_lens, P, P + tail
+            prefix_toks.append(e["toks"])
+        return (tokens, valid, Ls, cache, prefix_valid, prefix_lens,
+                prefix_toks, P, P + tail)
 
     # ------------------------------------------------------------ decode loop
 
     @staticmethod
     def _make_masked_sampler(eos_id: int, top_p: float):
-        """The guided sampler shared VERBATIM by the standard and
-        fast-forward decode loops (the greedy-equivalence guarantee
-        between them depends on a single implementation).
-
-        Guaranteed parse: a token is only allowed if the state it leads
-        to can still reach acceptance within the remaining budget
-        (min_budget precomputed per (state, token) in GuidedBatch), so
-        the sampler can never truncate into invalid JSON — e.g. with 7
-        tokens left it cannot open a minLength-10 string, and at the
-        exact boundary only shortest-completion tokens survive the mask.
-        vLLM has no equivalent: its guided output just cuts off at
-        max_tokens and fails to parse, which is what the reference's
-        3-attempt retry ladder (bcg_agents.py:708-759) exists to absorb.
-        min_budget also encodes "forbidden" (sentinel), so this one
-        gather is the entire mask.
-        """
-        use_top_p = top_p < 1.0
-
-        def masked_sample(logits, states, rng, emitted,
-                          tables, accepting, min_budget, dfa_ids,
-                          row_temp, row_budget):
-            clamped = jnp.maximum(states, 0)
-            budget_left = row_budget - emitted           # [B], incl. this token
-            allowed = min_budget[dfa_ids, clamped] <= budget_left[:, None]
-            eos_ok = accepting[dfa_ids, clamped]
-            any_tok = allowed.any(axis=-1)
-            greedy_row = row_temp <= 0.0                 # [B]
-            safe_temp = jnp.where(greedy_row, 1.0, row_temp)[:, None]
-            scaled = logits / safe_temp
-            lg = jnp.where(allowed, scaled, -jnp.inf)
-            # EOS is legal exactly at accepting states (same temperature
-            # scaling as every other token).
-            lg = lg.at[:, eos_id].set(
-                jnp.where(eos_ok, scaled[:, eos_id], -jnp.inf)
-            )
-            if use_top_p:
-                # Nucleus filter: keep the smallest prefix of the sorted
-                # distribution whose mass reaches top_p.
-                probs = jax.nn.softmax(lg, axis=-1)
-                sorted_probs = jnp.sort(probs, axis=-1)[:, ::-1]
-                cum = jnp.cumsum(sorted_probs, axis=-1)
-                cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
-                cutoff = jnp.take_along_axis(sorted_probs, cutoff_idx, axis=-1)
-                lg = jnp.where(probs >= cutoff, lg, -jnp.inf)
-            rng, sub = jax.random.split(rng)
-            tok = jnp.where(
-                greedy_row,
-                jnp.argmax(lg, axis=-1),
-                jax.random.categorical(sub, lg, axis=-1),
-            )
-            # Dead end (no token allowed): force EOS.
-            tok = jnp.where(~any_tok, eos_id, tok)
-            next_states = tables[dfa_ids, clamped, tok].astype(jnp.int32)
-            next_states = jnp.where(tok == eos_id, -1, next_states)
-            return tok.astype(jnp.int32), next_states, rng
-
-        return masked_sample
+        """The guided sampler shared VERBATIM by the standard,
+        fast-forward, AND speculative decode loops (the equivalence
+        guarantees between them depend on a single implementation — it
+        lives in :mod:`bcg_tpu.engine.speculative`, whose verify pass
+        also reuses its filter stage)."""
+        return _make_masked_sampler_impl(eos_id, top_p)
 
     def _note_jit_shape(self, entry: str, sig: Tuple) -> None:
         """Count a compile (and, beyond the first signature per entry
@@ -1552,6 +1546,37 @@ class JaxEngine(InferenceEngine):
             # Returned for donation aliasing — see the standard loop.
             return out, (rng, i), cache
 
+        compiled = jax.jit(loop, static_argnames=("L",), donate_argnums=(1,))
+        self._decode_loops[key] = compiled
+        return compiled
+
+    def _get_spec_decode_loop(self, guided_sig: Tuple, max_new: int,
+                              top_p: float = 1.0):
+        """Speculative decode loop (engine/speculative.py): every
+        iteration samples ONE token, drafts up to ``spec_k`` more by
+        prompt-lookup (n-gram match against the row's token history,
+        forced chains as fallback), and verifies the whole draft in one
+        K+1-position forward pass with PER-ROW compacted cache writes.
+        Greedy outputs are token-identical to the standard loop; the
+        win is weight-streaming passes ~ verify passes, not tokens.
+        Per-row acceptance counts live in the while-loop CARRY, never in
+        a shape — steady-state speculative decode is retrace-free."""
+        chunk_impl = (
+            "pallas"
+            if self.kv_quantized and self.decode_attention_impl == "pallas"
+            else "xla"
+        )
+        ring = (self.mesh, "sp") if self._sp_devices > 1 else None
+        key = ("spec", guided_sig, int(max_new), float(top_p),
+               self.spec_k, self.spec_ngram, chunk_impl)
+        if key in self._decode_loops:
+            return self._decode_loops[key]
+        self._note_jit_shape("spec_decode_loop", key)
+        self._decode_ring_active = ring is not None
+        loop = build_spec_loop(
+            self.spec, chunk_impl, ring, self.tokenizer.eos_id, top_p,
+            int(max_new), self.spec_k, self.spec_ngram,
+        )
         compiled = jax.jit(loop, static_argnames=("L",), donate_argnums=(1,))
         self._decode_loops[key] = compiled
         return compiled
@@ -1818,15 +1843,24 @@ class JaxEngine(InferenceEngine):
                 )
             else:
                 self.dp_batches += 1
-        # Fast-forward only pays off when the automaton HAS forced chains;
-        # the free path's permissive automaton has none, so it would buy
-        # 4x decode cache and padded chunks for zero skipped steps.
-        use_ff = self.fast_forward and sig_prefix[0] != "free"
-        self._check_kv_budget(B, budgets, fast_forward=use_ff)
-        if use_ff:
+        # Speculative decoding applies to BOTH paths (the free path's
+        # permissive automaton just never truncates a draft); it
+        # supersedes fast-forward, whose forced chains the drafter
+        # subsumes as its fallback source.  Fast-forward alone only pays
+        # off when the automaton HAS forced chains; the free path's
+        # permissive automaton has none, so it would buy 4x decode cache
+        # and padded chunks for zero skipped steps.
+        use_spec = self.spec_decode
+        use_ff = (
+            not use_spec and self.fast_forward and sig_prefix[0] != "free"
+        )
+        if use_spec:
+            decode_slots = _spec_decode_slots(max_new, self.spec_k)
+        elif use_ff:
             decode_slots = _ff_decode_slots(max_new)
         else:
             decode_slots = max_new + 1
+        self._check_kv_budget(B, budgets, decode_slots)
         t0 = time.perf_counter()
         with obs_tracer.span("engine.prefill", args={"rows": B}):
             prepped = None
@@ -1850,7 +1884,8 @@ class JaxEngine(InferenceEngine):
                 # layout (_assemble_cache's with_sharding_constraint wrapper,
                 # the same kv_cache_tree_sharding specs _init_cache_sharded
                 # uses for fresh caches).
-                tokens, valid, Ls, cache, prefix_valid, prefix_lens, P, S = prepped
+                (tokens, valid, Ls, cache, prefix_valid, prefix_lens,
+                 prefix_toks, P, S) = prepped
                 first_logits, cache = self._prefill_possibly_chunked(
                     tokens, valid, Ls, cache,
                     prefix_valid=prefix_valid, prefix_lens=prefix_lens,
@@ -1861,6 +1896,7 @@ class JaxEngine(InferenceEngine):
                 valid_mask[:, P:L] = valid
                 prompt_lens = (prefix_lens + valid.sum(axis=1)).astype(np.int32)
             else:
+                prefix_toks = None
                 full_prompts = [p + c + t for p, c, t in parts]
                 tokens, valid, L = self._prepare_batch(full_prompts, budgets)
                 S = L + decode_slots
@@ -1872,6 +1908,21 @@ class JaxEngine(InferenceEngine):
                 valid_mask = np.zeros((B, S), dtype=bool)
                 valid_mask[:, :L] = valid
                 prompt_lens = valid.sum(axis=1).astype(np.int32)
+            hist = None
+            if use_spec:
+                # Token-history buffer for the prompt-lookup drafter:
+                # row i's prompt tokens left-aligned at [0, prompt_lens[i])
+                # (-1 pads never match), with max_new free slots for the
+                # loop to append accepted output into.  On the
+                # prefix-cached path the prefix/core tokens come from the
+                # cache entries ("toks") — the batch arrays only carry
+                # the suffix.
+                hist = np.full((B, L + max_new), -1, dtype=np.int32)
+                for i in range(B):
+                    row = tokens[i][valid[i]]
+                    if prefix_toks is not None:
+                        row = np.concatenate([prefix_toks[i], row])
+                    hist[i, : len(row)] = row
             # Compile/retrace accounting: the prefill jit signature is
             # (path kind, B, token window, cache length) — the shape
             # tuple that decides whether jax.jit re-traces.
@@ -1887,9 +1938,32 @@ class JaxEngine(InferenceEngine):
         t1 = time.perf_counter()
 
         self._key, sub = jax.random.split(self._key)
+        drafted = accepted = None
         with obs_tracer.span("engine.decode",
                              args={"rows": B, "max_new": max_new}):
-            if use_ff:
+            if use_spec:
+                loop = self._get_spec_decode_loop(
+                    sig_prefix + (B, L), max_new, top_p
+                )
+                with obs_tracer.span(
+                    "engine.spec_verify",
+                    args={"rows": B, "k": self.spec_k,
+                          "ngram": self.spec_ngram},
+                ):
+                    out, (_, steps), (drafted, accepted), _cache_out = loop(
+                        self.params, cache, first_logits,
+                        self._put_batch(valid_mask),
+                        self._put_batch(prompt_lens), L,
+                        batch.tables, batch.accepting, batch.min_budget,
+                        self._put_batch(batch.dfa_ids),
+                        self._put_batch(batch.init_states),
+                        batch.chain_tok, batch.chain_len,
+                        self._put_batch(hist),
+                        self._put_batch(np.asarray(temps, np.float32)),
+                        self._put_batch(np.asarray(budgets, np.int32)),
+                        sub,
+                    )
+            elif use_ff:
                 loop = self._get_ff_decode_loop(sig_prefix + (B, L), max_new, top_p)
                 out, (_, steps), _cache_out = loop(
                     self.params, cache, first_logits,
@@ -1929,6 +2003,20 @@ class JaxEngine(InferenceEngine):
         # one weight pass — the wall-clock unit of the decode phase).
         self.last_decode_steps = int(steps)
         self.total_decode_steps += int(steps)
+        if use_spec:
+            # Draft acceptance over REAL rows only (padding rows repeat
+            # row 0 and would inflate the rate).  Counted even when 0 —
+            # but keys are only created once something drafted, so a
+            # spec-off engine's counter namespace stays byte-identical
+            # to HEAD's.
+            spec_drafted = int(np.asarray(drafted)[:real_B].sum())
+            spec_accepted = int(np.asarray(accepted)[:real_B].sum())
+            if spec_drafted:
+                obs_counters.inc("engine.spec.drafted", spec_drafted)
+                obs_counters.inc("engine.spec.accepted", spec_accepted)
+                obs_counters.inc(
+                    "engine.spec.rejected", spec_drafted - spec_accepted
+                )
         # Perf accounting.  Decode streams the whole ALLOCATED cache
         # window every step (einsum and Pallas paths both read all S
         # slots, masked), plus one full weight pass per loop iteration.
@@ -2005,6 +2093,31 @@ class JaxEngine(InferenceEngine):
             - prefix_reserve
         )
 
+    def _decode_reserve(self, max_new: int) -> int:
+        """Worst-case decode-tail cache slots for ``max_new`` output
+        tokens under the CONFIGURED loop family — speculative over-
+        allocates its K+1 verify window, fast-forward its compacted
+        chain tail.  The admission/provisioning worst case: _decode_batch
+        may still pick a smaller reserve per call (e.g. fast-forward
+        skips the free path)."""
+        if self.spec_decode:
+            return _spec_decode_slots(max_new, self.spec_k)
+        if self.fast_forward:
+            return _ff_decode_slots(max_new)
+        return max_new + 1
+
+    def worst_case_decode_window(self) -> int:
+        """Largest cache length any single admitted row can require —
+        prompt window plus decode reserve, maximized over the row's
+        token budget.  The serving scheduler's admission cap
+        (serve/scheduler.derive_row_cap) must use THIS, not
+        max_model_len: the fast-forward and speculative loops reserve
+        more decode slots than the budget they serve, so sizing
+        admission to max_model_len alone would overcommit exactly when
+        those loops are on."""
+        b = max(1, self.max_model_len - 2)
+        return (self.max_model_len - b - 1) + self._decode_reserve(b)
+
     def cap_for(self, S: int) -> Optional[int]:
         """Concurrent-row cap for decode-cache length ``S``, derived
         from the mesh axes that actually engage (ADVICE round-5 medium).
@@ -2049,9 +2162,7 @@ class JaxEngine(InferenceEngine):
         if self._mem_limit is None:
             return None
         max_new = max(budgets)
-        decode_res = (
-            _ff_decode_slots(max_new) if self.fast_forward else max_new + 1
-        )
+        decode_res = self._decode_reserve(max_new)
         limit = self.max_model_len - min(budgets) - 1
         B_pad = _aligned_pad_batch(len(parts), self._dp_devices)
         # Cheap pre-check at the WORST-CASE prompt window: if even that
@@ -2075,23 +2186,22 @@ class JaxEngine(InferenceEngine):
         return cap
 
     def _check_kv_budget(self, B: int, budgets: List[int],
-                         fast_forward: bool = False) -> None:
+                         decode_res: int) -> None:
         """hbm_utilization as an OOM guard (the reference's
         ``gpu_memory_utilization``, config.py:36): warn — once — when the
         worst-case KV cache for this batch would push past the budgeted
         fraction of device memory, naming the knobs that bound it.  B is
         the batch ACTUALLY decoded, so the engaged-axes accounting is
-        exact here: a B that skips dp alignment counts replicated."""
+        exact here: a B that skips dp alignment counts replicated.
+        ``decode_res`` is the decode-tail reservation of the loop that
+        will actually run (plain / fast-forward / speculative — the
+        caller's ``decode_slots``)."""
         if self._kv_budget_warned or self._mem_limit is None:
             return
         spec = self.spec
         # Worst case for a mixed-budget batch: a min-budget row's prompt
         # window (max_model_len - min - 1) plus the batch-wide decode
-        # reservation (the compacted fast-forward tail, _ff_decode_slots).
-        if fast_forward:
-            decode_res = _ff_decode_slots(max(budgets))
-        else:
-            decode_res = max(budgets) + 1
+        # reservation.
         S = self.max_model_len - min(budgets) - 1 + decode_res
         kv_total = B * S * self._kv_slot_bytes * spec.num_layers
         per_device = (

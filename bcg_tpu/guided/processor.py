@@ -236,6 +236,29 @@ class GuidedBatch:
         nxt = self.tables[self.dfa_ids, clamped, tokens].astype(jnp.int32)
         return jnp.where(states < 0, states, nxt)
 
+    def walk(self, states, tokens):
+        """Multi-step draft validation: advance each row's DFA through a
+        [B, T] token sequence, reporting per-position GRAMMAR legality
+        (transition exists; budget feasibility is the sampler's
+        min_budget gate, applied separately by the speculative drafter).
+        An illegal or post-finish position freezes the row's state, so a
+        draft's usable prefix is ``legal.cumprod(axis=1)``.  Returns
+        (states_after [B, T] int32, legal [B, T] bool)."""
+        import jax
+        import jax.numpy as jnp
+
+        def step(st, tk):
+            clamped = jnp.maximum(st, 0)
+            nxt = self.tables[self.dfa_ids, clamped, tk].astype(jnp.int32)
+            legal = (nxt >= 0) & (st >= 0)
+            nst = jnp.where(legal, nxt, st)
+            return nst, (nst, legal)
+
+        _, (sts, legal) = jax.lax.scan(
+            step, jnp.asarray(states, dtype=jnp.int32), jnp.asarray(tokens).T
+        )
+        return sts.T, legal.T
+
     @classmethod
     def permissive(cls, batch_size: int, vocab_size: int) -> "GuidedBatch":
         """A one-state always-accepting automaton allowing every token —

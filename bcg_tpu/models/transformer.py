@@ -342,11 +342,19 @@ def attention(q, k, v, mask, scale, impl: str = "xla"):
 def _write_cache(entry: Dict, k, v, pos) -> Dict:
     """Write fresh k/v into the cache entry (quantizing if it is int8).
 
+    ``pos`` is either a scalar (one shared cache slot for the whole
+    batch — prefill chunks, the standard/fast-forward decode loops) or a
+    [B] vector of PER-ROW slots (the speculative decode loop, whose rows
+    advance by their own accepted-token counts and keep the cache fully
+    compacted — no masked gaps streamed by later steps).
+
     Quantized entries store k/v [B, Hkv, S, Dh] (S-major-of-last-two):
     int8 arrays tile as (32, 128) on the last two dims, so a kernel block
     slicing S x Dh is native — the bf16 layout's [.., S, Hkv, Dh] would
     hand Mosaic (1, 128)-row int8 blocks (measured ~70x slower decode).
     """
+    if getattr(pos, "ndim", 0) == 1:
+        return _write_cache_rows(entry, k, v, pos)
     new = dict(entry)
     if "k_scale" in entry:
         from bcg_tpu.ops.decode_attention import quantize_kv
@@ -364,6 +372,33 @@ def _write_cache(entry: Dict, k, v, pos) -> Dict:
     else:
         new["k"] = jax.lax.dynamic_update_slice(entry["k"], k.astype(entry["k"].dtype), (0, pos, 0, 0))
         new["v"] = jax.lax.dynamic_update_slice(entry["v"], v.astype(entry["v"].dtype), (0, pos, 0, 0))
+    return new
+
+
+def _write_cache_rows(entry: Dict, k, v, row_pos) -> Dict:
+    """Per-row-position variant of :func:`_write_cache`: row ``b``'s
+    [T]-token chunk lands at cache slots ``[row_pos[b], row_pos[b]+T)``
+    (a scatter instead of ``dynamic_update_slice``; indices are in
+    bounds by the caller's slot provisioning)."""
+    new = dict(entry)
+    B, T = k.shape[0], k.shape[1]
+    bidx = jnp.arange(B)[:, None]                       # [B, 1]
+    sidx = row_pos[:, None] + jnp.arange(T)[None, :]    # [B, T]
+    if "k_scale" in entry:
+        from bcg_tpu.ops.decode_attention import quantize_kv
+
+        kq, ksc = quantize_kv(k)   # kq: [B, T, Hkv, Dh]; ksc: [B, T, Hkv]
+        vq, vsc = quantize_kv(v)
+        # Storage [B, Hkv, S, Dh] / scales [B, Hkv, S]: advanced indices
+        # on axes (0, 2) move to the front, so the target region is
+        # [B, T, Hkv, Dh] / [B, T, Hkv] — already the fresh-KV layout.
+        new["k"] = entry["k"].at[bidx, :, sidx].set(kq)
+        new["v"] = entry["v"].at[bidx, :, sidx].set(vq)
+        new["k_scale"] = entry["k_scale"].at[bidx, :, sidx].set(ksc)
+        new["v_scale"] = entry["v_scale"].at[bidx, :, sidx].set(vsc)
+    else:
+        new["k"] = entry["k"].at[bidx, sidx].set(k.astype(entry["k"].dtype))
+        new["v"] = entry["v"].at[bidx, sidx].set(v.astype(entry["v"].dtype))
     return new
 
 
@@ -888,6 +923,56 @@ def decode_chunk(
     last = jnp.sum(chunk_valid.astype(jnp.int32), axis=1) - 1      # [B]
     h_last = jnp.take_along_axis(x, last[:, None, None], axis=1)   # [B, 1, D]
     logits = _logits(params, spec, h_last)[:, 0, :]
+    return logits, new_cache
+
+
+def decode_chunk_spec(
+    params: TransformerParams,
+    spec: ModelSpec,
+    tokens: jax.Array,         # [B, K1] chunk: sampled token + draft
+    chunk_valid: jax.Array,    # [B, K1] bool; position 0 always valid
+    row_write_pos: jax.Array,  # [B] int32: PER-ROW cache slot of chunk col 0
+    positions: jax.Array,      # [B, K1] RoPE positions (per-row real counts)
+    cache: Dict,
+    cache_valid: jax.Array,    # [B, S] attendable cache slots BEFORE chunk
+    impl: str = "xla",
+    ring=None,                 # static (Mesh, axis_name): sp-sharded-cache
+                               # chunk decode (sp_chunk_decode_attention)
+) -> Tuple[jax.Array, Dict]:
+    """One speculative-decoding verify step: process a [B, K1] chunk
+    (the sampled token at position 0 plus up to K1-1 drafted tokens)
+    against the cache, with PER-ROW write positions (each row's cache
+    stays fully compacted at its own accepted-token count) and logits
+    returned at EVERY chunk position — position j's logits are the
+    model's distribution for position j+1, which is what the acceptance
+    test compares each draft token against.
+
+    Differs from :func:`decode_chunk` in exactly two ways: the KV write
+    is a per-row scatter (``_write_cache`` [B]-pos form) and the LM head
+    applies to all K1 positions instead of the last valid one.  The
+    attention itself is mask-driven and shared.
+    """
+    B, K1 = tokens.shape
+    cos, sin = rope_table(positions, spec.head_dim, spec.rope_theta,
+                          spec.rope_scaling)
+
+    # Mask: chunk queries attend valid prior cache slots plus the
+    # causally-visible valid chunk prefix, scattered at per-row columns.
+    S = cache_valid.shape[1]
+    base = jnp.repeat(cache_valid[:, None, :], K1, axis=1)         # [B, K1, S]
+    causal = jnp.tril(jnp.ones((K1, K1), bool))
+    chunk_mask = causal[None] & chunk_valid[:, None, :] & chunk_valid[:, :, None]
+    bidx = jnp.arange(B)[:, None, None]
+    qidx = jnp.arange(K1)[None, :, None]
+    sidx = row_write_pos[:, None, None] + jnp.arange(K1)[None, None, :]
+    attn_mask = base.at[bidx, qidx, sidx].set(chunk_mask)
+
+    x = params["embed"][tokens]
+    x, new_cache = _run_layers(
+        params, spec, x, cos, sin, row_write_pos, cache, attn_mask, impl,
+        chunk=True, ring=ring,
+    )
+    logits = _logits(params, spec, x)                              # [B, K1, V]
     return logits, new_cache
 
 

@@ -31,6 +31,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from bcg_tpu.parallel.compat import pallas_compiler_params
+
 _NEG_INF = -1e30
 
 # S-axis block sizes the kernels stream by.  Callers that ALLOCATE the
@@ -195,7 +197,7 @@ def _quantized_attention(qg, kp, vp, ksp, vsp, mp, scale, block_s, interpret):
             pltpu.VMEM((Hkv, rows, 1), jnp.float32),
             pltpu.VMEM((Hkv, rows, Dh), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_compiler_params(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -295,7 +297,7 @@ def decode_attention(
             pltpu.VMEM((group, 1), jnp.float32),
             pltpu.VMEM((group, Dh), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -396,7 +398,7 @@ def chunk_decode_attention(
             pltpu.VMEM((K * group, 1), jnp.float32),
             pltpu.VMEM((K * group, Dh), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

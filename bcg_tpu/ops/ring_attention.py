@@ -21,14 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-
-def _pvary(x, axis_names):
-    """Mark a constant as varying over mesh axes (carry-type match for
-    loop accumulators).  jax.lax.pvary is deprecated in favor of pcast;
-    support both so the op tracks the installed JAX."""
-    if hasattr(jax.lax, "pcast"):
-        return jax.lax.pcast(x, axis_names, to="varying")
-    return jax.lax.pvary(x, axis_names)
+from bcg_tpu.parallel.compat import pvary as _pvary, shard_map
 
 
 def _block_attend(q, k, v, q_pos, k_pos, scale, causal, kv_valid=None):
@@ -247,7 +240,7 @@ def sp_chunk_decode_attention(
         kv_spec = P(dp_ax, axis_name, tp_ax, None)   # [B, S, Hkv, Dh]
         extra_in = ()
         extra_args = ()
-    f = jax.shard_map(
+    f = shard_map(
         body,
         mesh=mesh,
         in_specs=(
@@ -338,7 +331,7 @@ def ring_attention(
                           kv_valid0=rest[0] if rest else None,
                           vary_axes=vary_axes)
 
-    f = jax.shard_map(
+    f = shard_map(
         body, mesh=mesh, in_specs=in_specs, out_specs=qkv_spec,
     )
     args = (q, k, v) + ((kv_valid,) if kv_valid is not None else ())

@@ -1,0 +1,48 @@
+"""JAX API compatibility shims for the parallel/ops layers.
+
+``shard_map`` moved from ``jax.experimental.shard_map`` to the top-level
+``jax`` namespace (jax 0.5+); older installs (this container ships
+0.4.37) only have the experimental path, and newer ones deprecate it.
+Resolve ONCE here — every call site imports :func:`shard_map` from this
+module instead of touching ``jax`` directly, so the whole SPMD layer
+(game_step collectives, ring/sp attention) runs on either side of the
+move without per-site version checks.  Same story for
+:func:`pallas_compiler_params` (``pltpu.TPUCompilerParams`` →
+``pltpu.CompilerParams`` rename) and :func:`pvary`.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map  # type: ignore[no-redef]
+
+
+def pvary(x, axis_names):
+    """Mark a constant as varying over mesh axes (carry-type match for
+    shard_map loop accumulators).  ``jax.lax.pvary`` is deprecated in
+    favor of ``pcast``; installs that predate the varying-manual-axes
+    type system (jax <= 0.4.x) have neither and need no marking at all —
+    there the shim is the identity."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axis_names, to="varying")
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, axis_names)
+    return x
+
+
+def pallas_compiler_params(**kwargs):
+    """``pltpu.CompilerParams(**kwargs)`` across the rename: newer jax
+    calls it ``CompilerParams``, 0.4.x ``TPUCompilerParams`` (same
+    fields).  Imported lazily so CPU-only processes that never lower a
+    Pallas kernel keep pallas out of their import graph."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kwargs)
+
+
+__all__ = ["shard_map", "pvary", "pallas_compiler_params"]

@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from bcg_tpu.parallel.compat import shard_map
+
 
 def exchange_values(
     values: jax.Array,        # [n] int32, -1 = abstain, sharded over dp
@@ -38,7 +40,7 @@ def exchange_values(
         received = jnp.where(mask_rows & (all_vals >= 0)[None, :], all_vals[None, :], -1)
         return received
 
-    f = jax.shard_map(
+    f = shard_map(
         body,
         mesh=mesh,
         in_specs=(P(axis_name), P(axis_name, None)),
@@ -70,7 +72,7 @@ def tally_votes(
             jnp.broadcast_to(half, local_votes.shape),
         )
 
-    f = jax.shard_map(
+    f = shard_map(
         body, mesh=mesh, in_specs=(P(axis_name),),
         out_specs=(P(axis_name),) * 5,
     )
@@ -124,7 +126,7 @@ def check_consensus_spmd(
             jnp.broadcast_to(agreement, shape),
         )
 
-    f = jax.shard_map(
+    f = shard_map(
         body, mesh=mesh,
         in_specs=(P(axis_name), P(axis_name), P(axis_name)),
         out_specs=(P(axis_name),) * 3,

@@ -97,6 +97,24 @@ _register(
     "Test-suite opt-out of the ~10-minute CPU full-stack bench test "
     "(tests/test_bench_cpu_stack.py).",
 )
+_register(
+    "BCG_TPU_SPEC", "bool", False,
+    "Prompt-lookup speculative decoding (engine/speculative.py): "
+    "n-gram drafts verified in one K+1-position forward pass; "
+    "token-identical at temperature 0, rejection sampling above.  "
+    "Override for EngineConfig.spec_decode.",
+)
+_register(
+    "BCG_TPU_SPEC_K", "int", 4,
+    "Max draft tokens per speculative verify pass (EngineConfig.spec_k "
+    "override; chunk width is K+1).",
+)
+_register(
+    "BCG_TPU_SPEC_NGRAM", "int", 3,
+    "Prompt-lookup match length in tokens (EngineConfig.spec_ngram "
+    "override): drafts continue the most recent history window equal "
+    "to the last N emitted tokens.",
+)
 
 # BCG_TPU_TRACE* — span tracer / observability (bcg_tpu/obs).
 _register(
@@ -223,6 +241,12 @@ _register(
     "Run the BENCH_CONCURRENCY window through the continuous-batching "
     "ServingEngine (bcg_tpu/serve) instead of CollectiveEngine waves; "
     "scheduler stats land in the bench JSON.",
+)
+_register(
+    "BENCH_SPEC", "bool", False,
+    "Bench arm of prompt-lookup speculative decoding "
+    "(EngineConfig.spec_decode); draft acceptance lands in the bench "
+    "JSON as spec_stats.",
 )
 
 # MB_* microbench knobs (scripts/microbench_prefill.py).

@@ -55,6 +55,13 @@ _LINGER_BUCKETS_MS = (1, 2, 5, 10, 20, 50, 100)
 _LINGER_COUNTERS = tuple(
     f"serve.linger_le_{b}ms" for b in _LINGER_BUCKETS_MS
 ) + (f"serve.linger_gt_{_LINGER_BUCKETS_MS[-1]}ms",)
+# Speculative-decoding counters the inner engine publishes
+# (engine/speculative.py); snapshotted per scheduler with the same
+# construction-time-baseline idiom as the linger buckets, so
+# LAST_SERVE_STATS carries THIS scheduler's draft acceptance rate.
+_SPEC_COUNTERS = (
+    "engine.spec.drafted", "engine.spec.accepted", "engine.spec.rejected",
+)
 
 
 class AdmissionRejected(RuntimeError):
@@ -133,6 +140,7 @@ class SchedulerStats:
         self.max_queue_rows = 0
         self.lat = SpanAggregator()
         self._linger_base = [obs_counters.value(n) for n in _LINGER_COUNTERS]
+        self._spec_base = [obs_counters.value(n) for n in _SPEC_COUNTERS]
 
     def record_linger(self, seconds: float) -> None:
         self.lat.add("queue_wait", seconds)
@@ -191,6 +199,24 @@ class SchedulerStats:
                 name.split(".", 1)[-1]: row
                 for name, row in lat_table.items()
             },
+            # Speculative-decoding acceptance under THIS scheduler
+            # (None when the inner engine drafted nothing — spec off or
+            # fake backend without the mirror).
+            "spec": self._spec_snapshot(),
+        }
+
+    def _spec_snapshot(self) -> Optional[Dict[str, Any]]:
+        drafted, accepted, rejected = (
+            obs_counters.value(name) - base
+            for name, base in zip(_SPEC_COUNTERS, self._spec_base)
+        )
+        if not drafted:
+            return None
+        return {
+            "drafted": drafted,
+            "accepted": accepted,
+            "rejected": rejected,
+            "acceptance_rate": round(accepted / drafted, 4),
         }
 
 
@@ -203,7 +229,12 @@ def derive_row_cap(engine) -> Optional[int]:
     max_len = getattr(engine, "max_model_len", None)
     if cap_for is None or not max_len:
         return None
-    return cap_for(int(max_len))
+    # Engines whose decode loops over-allocate cache past the token
+    # budget (fast-forward's compacted tail, speculation's K+1 verify
+    # window) expose the true worst-case window; max_model_len is only
+    # exact for the plain loop.
+    window = getattr(engine, "worst_case_decode_window", None)
+    return cap_for(int(window()) if callable(window) else int(max_len))
 
 
 class Scheduler:

@@ -149,10 +149,11 @@ _CONFIG_OVERRIDE_ENVS = (
     "BENCH_FAST_FORWARD", "BENCH_COMPACT_JSON", "BENCH_PREFIX_CACHING",
     "BENCH_SHARED_CORE", "BENCH_PREFILL_CHUNK", "BENCH_SCAN_LAYERS",
     "BENCH_ATTENTION_IMPL", "BENCH_CONCURRENCY", "BENCH_FORCE_CPU",
-    "BENCH_SERVE",
+    "BENCH_SERVE", "BENCH_SPEC",
     "BCG_TPU_DISABLE_INT8_DECODE_KERNEL", "BCG_TPU_DISABLE_W4_KERNEL",
     "BCG_TPU_ALLOW_PADDED_GROUP_KERNEL", "BCG_TPU_FINE_SUFFIX",
     "BCG_TPU_W8A16_PREFILL",
+    "BCG_TPU_SPEC", "BCG_TPU_SPEC_K", "BCG_TPU_SPEC_NGRAM",
 )
 
 
@@ -164,6 +165,30 @@ def _serve_stats_or_none():
     from bcg_tpu.runtime import metrics as _metrics
 
     return _metrics.LAST_SERVE_STATS
+
+
+def _spec_stats_or_none():
+    """Speculative-decoding counters + acceptance rate when the window
+    drafted anything (BCG_TPU_SPEC / BENCH_SPEC); None otherwise.
+    Attached on success AND error — same idiom as serve_stats: a
+    mid-wave crash must not lose the acceptance profile the completed
+    calls already recorded."""
+    try:
+        from bcg_tpu.obs import counters as _counters
+
+        drafted = _counters.value("engine.spec.drafted")
+        if not drafted:
+            return None
+        accepted = _counters.value("engine.spec.accepted")
+        return {
+            "drafted": drafted,
+            "accepted": accepted,
+            "rejected": _counters.value("engine.spec.rejected"),
+            "acceptance_rate": round(accepted / drafted, 4),
+        }
+    except Exception:
+        # Inside the never-rc=1 contract (see _obs_payload).
+        return None
 
 
 def _obs_payload() -> dict:
@@ -215,6 +240,9 @@ def _error_result(exc: BaseException, retried: bool) -> dict:
             out["serve_stats"] = serve_stats
     except Exception:
         pass
+    spec_stats = _spec_stats_or_none()
+    if spec_stats:
+        out["spec_stats"] = spec_stats
     # Boot-phase breakdown of the failed attempt (engine boots record
     # into runtime.metrics.LAST_BOOT_PHASES even when construction
     # dies mid-phase): a RESOURCE_EXHAUSTED error line now names the
@@ -589,6 +617,7 @@ def _run_attempt(cfg, model: str, backend: str, concurrency: int,
             "quantization": cfg.engine.quantization,
             "kv_cache_dtype": cfg.engine.kv_cache_dtype,
             "fast_forward": cfg.engine.decode_fast_forward,
+            "spec_decode": cfg.engine.spec_decode,
             "compact_json": cfg.engine.guided_compact_json,
             "prefix_caching": cfg.engine.prefix_caching,
             "prefill_chunk": cfg.engine.prefill_chunk,
@@ -609,6 +638,9 @@ def _run_attempt(cfg, model: str, backend: str, concurrency: int,
             # BENCH_SERVE=1: latest serving-scheduler snapshot (queue
             # depth, batch occupancy, linger histogram, rejections).
             "serve_stats": _serve_stats_or_none(),
+            # BCG_TPU_SPEC/BENCH_SPEC: speculative-decoding draft
+            # acceptance over the whole run (engine.spec.* counters).
+            "spec_stats": _spec_stats_or_none(),
             "window_decode_steps": window_steps,
             "window_failed_row_fraction": round(failed_fraction, 4),
             "baseline_denominator_dec_per_sec": (
@@ -747,6 +779,10 @@ def main() -> None:
             # whether the flash kernel is the crasher).
             attention_impl=envflags.get_str("BENCH_ATTENTION_IMPL"),
             decode_fast_forward=_env_flag("BENCH_FAST_FORWARD", True),
+            # Prompt-lookup speculative decoding (supersedes
+            # fast-forward when on; BCG_TPU_SPEC also enables it at the
+            # engine level).
+            spec_decode=_env_flag("BENCH_SPEC", False),
             guided_compact_json=_env_flag("BENCH_COMPACT_JSON", True),
             # Off by default for the large size class: weights + KV
             # leave no room for cached prefix KV on a 16 GB chip — the

@@ -116,7 +116,24 @@ def render_report(trace: dict, top: int = 20) -> str:
         val_w = max(len(f"{v}") for _, v in ranked)
         for name, value in ranked:
             lines.append(f"{value:>{val_w}}  {name}")
+    spec_line = spec_acceptance(counters)
+    if spec_line:
+        lines.append("")
+        lines.append(spec_line)
     return "\n".join(lines)
+
+
+def spec_acceptance(counters: Dict[str, float]) -> str:
+    """One-line draft acceptance summary when the export carries
+    speculative-decoding counters (engine.spec.*); '' otherwise."""
+    drafted = counters.get("engine.spec.drafted")
+    if not drafted:
+        return ""
+    accepted = counters.get("engine.spec.accepted", 0)
+    return (
+        f"== speculative decoding: {accepted}/{drafted} draft tokens "
+        f"accepted ({100.0 * accepted / drafted:.1f}%) =="
+    )
 
 
 def main(argv=None) -> int:

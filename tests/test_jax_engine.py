@@ -663,6 +663,28 @@ class TestEngineUnderMesh:
         )
         eng.shutdown()
 
+    def test_sequence_parallel_speculative_decode(self):
+        """The speculative loop keeps the cache sp-sharded too: its
+        verify chunk goes through sp_chunk_decode_attention with
+        PER-ROW scatter writes into the sharded cache, and its greedy
+        output matches the plain loop's under the same mesh."""
+        eng = self._engine(sequence_parallel_size=2, prefix_caching=False,
+                           spec_decode=True)
+        plain = self._engine(sequence_parallel_size=2, prefix_caching=False)
+        prompts = [
+            ("You are honest.", "Pick a value.", DECISION_SCHEMA),
+            ("You vote.", "Stop or continue?", VOTE_SCHEMA),
+        ]
+        out = eng.batch_generate_json(prompts, temperature=0.0, max_tokens=96)
+        n_spec = eng.last_decode_steps
+        assert eng._decode_ring_active
+        assert eng.sp_bypasses == 0
+        ref = plain.batch_generate_json(prompts, temperature=0.0, max_tokens=96)
+        assert out == ref
+        assert n_spec < plain.last_decode_steps
+        eng.shutdown()
+        plain.shutdown()
+
     @pytest.mark.slow
     def test_long_context_serving_via_sp(self):
         """An ~8K-byte-token prompt served end-to-end under sp=4: ring

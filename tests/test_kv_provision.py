@@ -193,7 +193,7 @@ class TestCheckKvBudget:
         row = eng.spec.num_layers * eng._kv_slot_bytes * S_worst
         _set_budget(eng, 3 * row / 8)
         with pytest.warns(UserWarning, match="worst-case KV cache"):
-            eng._check_kv_budget(3, [24] * 3)
+            eng._check_kv_budget(3, [24] * 3, 24 + 1)
         assert eng._kv_budget_warned
         eng.shutdown()
 
@@ -207,7 +207,7 @@ class TestCheckKvBudget:
 
         with _w.catch_warnings():
             _w.simplefilter("error")
-            eng._check_kv_budget(8, [24] * 8)
+            eng._check_kv_budget(8, [24] * 8, 24 + 1)
         assert not eng._kv_budget_warned
         eng.shutdown()
 

@@ -416,6 +416,51 @@ class TestRetraceCounters:
         assert repeat == {}, repeat
         engine.shutdown()
 
+    def test_speculative_loop_steady_state_zero_retraces(self):
+        """BCG_TPU_SPEC=1 steady state: per-row acceptance counts vary
+        call to call (different prompts draft and accept differently)
+        but live in the while-loop CARRY, not in any shape — so after
+        the first compile, further calls must show ZERO compile/retrace
+        movement on every jit entry point."""
+        import dataclasses
+
+        from bcg_tpu.config import EngineConfig
+        from bcg_tpu.engine.jax_engine import JaxEngine
+
+        engine = JaxEngine(dataclasses.replace(
+            EngineConfig(
+                backend="jax", model_name="bcg-tpu/tiny-test",
+                max_model_len=512,
+            ),
+            spec_decode=True,
+        ))
+        # Prompts chosen to vary acceptance: no echo, heavy echo of the
+        # JSON skeleton, and a longer mixed one.
+        variants = [
+            [("sys", "vote now", self.VOTE)],
+            [("sys", 'history: {"decision": "stop"} {"decision": "stop"} '
+                     "vote again", self.VOTE)],
+            [("sys", "round 5 results were mixed; vote once more please",
+              self.VOTE)],
+        ]
+        engine.batch_generate_json(variants[0], temperature=0.0, max_tokens=32)
+        after_first = obs_counters.snapshot()
+        accepts = []
+        for prompts in variants * 2:
+            engine.batch_generate_json(prompts, temperature=0.0, max_tokens=32)
+            accepts.append(
+                obs_counters.value("engine.spec.accepted")
+            )
+        moved = {
+            k: v for k, v in obs_counters.delta(after_first).items()
+            if k.startswith("engine.compile") or k.startswith("engine.retrace")
+        }
+        assert moved == {}, f"speculative steady-state retraced: {moved}"
+        # Non-vacuous: the calls really did accept varying amounts.
+        deltas = {b - a for a, b in zip(accepts, accepts[1:])}
+        assert len(deltas) > 1, deltas
+        engine.shutdown()
+
 
 class _DelayedCalls(InferenceEngine):
     """Per-call host-side delay in front of a shared proxy (the

@@ -1,0 +1,536 @@
+"""Prompt-lookup speculative decoding (engine/speculative.py +
+JaxEngine._get_spec_decode_loop + models decode_chunk_spec).
+
+The decisive properties:
+
+* temperature 0 is TOKEN-IDENTICAL to the plain loop (guided and free
+  sigs) — drafts are verified against the same masked argmax the plain
+  loop samples from, so acceptance can never change the sequence;
+* the hermetic guided-JSON decision benchmark runs in >=30% fewer
+  device decode iterations with speculation on (obs counter deltas, not
+  wall clock — CI-assertable on CPU);
+* temperature > 0 rejection sampling preserves the masked-sampler
+  distribution (unit-level residual test + seeded end-to-end check);
+* speculation disabled (the default) compiles the same jit entry
+  points as before and creates no engine.spec.* counters.
+"""
+
+import dataclasses
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bcg_tpu.config import EngineConfig
+from bcg_tpu.engine.jax_engine import JaxEngine
+from bcg_tpu.engine.speculative import (
+    accept_draft,
+    draft_tokens,
+    make_masked_logits,
+    make_masked_sampler,
+    ngram_draft_np,
+    spec_decode_slots,
+    spec_mirror_np,
+)
+from bcg_tpu.guided.processor import GuidedBatch, compile_schema
+from bcg_tpu.obs import counters as obs_counters
+
+VOTE = {
+    "type": "object",
+    "properties": {"decision": {"type": "string", "enum": ["stop", "continue"]}},
+    "required": ["decision"],
+    "additionalProperties": False,
+}
+DECISION = {
+    "type": "object",
+    "properties": {
+        "internal_strategy": {"type": "string", "minLength": 1, "maxLength": 25},
+        "value": {"type": "integer", "minimum": 0, "maximum": 50},
+        "public_reasoning": {"type": "string", "minLength": 1, "maxLength": 25},
+    },
+    "required": ["internal_strategy", "value", "public_reasoning"],
+    "additionalProperties": False,
+}
+
+
+def _base_config(**kw):
+    return EngineConfig(
+        backend="jax", model_name="bcg-tpu/tiny-test", max_model_len=2048, **kw
+    )
+
+
+# --------------------------------------------------------------- drafter
+class TestDrafter:
+    """Traced n-gram matcher against the numpy oracle."""
+
+    V = 64
+    EOS = 63
+
+    def _draft(self, hists, toks, k=4, n=3, budget=100):
+        B = len(hists)
+        H = max(len(h) for h in hists) + 8
+        hist = np.full((B, H), -1, dtype=np.int32)
+        for i, h in enumerate(hists):
+            hist[i, : len(h)] = h
+        cur0 = np.asarray([len(h) for h in hists], np.int32)
+        batch = GuidedBatch.permissive(B, self.V)
+        draft, dmask, states_v, st_final = draft_tokens(
+            jnp.asarray(hist), jnp.asarray(cur0), jnp.asarray(toks, dtype=jnp.int32),
+            jnp.zeros(B, jnp.int32), jnp.zeros(B, bool),
+            batch.tables, batch.min_budget, batch.chain_tok, batch.chain_len,
+            batch.dfa_ids, jnp.zeros(B, jnp.int32),
+            jnp.full((B,), budget, jnp.int32),
+            k=k, n=n, eos_id=self.EOS,
+        )
+        out = []
+        for i in range(B):
+            row = np.asarray(draft[i])[np.asarray(dmask[i])]
+            out.append(row.tolist())
+        return out
+
+    def test_matches_numpy_reference_on_random_histories(self):
+        rng = np.random.default_rng(0)
+        hists, toks = [], []
+        for _ in range(16):
+            # Small alphabet forces repeats -> plenty of matches.
+            h = rng.integers(0, 6, size=rng.integers(8, 60)).tolist()
+            hists.append(h)
+            toks.append(int(rng.integers(0, 6)))
+        got = self._draft(hists, toks)
+        for h, t, g in zip(hists, toks, got):
+            ref = ngram_draft_np(h, t, 3, 4)
+            # The permissive automaton truncates only at EOS (excluded
+            # from drafting by design), which the small alphabet never
+            # produces — so the traced draft IS the oracle continuation.
+            assert g == ref, (h, t, g, ref)
+
+    def test_most_recent_match_wins(self):
+        # The gram — the last n-1 history tokens (1, 2) plus the sampled
+        # token 3 — occurs twice with different continuations: the
+        # drafter must continue the LATER occurrence.
+        h = [1, 2, 3, 7, 7, 5, 1, 2, 3, 9, 8, 4, 1, 2]
+        got = self._draft([h], [3], k=3, n=3)
+        assert got[0] == [9, 8, 4]
+
+    def test_no_match_and_short_history(self):
+        assert self._draft([[1, 2]], [5], n=3)[0] == []
+        assert self._draft([[0]], [0], n=3)[0] == []
+
+    def test_eos_never_drafted(self):
+        h = [1, 2, 3, self.EOS, 9, 9, 1, 2]
+        # Match at (1,2,3): continuation starts with EOS -> truncated
+        # immediately.
+        assert self._draft([h], [3], k=3, n=3)[0] == []
+
+    def test_budget_truncates_draft(self):
+        h = [1, 2, 3, 4, 5, 6, 7, 1, 2]
+        # budget 2: the sampled token takes 1, so only 1 draft slot is
+        # affordable (min_budget is 1 everywhere in the permissive DFA).
+        assert self._draft([h], [3], k=4, n=3, budget=2)[0] == [4]
+
+    def test_grammar_truncates_draft(self):
+        """A grammar-illegal n-gram continuation is cut AT DRAFT TIME:
+        the most recent match's continuation is garbage, so the drafter
+        must drop it and fall through to the forced chain — every
+        proposed token walks legally through the DFA
+        (GuidedBatch.walk is the oracle)."""
+        tb = [bytes([i]) for i in range(256)]
+        guide = compile_schema(VOTE, tb, vocab_id=401)
+        batch = GuidedBatch([guide])
+        td = guide.token_dfa
+        tok = ord('"')
+        base = int(td.transitions[td.transitions[td.start, ord("{")], tok])
+        assert base >= 0
+        # History: a previous LEGAL emission, then a poisoned copy whose
+        # '{"' continuation is garbage, ending just after '{' so the
+        # bigram source picks the poisoned (most recent) occurrence.
+        row = (
+            [ord(c) for c in '{"decision": "stop"}']
+            + [ord(c) for c in '{"zz']
+            + [ord("{")]
+        )
+        hist = np.full((1, 64), -1, np.int32)
+        hist[0, : len(row)] = row
+        draft, dmask, _sv, _sf = draft_tokens(
+            jnp.asarray(hist), jnp.asarray([len(row)], jnp.int32),
+            jnp.asarray([tok], jnp.int32),
+            jnp.asarray([base], jnp.int32), jnp.zeros(1, bool),
+            batch.tables, batch.min_budget, batch.chain_tok,
+            batch.chain_len, batch.dfa_ids, jnp.zeros(1, jnp.int32),
+            jnp.full((1,), 64, jnp.int32), k=4, n=2, eos_id=0,
+        )
+        n_drafted = int(np.asarray(dmask[0]).sum())
+        assert n_drafted > 0  # forced chain drafts past the dead n-gram
+        assert int(np.asarray(draft)[0, 0]) == ord("d")  # not the 'z'
+        _states, legal = batch.walk(jnp.asarray([base], jnp.int32), draft[:1])
+        assert np.asarray(legal)[0][:n_drafted].all()
+
+
+class TestGuidedBatchWalk:
+    def test_walk_matches_step_and_flags_illegal(self):
+        tb = [bytes([i]) for i in range(256)]
+        guide = compile_schema(VOTE, tb, vocab_id=402)
+        batch = GuidedBatch([guide])
+        td = guide.token_dfa
+        seq = [ord(c) for c in '{"decision"']
+        states, legal = batch.walk(
+            jnp.asarray([td.start], jnp.int32), jnp.asarray([seq], jnp.int32)
+        )
+        assert np.asarray(legal).all()
+        # Walking token-by-token through step() lands on the same state.
+        st = jnp.asarray([td.start], jnp.int32)
+        for t in seq:
+            st = batch.step(st, jnp.asarray([t], jnp.int32))
+        assert int(np.asarray(states)[0, -1]) == int(np.asarray(st)[0])
+        # An illegal token freezes the state and reports False.
+        bad = jnp.asarray([[ord("z"), ord("z")]], jnp.int32)
+        states2, legal2 = batch.walk(st, bad)
+        assert not np.asarray(legal2).any()
+        assert (np.asarray(states2) == int(np.asarray(st)[0])).all()
+
+
+# ---------------------------------------------------------- conformance
+@pytest.fixture(scope="module")
+def engine_pair():
+    jax.config.update("jax_platforms", "cpu")
+    std = JaxEngine(_base_config())
+    spec = JaxEngine(_base_config(spec_decode=True))
+    yield std, spec
+    std.shutdown()
+    spec.shutdown()
+
+
+class TestTemperatureZeroConformance:
+    def test_decision_benchmark_30pct_fewer_steps_and_identical(self, engine_pair):
+        """Acceptance criterion: the hermetic guided-JSON decision
+        benchmark emits byte-identical token sequences at temperature 0
+        while taking >=30% fewer device decode iterations (counter
+        deltas, not wall clock)."""
+        std, spec = engine_pair
+        prompts = [
+            ("honest agent system prompt", "Round 3: propose a value", DECISION),
+            ("byzantine agent system prompt", "Round 3: vote now", VOTE),
+            ("honest agent system prompt", "Round 4: propose a value", DECISION),
+        ]
+        s0_std, s0_spec = std.total_decode_steps, spec.total_decode_steps
+        r_std = std.batch_generate_json(prompts, temperature=0.0, max_tokens=80)
+        steps_std = std.total_decode_steps - s0_std
+        before = obs_counters.snapshot()
+        r_spec = spec.batch_generate_json(prompts, temperature=0.0, max_tokens=80)
+        steps_spec = spec.total_decode_steps - s0_spec
+        moved = obs_counters.delta(before)
+        assert r_spec == r_std
+        assert all("error" not in r for r in r_std)
+        assert steps_spec <= 0.7 * steps_std, (steps_spec, steps_std)
+        drafted = moved.get("engine.spec.drafted", 0)
+        accepted = moved.get("engine.spec.accepted", 0)
+        assert drafted > 0 and 0 < accepted <= drafted
+        assert moved.get("engine.spec.rejected", 0) == drafted - accepted
+
+    def test_free_sig_identical(self, engine_pair):
+        std, spec = engine_pair
+        prompts = [
+            "repeat after me: alpha beta gamma alpha beta",
+            "the quick brown fox",
+        ]
+        f_std = std.batch_generate(prompts, temperature=0.0, max_tokens=32)
+        f_spec = spec.batch_generate(prompts, temperature=0.0, max_tokens=32)
+        assert f_spec == f_std
+
+    def test_second_round_echo_improves_on_plain(self, engine_pair):
+        """A round-2 prompt embedding round-1's own output (the BCG
+        history echo) must still be token-identical — and speculation
+        must beat the plain loop on it (the n-gram source now contains
+        the literal answer).  Enum-only schema: free-string positions on
+        a random-weight model can sit on argmax near-ties where the
+        chunked verify pass and the single-token plain step reassociate
+        float reductions differently (the pre-existing fast-forward
+        chunk loop shows the same flip), which would test numerics, not
+        the acceptance logic."""
+        std, spec = engine_pair
+        r1 = spec.batch_generate_json(
+            [("sys", "Round 1: vote", VOTE)], temperature=0.0,
+            max_tokens=60,
+        )[0]
+        echo = f"Round 1 votes: agent_0 said {json.dumps(r1)}. Round 2: vote"
+        r_std = std.batch_generate_json(
+            [("sys", echo, VOTE)], temperature=0.0, max_tokens=60
+        )
+        n_std = std.last_decode_steps
+        r_spec = spec.batch_generate_json(
+            [("sys", echo, VOTE)], temperature=0.0, max_tokens=60
+        )
+        n_spec = spec.last_decode_steps
+        assert r_spec == r_std
+        assert n_spec < n_std
+
+    def test_mixed_budgets_and_padding_rows(self, engine_pair):
+        """Per-row budgets differ and the batch pads (real_B=3 -> B=4):
+        padded speculative decode must keep real rows identical."""
+        std, spec = engine_pair
+        prompts = [("s", f"user prompt {i}", VOTE) for i in range(3)]
+        r_std = std.batch_generate_json(prompts, temperature=0.0, max_tokens=[24, 48, 30])
+        r_spec = spec.batch_generate_json(prompts, temperature=0.0, max_tokens=[24, 48, 30])
+        assert r_spec == r_std
+
+
+@pytest.mark.slow
+class TestInt8KvComposes:
+    def test_int8_kv_spec_matches_int8_plain(self):
+        """Speculative decode over an int8 KV cache (off-TPU this
+        exercises the QUANTIZED per-row scatter write + full-dequant
+        chunk fallback) must match the plain int8-KV loop token for
+        token — both attend the same stored cache, so the quantization
+        error is identical."""
+        jax.config.update("jax_platforms", "cpu")
+        base = _base_config(kv_cache_dtype="int8")
+        with pytest.warns(UserWarning, match="int8"):
+            std = JaxEngine(base)
+        spec = JaxEngine(dataclasses.replace(base, spec_decode=True))
+        prompts = [
+            ("honest system", "vote on round 3", VOTE),
+            ("byzantine system", "decide round 3", DECISION),
+        ]
+        r_std = std.batch_generate_json(prompts, temperature=0.0, max_tokens=60)
+        r_spec = spec.batch_generate_json(prompts, temperature=0.0, max_tokens=60)
+        assert r_spec == r_std
+        assert all("error" not in r for r in r_std)
+        assert spec.last_decode_steps < std.last_decode_steps
+        std.shutdown()
+        spec.shutdown()
+
+
+# ------------------------------------------------- temperature > 0 paths
+class TestRejectionSampling:
+    def test_residual_preserves_distribution(self):
+        """Unit-level: 'accept draft d w.p. p(d), else resample with d
+        forbidden' must reproduce p exactly — the forbid path IS the
+        renormalized leave-one-out residual.  4-sigma band over 20k
+        trials."""
+        V, eos = 4, 3
+        logits = jnp.log(jnp.asarray([[0.45, 0.30, 0.20, 0.05]]))
+        batch = GuidedBatch.permissive(1, V)
+        ml = make_masked_logits(eos, 1.0)
+        sampler = make_masked_sampler(eos, 1.0)
+        temps = jnp.ones((1,))
+        budgets = jnp.full((1,), 100, jnp.int32)
+        states = jnp.zeros((1,), jnp.int32)
+        lg, _, _ = ml(logits, states, jnp.zeros((1,), jnp.int32),
+                      batch.tables, batch.accepting, batch.min_budget,
+                      batch.dfa_ids, temps, budgets)
+        p = np.asarray(jax.nn.softmax(lg, axis=-1))[0]
+        d = 1  # deterministic draft token
+
+        def one(key):
+            ku, ks = jax.random.split(key)
+            u = jax.random.uniform(ku)
+            tok, _, _ = sampler(
+                logits, states, ks, jnp.zeros((1,), jnp.int32),
+                batch.tables, batch.accepting, batch.min_budget,
+                batch.dfa_ids, temps, budgets,
+                forbid=jnp.asarray([d], jnp.int32),
+            )
+            return jnp.where(u < p[d], d, tok[0])
+
+        n = 20000
+        toks = np.asarray(jax.vmap(one)(jax.random.split(jax.random.PRNGKey(7), n)))
+        freq = np.bincount(toks, minlength=V) / n
+        for v in range(V):
+            sigma = np.sqrt(p[v] * (1 - p[v]) / n)
+            assert abs(freq[v] - p[v]) < 4 * sigma + 1e-9, (v, freq, p)
+
+    def test_end_to_end_distribution_close_to_plain(self, engine_pair):
+        """Seeded end-to-end check: the spec loop's vote distribution at
+        temperature 1 matches the plain loop's within binomial noise
+        (different RNG consumption, same law)."""
+        std, spec = engine_pair
+        B = 96
+        prompts = [("s", "vote on the proposal", VOTE)] * B
+
+        def stop_frac(engine):
+            out = engine.batch_generate_json(prompts, temperature=1.0,
+                                             max_tokens=24)
+            assert all("error" not in r for r in out)
+            return sum(r["decision"] == "stop" for r in out) / B
+
+        f_std, f_spec = stop_frac(std), stop_frac(spec)
+        # 4-sigma two-sample band at n=96/side, worst-case p=0.5.
+        assert abs(f_std - f_spec) < 4 * np.sqrt(2 * 0.25 / B), (f_std, f_spec)
+
+    def test_verify_pass_accepts_probable_drafts(self):
+        """accept_draft's greedy arm: a draft equal to the argmax chain
+        is fully accepted; a corrupted tail truncates acceptance."""
+        V, eos, K = 8, 7, 3
+        batch = GuidedBatch.permissive(1, V)
+        ml = make_masked_logits(eos, 1.0)
+        # logits_all[., j] puts all mass on token j+1 -> greedy chain
+        # 1, 2, 3 for draft indices 0..2 (position 0 is the sampled tok).
+        la = np.full((1, K + 1, V), -20.0, np.float32)
+        for j in range(K):
+            la[0, j, j + 1] = 10.0
+        la[0, K, 0] = 10.0
+        common = dict(
+            states_v=jnp.zeros((1, K), jnp.int32),
+            emitted=jnp.zeros((1,), jnp.int32),
+            tables=batch.tables, accepting=batch.accepting,
+            min_budget=batch.min_budget, dfa_ids=batch.dfa_ids,
+            row_temp=jnp.zeros((1,)),
+            row_budget=jnp.full((1,), 100, jnp.int32),
+            masked_logits=ml, eos_id=eos,
+        )
+        acc, forbid, nl, _ = accept_draft(
+            jnp.asarray(la), jnp.asarray([[1, 2, 3]], jnp.int32),
+            jnp.ones((1, K), bool), rng=jax.random.PRNGKey(0), **common,
+        )
+        assert int(acc[0]) == 3 and int(forbid[0]) == -1
+        assert int(np.argmax(np.asarray(nl)[0])) == 0  # bonus position
+        acc2, forbid2, nl2, _ = accept_draft(
+            jnp.asarray(la), jnp.asarray([[1, 5, 3]], jnp.int32),
+            jnp.ones((1, K), bool), rng=jax.random.PRNGKey(0), **common,
+        )
+        assert int(acc2[0]) == 1 and int(forbid2[0]) == 5
+        # Carry logits come from the last ACCEPTED position (chunk pos 1
+        # predicts draft index 1 -> argmax 2, the token the next
+        # iteration will sample).
+        assert int(np.argmax(np.asarray(nl2)[0])) == 2
+
+
+# ------------------------------------------------------ engine plumbing
+class TestDisabledDefault:
+    def test_default_engine_has_no_spec_surface(self):
+        jax.config.update("jax_platforms", "cpu")
+        eng = JaxEngine(_base_config())
+        before = obs_counters.snapshot()
+        eng.batch_generate_json([("s", "vote", VOTE)], temperature=0.0,
+                                max_tokens=16)
+        moved = obs_counters.delta(before)
+        assert not any(k.startswith("engine.spec") for k in moved), moved
+        # Same jit entry points as before this feature existed.
+        assert set(eng._jit_shapes) == {"prefill", "decode_loop"}
+        assert not any(
+            isinstance(k, tuple) and k and k[0] == "spec"
+            for k in eng._decode_loops
+        )
+        eng.shutdown()
+
+
+class TestProvisioning:
+    def test_spec_slots_cover_worst_case(self):
+        assert spec_decode_slots(100, 4) == 106
+        assert spec_decode_slots(1, 1) == 4
+
+    def test_worst_case_window_grows_with_spec(self):
+        jax.config.update("jax_platforms", "cpu")
+        plain = JaxEngine(_base_config())
+        w_plain = plain.worst_case_decode_window()
+        plain.shutdown()
+        spec = JaxEngine(_base_config(spec_decode=True, spec_k=4))
+        w_spec = spec.worst_case_decode_window()
+        spec.shutdown()
+        assert w_plain == 2048  # plain loop: exactly max_model_len
+        assert w_spec == 2048 + 4 + 1  # + K+1 verify-window overhang
+
+    def test_serve_admission_uses_worst_case_window(self):
+        from bcg_tpu.serve.scheduler import derive_row_cap
+
+        seen = {}
+
+        class _Eng:
+            max_model_len = 1000
+
+            def cap_for(self, S):
+                seen["S"] = S
+                return 7
+
+            def worst_case_decode_window(self):
+                return 1234
+
+        assert derive_row_cap(_Eng()) == 7
+        assert seen["S"] == 1234
+
+        class _Legacy:
+            max_model_len = 1000
+
+            def cap_for(self, S):
+                seen["S"] = S
+                return 3
+
+        assert derive_row_cap(_Legacy()) == 3
+        assert seen["S"] == 1000
+
+    def test_env_flags_enable_and_tune(self, monkeypatch):
+        jax.config.update("jax_platforms", "cpu")
+        monkeypatch.setenv("BCG_TPU_SPEC", "1")
+        monkeypatch.setenv("BCG_TPU_SPEC_K", "6")
+        monkeypatch.setenv("BCG_TPU_SPEC_NGRAM", "2")
+        eng = JaxEngine(_base_config())
+        assert eng.spec_decode and eng.spec_k == 6 and eng.spec_ngram == 2
+        eng.shutdown()
+
+
+# ------------------------------------------------------- hermetic mirror
+class TestFakeMirror:
+    def test_numpy_mirror_counts(self):
+        # Output "abcabcabc" over prompt "abcabc": pure self-echo, so
+        # after the first cycle nearly everything drafts and accepts.
+        prompt = list(b"abcabcabc")
+        out = list(b"abcabcabcabc")
+        drafted, accepted, iters = spec_mirror_np(prompt, out, 3, 4)
+        assert accepted > 0 and accepted <= drafted
+        assert iters + accepted == len(out)
+
+    def test_fake_engine_mirrors_counters_and_span(self, monkeypatch):
+        from bcg_tpu.engine.fake import FakeEngine
+        from bcg_tpu.obs import tracer as obs_tracer
+
+        prompts = [("sys " * 30, "agent_1 value: 17. agent_2 value: 17.", DECISION)]
+        monkeypatch.delenv("BCG_TPU_SPEC", raising=False)
+        plain_out = FakeEngine(seed=0).batch_generate_json(prompts)
+        monkeypatch.setenv("BCG_TPU_SPEC", "1")
+        monkeypatch.setenv("BCG_TPU_TRACE", "1")
+        obs_tracer.reset()
+        try:
+            eng = FakeEngine(seed=0)
+            before = obs_counters.snapshot()
+            out = eng.batch_generate_json(prompts)
+            assert "error" not in out[0]
+            # The mirror observes; it must never alter responses.
+            assert out == plain_out
+            moved = obs_counters.delta(before)
+            assert moved.get("engine.spec.drafted", 0) > 0
+            assert 0 < moved.get("engine.spec.accepted", 0) <= moved[
+                "engine.spec.drafted"
+            ]
+            names = [e[1] for e in obs_tracer.get_tracer().events()]
+            assert "engine.spec_verify" in names
+        finally:
+            obs_tracer.reset()
+
+    def test_fake_engine_off_by_default(self, monkeypatch):
+        from bcg_tpu.engine.fake import FakeEngine
+
+        monkeypatch.delenv("BCG_TPU_SPEC", raising=False)
+        eng = FakeEngine(seed=0)
+        before = obs_counters.snapshot()
+        eng.batch_generate_json([("s", "u", VOTE)])
+        moved = obs_counters.delta(before)
+        assert not any(k.startswith("engine.spec") for k in moved)
+
+
+class TestServeStats:
+    def test_snapshot_carries_acceptance_rate(self):
+        from bcg_tpu.serve.scheduler import SchedulerStats
+
+        stats = SchedulerStats()  # baselines at current counter values
+        obs_counters.inc("engine.spec.drafted", 10)
+        obs_counters.inc("engine.spec.accepted", 6)
+        obs_counters.inc("engine.spec.rejected", 4)
+        snap = stats.snapshot()
+        assert snap["spec"] == {
+            "drafted": 10, "accepted": 6, "rejected": 4,
+            "acceptance_rate": 0.6,
+        }
+        # A scheduler constructed AFTER the movement sees none of it.
+        assert SchedulerStats().snapshot()["spec"] is None

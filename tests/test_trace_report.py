@@ -52,6 +52,35 @@ def test_report_renders_traced_game(traced, tmp_path):
     assert "serve.requests" in proc.stdout
 
 
+def test_report_derives_spec_acceptance(tmp_path):
+    """engine.spec.* counters in an export turn into a one-line draft
+    acceptance rate (and the line is absent without them)."""
+    trace = {
+        "traceEvents": [],
+        "otherData": {"counters": {
+            "engine.spec.drafted": 80,
+            "engine.spec.accepted": 60,
+            "engine.spec.rejected": 20,
+        }},
+    }
+    path = tmp_path / "spec_trace.json"
+    path.write_text(json.dumps(trace))
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, str(path)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "60/80 draft tokens accepted (75.0%)" in proc.stdout
+    # No spec counters -> no spec line.
+    bare = tmp_path / "bare.json"
+    bare.write_text(json.dumps({"traceEvents": [], "otherData": {}}))
+    proc2 = subprocess.run(
+        [sys.executable, SCRIPT, str(bare)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert "speculative" not in proc2.stdout
+
+
 def test_report_handles_empty_trace(tmp_path):
     empty = tmp_path / "empty.json"
     empty.write_text(json.dumps({"traceEvents": [], "otherData": {}}))
