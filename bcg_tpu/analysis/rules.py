@@ -460,6 +460,17 @@ def rule_env_raw(ctx: ModuleContext) -> Iterable[Finding]:
                 name, how = flag_name(node.args[0]), cname
             elif cname in ("os.getenv", "getenv") and node.args:
                 name, how = flag_name(node.args[0]), cname
+            elif (
+                cname in ("os.environ.setdefault", "environ.setdefault")
+                and node.args
+            ):
+                # setdefault RETURNS the (possibly pre-existing) value —
+                # a read with the registry's parse bypassed, plus a
+                # write that later registry reads silently inherit.
+                # Plain `os.environ[...] = ...` writes stay legal
+                # (scenario harnesses configure flags they then read
+                # through the registry).
+                name, how = flag_name(node.args[0]), cname
         elif isinstance(node, ast.Subscript):
             base = _call_name(node.value)
             if base in ("os.environ", "environ") and isinstance(
@@ -685,6 +696,85 @@ def rule_time_wall(ctx: ModuleContext) -> Iterable[Finding]:
             )
 
 
+# ------------------------------------------------ rule: metric name taxonomy
+# <subsystem>.<noun>[.<detail>[.<detail>]] — lowercase dotted identifiers,
+# 2-4 segments (DESIGN.md "Observability": the registry name is the
+# documentation key, and the Prometheus exposition derives metric names
+# from it mechanically).
+_OBS_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_]+){1,3}$")
+# f-string fragments: only chars a valid dotted name can contain (the
+# dynamic parts fill in the rest); the LEADING fragment must already
+# carry the `<subsystem>.` prefix.
+_OBS_FRAGMENT_RE = re.compile(r"^[a-z0-9_.]*$")
+_OBS_PREFIX_RE = re.compile(r"^[a-z][a-z0-9_]*\.")
+_OBS_CALL_ATTRS = {"inc", "counter", "gauge", "set_gauge", "value"}
+_OBS_BASE_RE = re.compile(r"(^|\.)(obs_)?_?counters$|(^|\.)REGISTRY$")
+
+
+@_rule("BCG-OBS-NAME")
+def rule_obs_name(ctx: ModuleContext) -> Iterable[Finding]:
+    """Counter/gauge names registered through ``bcg_tpu.obs.counters``
+    must be lowercase dotted identifiers matching the documented
+    taxonomy (``<subsystem>.<noun>[.<detail>]``): the Prometheus
+    exposition derives metric names from them mechanically, and a
+    one-off spelling ("Serve.Requests", a bare "requests") fragments
+    the namespace every dashboard and baseline keys on.  Literal names
+    are checked whole; f-string names have their static fragments
+    checked (the leading fragment must carry the subsystem prefix);
+    variable names are trusted."""
+    if ctx.rel_path.endswith("obs/counters.py"):
+        return  # the registry implementation itself
+    imported_direct = any(
+        isinstance(node, ast.ImportFrom)
+        and node.module == "bcg_tpu.obs.counters"
+        for node in ast.walk(ctx.tree)
+    )
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr not in _OBS_CALL_ATTRS:
+                continue
+            base = _call_name(node.func.value)
+            if not base or not _OBS_BASE_RE.search(base):
+                continue
+        elif isinstance(node.func, ast.Name):
+            if not imported_direct or node.func.id not in _OBS_CALL_ATTRS:
+                continue
+        else:
+            continue
+        arg = node.args[0]
+        bad: Optional[str] = None
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            if not _OBS_NAME_RE.match(arg.value):
+                bad = repr(arg.value)
+        elif isinstance(arg, ast.JoinedStr):
+            consts = [
+                v.value for v in arg.values
+                if isinstance(v, ast.Constant) and isinstance(v.value, str)
+            ]
+            if any(not _OBS_FRAGMENT_RE.match(c) for c in consts):
+                bad = "f-string with non-taxonomy characters"
+            elif not (
+                arg.values
+                and isinstance(arg.values[0], ast.Constant)
+                and isinstance(arg.values[0].value, str)
+                and _OBS_PREFIX_RE.match(arg.values[0].value)
+            ):
+                # Leading dynamic part (f"{x}.retrace"): the subsystem
+                # itself is unknowable statically — require a literal
+                # '<subsystem>.' prefix.
+                bad = "f-string without a literal '<subsystem>.' prefix"
+        if bad:
+            yield ctx.finding(
+                "BCG-OBS-NAME",
+                node,
+                f"metric name {bad} violates the counter/gauge taxonomy "
+                "(<subsystem>.<noun>[.<detail>], lowercase dotted, 2-4 "
+                "segments — DESIGN.md Observability)",
+            )
+
+
 # ------------------------------------------------- rule: mutable defaults
 @_rule("BCG-MUT-DEFAULT")
 def rule_mut_default(ctx: ModuleContext) -> Iterable[Finding]:
@@ -726,6 +816,7 @@ ALL_RULES: Sequence = (
     rule_mut_default,
     rule_lock_call,
     rule_time_wall,
+    rule_obs_name,
 )
 
 RULE_IDS: List[str] = [r.rule_id for r in ALL_RULES]

@@ -43,7 +43,12 @@ from bcg_tpu.engine.speculative import (
 from bcg_tpu.engine.tokenizer import Tokenizer, tokenizer_for_model
 from bcg_tpu.guided.processor import GuidedBatch, compile_schema
 from bcg_tpu.config import env_flag
-from bcg_tpu.obs import counters as obs_counters, tracer as obs_tracer
+from bcg_tpu.obs import (
+    counters as obs_counters,
+    hlo as obs_hlo,
+    ledger as obs_ledger,
+    tracer as obs_tracer,
+)
 from bcg_tpu.models.configs import (
     LARGE_MODEL_PARAMS,
     ModelSpec,
@@ -729,6 +734,11 @@ class JaxEngine(InferenceEngine):
         )
         self._prefix_lens_memo: Dict[str, int] = {}
         self._prefix_bytes = 0
+        # Per-DEVICE counterpart (shard sizes via tree_bytes_per_device):
+        # what the HBM ledger's prefix_cache account is charged with —
+        # global nbytes would overstate it by the shard factor on
+        # tp/sp-sharded meshes.
+        self._prefix_bytes_dev = 0
         self._prefix_active: set = set()
         self._prefix_over_budget_warned = False
         # Prefix-KV budget: a fraction of device memory when known (the
@@ -772,6 +782,21 @@ class JaxEngine(InferenceEngine):
             self._prefix_budget = min(
                 4 << 30, max(256 << 20, int(free * 0.25))
             )
+        # HBM ledger (bcg_tpu/obs/ledger.py): declare this device's
+        # capacity and charge the weight tree — per-device bytes from the
+        # leaves' ACTUAL shardings, the same tree_bytes_per_device the
+        # budget math uses, so the ledger and admission cannot disagree.
+        # Keyed by engine identity: weight-sharing engines each charge
+        # their own (shared-tree) share exactly once, and shutdown
+        # credits exactly what this instance charged.
+        obs_ledger.set_limit(self._mem_limit)
+        obs_ledger.charge("params", id(self), self._param_bytes_per_device)
+        # Telemetry endpoint (BCG_TPU_METRICS_PORT): idempotent, off by
+        # default — a scraped deployment gets engine.hlo.* / hbm.* /
+        # serve.* without further wiring.
+        from bcg_tpu.obs import export as obs_export
+
+        obs_export.maybe_start_http_server()
         if _TIMING and self.boot_phases:
             import sys as _sys
 
@@ -844,6 +869,17 @@ class JaxEngine(InferenceEngine):
         return self._encode_leftpad(full_prompts, limits, _LEN_BUCKETS)
 
     # --------------------------------------------------------- prefix caching
+
+    def _entry_bytes_per_device(self, kv, global_bytes: int) -> int:
+        """ONE device's share of a prefix entry's KV (shard sizes via
+        tree_bytes_per_device) — the unit the HBM ledger accounts in.
+        ``global_bytes`` (the nbytes sum the LRU budget uses) is the
+        single-device answer, so skip the leaf walk without a mesh."""
+        if self.mesh is None or self._mesh_devices <= 1:
+            return global_bytes
+        from bcg_tpu.parallel.sharding import tree_bytes_per_device
+
+        return tree_bytes_per_device(kv)
 
     def _prefix_len(self, prefix: str) -> int:
         """Token count of a prefix (memoized — called every batch)."""
@@ -947,6 +983,8 @@ class JaxEngine(InferenceEngine):
         )
         self._prefix_bytes += entry_bytes
         entry["bytes"] = entry_bytes
+        entry["bytes_dev"] = self._entry_bytes_per_device(kv, entry_bytes)
+        self._prefix_bytes_dev += entry["bytes_dev"]
         self._prefix_cache[key] = entry
         self._prefix_active.add(key)
         # A larger entry supersedes smaller-bucket duplicates of the same
@@ -958,6 +996,7 @@ class JaxEngine(InferenceEngine):
         ]:
             old = self._prefix_cache.pop(k2)
             self._prefix_bytes -= old["bytes"]
+            self._prefix_bytes_dev -= old["bytes_dev"]
         # Evict LRU-first, but never a key of the batch being assembled
         # (_prefix_active): evicting mid-batch would re-prefill the whole
         # working set on EVERY call — the thrash this cache exists to
@@ -1148,6 +1187,8 @@ class JaxEngine(InferenceEngine):
         entry_bytes = sum(getattr(a, "nbytes", 0) for a in jax.tree.leaves(kv))
         self._prefix_bytes += entry_bytes
         entry["bytes"] = entry_bytes
+        entry["bytes_dev"] = self._entry_bytes_per_device(kv, entry_bytes)
+        self._prefix_bytes_dev += entry["bytes_dev"]
         key = (composite, Pb)
         self._prefix_cache[key] = entry
         self._prefix_active.add(key)
@@ -1156,13 +1197,19 @@ class JaxEngine(InferenceEngine):
 
     def _evict_prefix_over_budget(self) -> None:
         """LRU eviction shared by both entry kinds — never a key of the
-        batch being assembled (see _get_prefix_entry)."""
+        batch being assembled (see _get_prefix_entry).  Doubles as the
+        prefix account's ledger sync point: both entry creators end
+        here, so re-charging the engine's single prefix-cache key with
+        the post-eviction total keeps ``hbm.prefix_cache_bytes`` exact
+        without per-entry ledger keys."""
         evictable = [
             k for k in self._prefix_cache if k not in self._prefix_active
         ]
         while self._prefix_bytes > self._prefix_budget and evictable:
             old = self._prefix_cache.pop(evictable.pop(0))
             self._prefix_bytes -= old["bytes"]
+            self._prefix_bytes_dev -= old["bytes_dev"]
+        obs_ledger.charge("prefix_cache", id(self), self._prefix_bytes_dev)
         if (
             self._prefix_bytes > self._prefix_budget
             and not self._prefix_over_budget_warned
@@ -1747,7 +1794,7 @@ class JaxEngine(InferenceEngine):
                     # (prefix slots + its own causal window) — same
                     # semantics as prefill_with_prefix (identical RoPE
                     # offsets and mask), sharded instead of replicated.
-                    return self._prefill_chunk_at(
+                    return obs_hlo.wrap("prefill_chunk", self._prefill_chunk_at)(
                         self.params, tokens=self._put_batch(tokens),
                         valid=self._put_batch(valid), cache=cache,
                         hist_valid=self._put_batch(prefix_valid),
@@ -1762,7 +1809,7 @@ class JaxEngine(InferenceEngine):
                         f"divisible by sp={self._sp_devices} "
                         "(off-ladder clamp shape)"
                     )
-                return self._prefill_suffix(
+                return obs_hlo.wrap("prefill_suffix", self._prefill_suffix)(
                     self.params, tokens=self._put_batch(tokens),
                     valid=self._put_batch(valid), cache=cache,
                     prefix_valid=self._put_batch(prefix_valid),
@@ -1770,7 +1817,7 @@ class JaxEngine(InferenceEngine):
                 )
             if self._prefill_sp is not None:
                 if L % self._sp_devices == 0:
-                    return self._prefill_sp(
+                    return obs_hlo.wrap("prefill_sp", self._prefill_sp)(
                         self.params, tokens=self._put_batch(tokens),
                         valid=self._put_batch(valid), cache=cache,
                     )
@@ -1782,7 +1829,7 @@ class JaxEngine(InferenceEngine):
                     f"prompt window L={L} not divisible by "
                     f"sp={self._sp_devices} (off-ladder entry bucket)"
                 )
-            return self._prefill(
+            return obs_hlo.wrap("prefill", self._prefill)(
                 self.params, tokens=self._put_batch(tokens),
                 valid=self._put_batch(valid), cache=cache,
             )
@@ -1810,7 +1857,9 @@ class JaxEngine(InferenceEngine):
                 hist[:, :P] = prefix_valid
             hist[:, P:P + start] = valid[:, :start]
             pos_off = base_lens + valid[:, :start].sum(axis=1)
-            first_logits, cache = self._prefill_chunk_at(
+            first_logits, cache = obs_hlo.wrap(
+                "prefill_chunk", self._prefill_chunk_at
+            )(
                 self.params,
                 tokens=self._put_batch(tokens[:, start:start + Ct]),
                 valid=self._put_batch(valid[:, start:start + Ct]),
@@ -1822,6 +1871,26 @@ class JaxEngine(InferenceEngine):
         return first_logits, cache
 
     def _decode_batch(
+        self, parts, batch, sig_prefix, real_B, temps, budgets,
+        top_p,
+    ) -> List[str]:
+        """Ledger envelope around :meth:`_decode_batch_impl`: the
+        decode-cache charge (made inside the impl once B/S are known) is
+        credited here in a ``finally`` so an engine failure cannot leak
+        a phantom KV slab into ``hbm.kv_cache_bytes``."""
+        try:
+            return self._decode_batch_impl(
+                parts, batch, sig_prefix, real_B, temps, budgets, top_p
+            )
+        finally:
+            obs_ledger.credit("kv_cache", id(self))
+            obs_ledger.credit("spec_slots", id(self))
+            if self._mem_limit is not None:
+                # Real allocator present: publish the drift gauge
+                # (ledger vs bytes_in_use) each call — the leak alarm.
+                obs_ledger.reconcile()
+
+    def _decode_batch_impl(
         self, parts, batch, sig_prefix, real_B, temps, budgets,
         top_p,
     ) -> List[str]:
@@ -1908,6 +1977,18 @@ class JaxEngine(InferenceEngine):
                 valid_mask = np.zeros((B, S), dtype=bool)
                 valid_mask[:, :L] = valid
                 prompt_lens = valid.sum(axis=1).astype(np.int32)
+            # Ledger: this call's decode slab, split into the token-
+            # budget window (kv_cache) and the loop family's decode-tail
+            # OVER-allocation (spec_slots — speculation's K+1 verify
+            # window / fast-forward's compacted tail, the slots past
+            # max_new+1).  Per-device bytes via the same placement
+            # function admission uses; credited by _decode_batch's
+            # finally.
+            slab = self._kv_bytes_per_device(B, S)
+            extra = max(0, decode_slots - (max_new + 1))
+            spec_part = int(slab * extra / S) if S else 0
+            obs_ledger.charge("kv_cache", id(self), slab - spec_part)
+            obs_ledger.charge("spec_slots", id(self), spec_part)
             hist = None
             if use_spec:
                 # Token-history buffer for the prompt-lookup drafter:
@@ -1942,9 +2023,9 @@ class JaxEngine(InferenceEngine):
         with obs_tracer.span("engine.decode",
                              args={"rows": B, "max_new": max_new}):
             if use_spec:
-                loop = self._get_spec_decode_loop(
+                loop = obs_hlo.wrap("spec_decode_loop", self._get_spec_decode_loop(
                     sig_prefix + (B, L), max_new, top_p
-                )
+                ))
                 with obs_tracer.span(
                     "engine.spec_verify",
                     args={"rows": B, "k": self.spec_k,
@@ -1964,7 +2045,10 @@ class JaxEngine(InferenceEngine):
                         sub,
                     )
             elif use_ff:
-                loop = self._get_ff_decode_loop(sig_prefix + (B, L), max_new, top_p)
+                loop = obs_hlo.wrap(
+                    "ff_decode_loop",
+                    self._get_ff_decode_loop(sig_prefix + (B, L), max_new, top_p),
+                )
                 out, (_, steps), _cache_out = loop(
                     self.params, cache, first_logits,
                     self._put_batch(valid_mask),
@@ -1978,7 +2062,10 @@ class JaxEngine(InferenceEngine):
                     sub,
                 )
             else:
-                loop = self._get_decode_loop(sig_prefix + (B, L), max_new, top_p)
+                loop = obs_hlo.wrap(
+                    "decode_loop",
+                    self._get_decode_loop(sig_prefix + (B, L), max_new, top_p),
+                )
                 out, (_, steps), _cache_out = loop(
                     self.params, cache, first_logits,
                     self._put_batch(valid_mask),
@@ -2338,4 +2425,10 @@ class JaxEngine(InferenceEngine):
         self._decode_loops.clear()
         self._prefix_cache.clear()
         self._prefix_bytes = 0
+        self._prefix_bytes_dev = 0
         self._prefix_lens_memo.clear()
+        # Release this engine's ledger accounts (weights + prefix KV;
+        # per-call kv_cache/spec_slots charges are credited by their own
+        # finally) so hbm.* gauges reflect the post-shutdown device.
+        obs_ledger.credit("params", id(self))
+        obs_ledger.credit("prefix_cache", id(self))

@@ -1,4 +1,5 @@
-"""Observability: span tracer + counter/gauge registry.
+"""Observability: span tracer, counter/gauge registry, and the
+device-cost half — HLO census, HBM ledger, telemetry export.
 
 ``bcg_tpu.obs.tracer`` — nestable, cross-thread spans with explicit
 parent handoff, ring-buffered, exported as Chrome trace-event JSON
@@ -6,13 +7,21 @@ parent handoff, ring-buffered, exported as Chrome trace-event JSON
 table + top counters from an export).  ``bcg_tpu.obs.counters`` — the
 single process-wide counter/gauge registry (compile/retrace accounting,
 serve linger buckets) with ``snapshot()``/``delta()`` for tests and
-bench JSON.
+bench JSON.  ``bcg_tpu.obs.hlo`` — lowered-HLO kernel census per jit
+entry (``engine.hlo.*`` gauges; ``scripts/hlo_census.py`` +
+``hlo_baseline.json`` pin kernel counts per decode step).
+``bcg_tpu.obs.ledger`` — per-device HBM byte accounting of params / KV
+slabs / prefix entries / spec slots (``hbm.*`` gauges).
+``bcg_tpu.obs.export`` — Prometheus text exposition, the
+``BCG_TPU_SERVE_EVENTS`` request-lifecycle JSONL sink, and the
+``BCG_TPU_METRICS_PORT`` HTTP ``/metrics`` endpoint.
 
-Neither module imports jax: flag-only consumers (bench.py's error
-path) stay light.  Enable tracing with ``BCG_TPU_TRACE=1``; see
-DESIGN.md "Observability" for the span taxonomy.
+None of these modules import jax at module scope: flag-only consumers
+(bench.py's error path) stay light.  Enable tracing with
+``BCG_TPU_TRACE=1``; see DESIGN.md "Observability" for the span
+taxonomy and the device-cost subsection.
 """
 
-from bcg_tpu.obs import counters, tracer  # noqa: F401
+from bcg_tpu.obs import counters, export, hlo, ledger, tracer  # noqa: F401
 
-__all__ = ["counters", "tracer"]
+__all__ = ["counters", "export", "hlo", "ledger", "tracer"]

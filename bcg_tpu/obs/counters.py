@@ -122,6 +122,20 @@ class Registry:
             out.update({n: g.value for n, g in self._gauges.items()})
         return dict(sorted(out.items()))
 
+    def snapshot_typed(self) -> Dict[str, Dict[str, Number]]:
+        """``{"counters": {...}, "gauges": {...}}`` — the split the
+        Prometheus exposition (:mod:`bcg_tpu.obs.export`) needs, since
+        counter-vs-gauge is a declared TYPE there, not a convention."""
+        with self._lock:
+            return {
+                "counters": dict(
+                    sorted((n, c.value) for n, c in self._counters.items())
+                ),
+                "gauges": dict(
+                    sorted((n, g.value) for n, g in self._gauges.items())
+                ),
+            }
+
     def delta(self, before: Dict[str, Number]) -> Dict[str, Number]:
         """COUNTER movement since ``before`` (a prior ``snapshot()``),
         nonzero entries only.  Gauges are excluded: a gauge's change is
@@ -172,6 +186,10 @@ def value(name: str, default: Number = 0) -> Number:
 
 def snapshot() -> Dict[str, Number]:
     return REGISTRY.snapshot()
+
+
+def snapshot_typed() -> Dict[str, Dict[str, Number]]:
+    return REGISTRY.snapshot_typed()
 
 
 def delta(before: Dict[str, Number]) -> Dict[str, Number]:

@@ -1,0 +1,284 @@
+"""Telemetry export: Prometheus text exposition, request-lifecycle
+JSONL sink, and an optional stdlib HTTP ``/metrics`` endpoint.
+
+Three consumers of the same registry, one module:
+
+* :func:`render_prometheus` — the counter/gauge registry in Prometheus
+  text-exposition format (v0.0.4): dotted registry names become
+  ``bcg_``-prefixed underscore names, counters carry the ``_total``
+  suffix and ``# TYPE ... counter``, gauges ``# TYPE ... gauge``, and
+  every metric's HELP line cites the original dotted name (the registry
+  name IS the documentation key in DESIGN.md's taxonomy).  Escaping
+  follows the exposition spec (backslash and newline in HELP text).
+* :class:`EventSink` — an append-only JSONL stream of serve-path
+  request lifecycle events (``admitted`` / ``rejected`` / ``cancelled``
+  / ``dispatched`` / ``completed`` / ``failed``), each line carrying
+  the request id, row count, and the latency breakdown the scheduler
+  already measures.  Enabled by ``BCG_TPU_SERVE_EVENTS=<path>``; the
+  scheduler calls :func:`emit_event`, which is a no-op when disabled.
+* :func:`maybe_start_http_server` — a daemon-thread
+  ``ThreadingHTTPServer`` serving ``GET /metrics`` with the live
+  exposition, gated by ``BCG_TPU_METRICS_PORT`` (0 = off, the
+  default).  Idempotent per process; a bind failure warns and stays
+  off rather than taking the engine down.  This is the piece a
+  deployment's Prometheus scrapes.
+
+No jax import — loadable by flag-only consumers.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import re
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Optional, Tuple
+
+from bcg_tpu.obs import counters as obs_counters
+from bcg_tpu.runtime import envflags
+
+_NAME_PREFIX = "bcg_"
+_INVALID_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def prometheus_name(registry_name: str, counter: bool = False) -> str:
+    """Dotted registry name -> Prometheus metric name
+    (``serve.linger_le_1ms`` -> ``bcg_serve_linger_le_1ms``; counters
+    get the conventional ``_total`` suffix)."""
+    name = _NAME_PREFIX + _INVALID_CHARS.sub("_", registry_name.replace(".", "_"))
+    if counter and not name.endswith("_total"):
+        name += "_total"
+    return name
+
+
+def _escape_help(text: str) -> str:
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _format_value(value) -> str:
+    # Prometheus values are floats; render integers without the
+    # trailing .0 noise (both parse identically).
+    f = float(value)
+    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def render_prometheus(typed: Optional[Dict[str, Dict[str, Any]]] = None) -> str:
+    """The registry (or an explicit ``snapshot_typed()``-shaped dict) in
+    Prometheus text-exposition format, sorted by metric name."""
+    if typed is None:
+        typed = obs_counters.snapshot_typed()
+    lines = []
+    rows = [
+        (prometheus_name(name, counter=True), name, "counter", value)
+        for name, value in typed.get("counters", {}).items()
+    ] + [
+        (prometheus_name(name), name, "gauge", value)
+        for name, value in typed.get("gauges", {}).items()
+    ]
+    for metric, original, kind, value in sorted(rows):
+        lines.append(
+            f"# HELP {metric} {_escape_help(f'bcg_tpu registry {kind} {original!r}')}"
+        )
+        lines.append(f"# TYPE {metric} {kind}")
+        lines.append(f"{metric} {_format_value(value)}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+# ------------------------------------------------------------ JSONL events
+class EventSink:
+    """Append-only JSONL event stream (one JSON object per line),
+    written by a dedicated drainer thread.
+
+    ``emit()`` only appends to a bounded in-memory queue — the scheduler
+    calls it from its dispatch loop and (on failure paths) while holding
+    its condition lock, so a stalled disk must never turn the telemetry
+    sink into a serving-latency cliff.  The drainer opens the file
+    lazily, writes in batches and flushes per batch (the file stays
+    tail-able live); a full queue drops the OLDEST records and counts
+    the loss in ``serve.events_dropped``; ``close()`` drains what is
+    queued before returning (an atexit hook closes the process sink so
+    a normal exit loses nothing)."""
+
+    def __init__(self, path: str, max_queue: int = 65536):
+        self.path = path
+        self._cond = threading.Condition()
+        self._queue: "deque" = deque(maxlen=max_queue)
+        self._closed = False
+        self._write_failed = False
+        self._thread = threading.Thread(
+            target=self._drain, name="bcg-event-sink", daemon=True
+        )
+        self._thread.start()
+
+    def emit(self, event: str, **fields: Any) -> None:
+        record = {"ts": time.time(), "event": event}
+        record.update(fields)
+        with self._cond:
+            if self._closed:
+                return
+            if len(self._queue) == self._queue.maxlen:
+                # deque(maxlen) evicts the oldest on append — count it.
+                obs_counters.inc("serve.events_dropped")
+            self._queue.append(record)
+            self._cond.notify()
+
+    def _drain(self) -> None:
+        fh = None
+        while True:
+            with self._cond:
+                while not self._queue and not self._closed:
+                    self._cond.wait()
+                batch = list(self._queue)
+                self._queue.clear()
+                closed = self._closed
+                self._cond.notify_all()  # close() waits for empty queue
+            if batch and not self._write_failed:
+                try:
+                    if fh is None:
+                        fh = open(self.path, "a", encoding="utf-8")
+                    for record in batch:
+                        fh.write(json.dumps(record, default=str) + "\n")
+                    fh.flush()
+                except OSError as exc:
+                    import sys
+
+                    # One warning, then drop silently: retrying a dead
+                    # disk per batch would just spin this thread.
+                    print(
+                        f"obs.export: event sink write failed "
+                        f"({self.path}): {exc} — further events dropped",
+                        file=sys.stderr,
+                    )
+                    self._write_failed = True
+            if closed:
+                break
+        if fh is not None:
+            fh.close()
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._thread.join(timeout=10.0)
+
+
+_sink_lock = threading.Lock()
+_sink: Optional[EventSink] = None
+_sink_configured = False
+
+
+def _ensure_sink() -> Optional[EventSink]:
+    global _sink, _sink_configured
+    if _sink_configured:
+        return _sink
+    with _sink_lock:
+        if not _sink_configured:
+            path = envflags.get_str("BCG_TPU_SERVE_EVENTS")
+            if path:
+                _sink = EventSink(path)
+                # Drain the queue on normal interpreter exit — the
+                # writer is a daemon thread and would otherwise die
+                # with the tail of the run still in memory.
+                atexit.register(reset_sink)
+            _sink_configured = True
+    return _sink
+
+
+def emit_event(event: str, **fields: Any) -> None:
+    """Queue one lifecycle event for the configured sink (no-op when
+    ``BCG_TPU_SERVE_EVENTS`` is unset).  Non-blocking by construction —
+    the scheduler calls this from its dispatch loop and, on failure
+    paths, under its condition lock; disk latency lives entirely on the
+    sink's drainer thread."""
+    sink = _ensure_sink()
+    if sink is not None:
+        sink.emit(event, **fields)
+
+
+def reset_sink() -> None:
+    """Drop the cached sink + its read-once flag — TEST-ONLY."""
+    global _sink, _sink_configured
+    with _sink_lock:
+        if _sink is not None:
+            _sink.close()
+        _sink = None
+        _sink_configured = False
+
+
+# ------------------------------------------------------------ HTTP server
+_server_lock = threading.Lock()
+_server = None
+_server_port: Optional[int] = None
+
+
+def start_http_server(port: int) -> Tuple[Any, int]:
+    """Start the metrics endpoint on ``port`` (0 = ephemeral) and return
+    ``(server, bound_port)``.  The server thread is a daemon; call
+    ``server.shutdown()`` to stop it (tests do; production lets process
+    exit reap it)."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class _Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 (stdlib casing)
+            if self.path.split("?")[0] not in ("/metrics", "/"):
+                self.send_response(404)
+                self.end_headers()
+                return
+            body = render_prometheus().encode("utf-8")
+            self.send_response(200)
+            self.send_header(
+                "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+            )
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # silence per-scrape stderr noise
+            pass
+
+    server = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+    thread = threading.Thread(
+        target=server.serve_forever, name="bcg-metrics-http", daemon=True
+    )
+    thread.start()
+    return server, server.server_address[1]
+
+
+def maybe_start_http_server() -> Optional[int]:
+    """Start the endpoint once per process when ``BCG_TPU_METRICS_PORT``
+    is set (> 0); returns the bound port, or None when disabled.  Called
+    from engine/scheduler boot — cheap no-op on every later call."""
+    global _server, _server_port
+    if _server is not None:
+        return _server_port
+    port = envflags.get_int("BCG_TPU_METRICS_PORT")
+    if port <= 0:
+        return None
+    with _server_lock:
+        if _server is None:
+            try:
+                _server, _server_port = start_http_server(port)
+            except OSError as exc:
+                import sys
+
+                print(
+                    f"obs.export: metrics endpoint failed to bind port "
+                    f"{port}: {exc} — telemetry HTTP disabled",
+                    file=sys.stderr,
+                )
+                return None
+    return _server_port
+
+
+def stop_http_server() -> None:
+    """Shut the process endpoint down (TEST-ONLY; production relies on
+    daemon-thread teardown at exit)."""
+    global _server, _server_port
+    with _server_lock:
+        if _server is not None:
+            _server.shutdown()
+            _server.server_close()
+            _server = None
+            _server_port = None

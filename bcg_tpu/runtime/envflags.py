@@ -135,6 +135,28 @@ _register(
     "eviction).",
 )
 
+# BCG_TPU_HLO_CENSUS / METRICS / EVENTS — device-cost observability
+# (bcg_tpu/obs: hlo.py, export.py, ledger.py).
+_register(
+    "BCG_TPU_HLO_CENSUS", "bool", False,
+    "Record a lowered-HLO kernel census (op counts by category + XLA "
+    "cost analysis) at each engine jit entry's first call, published "
+    "as engine.hlo.* gauges (scripts/hlo_census.py; one extra "
+    "lower+compile per entry — keep off on serving hot paths).",
+)
+_register(
+    "BCG_TPU_METRICS_PORT", "int", 0,
+    "Serve the counter/gauge registry as a Prometheus text exposition "
+    "on http://127.0.0.1:<port>/metrics (stdlib HTTP server, daemon "
+    "thread; 0 = disabled).",
+)
+_register(
+    "BCG_TPU_SERVE_EVENTS", "str", None,
+    "Append serve-path request lifecycle events (admitted/dispatched/"
+    "completed/rejected, with request id and latency breakdown) as "
+    "JSONL to this path.",
+)
+
 # BCG_TPU_SERVE_* — continuous-batching serving subsystem (bcg_tpu/serve).
 _register(
     "BCG_TPU_SERVE", "bool", False,

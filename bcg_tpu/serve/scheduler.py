@@ -38,11 +38,17 @@ deadlock class this module exists to retire.
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-from bcg_tpu.obs import counters as obs_counters, tracer as obs_tracer
+from bcg_tpu.obs import (
+    counters as obs_counters,
+    export as obs_export,
+    ledger as obs_ledger,
+    tracer as obs_tracer,
+)
 from bcg_tpu.obs.tracer import SpanAggregator
 from bcg_tpu.runtime import envflags
 
@@ -82,10 +88,13 @@ class Request:
     """One engine call from one participant, completed independently."""
 
     __slots__ = ("sig", "payload", "n_rows", "temps", "budgets", "deadline",
-                 "enqueued_at", "done", "results", "error", "span")
+                 "enqueued_at", "done", "results", "error", "span", "req_id")
+
+    _ids = itertools.count(1)  # process-wide: ids stay unique across schedulers
 
     def __init__(self, sig: Tuple, payload: List, temps: List[float],
                  budgets: List[int], deadline: Optional[float]):
+        self.req_id = next(Request._ids)
         self.sig = sig
         self.payload = payload
         self.n_rows = len(payload)
@@ -203,6 +212,12 @@ class SchedulerStats:
             # (None when the inner engine drafted nothing — spec off or
             # fake backend without the mirror).
             "spec": self._spec_snapshot(),
+            # HBM ledger view (bcg_tpu/obs/ledger.py): what the device
+            # currently holds (params / KV slab / prefix entries / spec
+            # slots) and the admission headroom left under the declared
+            # limit — the byte-level counterpart of row_cap (None
+            # throughout on CPU where no limit is known).
+            "hbm": obs_ledger.snapshot(),
         }
 
     def _spec_snapshot(self) -> Optional[Dict[str, Any]]:
@@ -292,6 +307,9 @@ class Scheduler:
             target=self._loop, name="bcg-serve-scheduler", daemon=True
         )
         self._thread.start()
+        # Telemetry endpoint (BCG_TPU_METRICS_PORT): idempotent no-op
+        # when disabled; a FakeEngine serving run is scrapeable too.
+        obs_export.maybe_start_http_server()
 
     # ------------------------------------------------------------ submission
 
@@ -316,6 +334,7 @@ class Scheduler:
             if self._closed:
                 self.stats.cancelled += 1
                 req.fail(SchedulerClosed("scheduler is shut down"))
+                self._emit(req, "cancelled", reason="scheduler_closed")
                 return req
             if (self._row_cap is not None and self._strict
                     and req.n_rows > self._row_cap):
@@ -324,6 +343,7 @@ class Scheduler:
                     f"request of {req.n_rows} rows exceeds the device "
                     f"bucket of {self._row_cap} rows"
                 ))
+                self._emit(req, "rejected", row_cap=self._row_cap)
                 return req
             blocked = False
             # A lone request larger than the watermark must still admit
@@ -347,6 +367,8 @@ class Scheduler:
                             req.fail(RequestCancelled(
                                 "deadline expired waiting for queue admission"
                             ))
+                            self._emit(req, "cancelled",
+                                       reason="admission_deadline")
                             return req
                     self._cond.wait(timeout if timeout is not None else 1.0)
                     if not self._thread.is_alive() and not self._closed:
@@ -359,10 +381,12 @@ class Scheduler:
                             "scheduler thread died while this request "
                             "waited for queue admission"
                         ))
+                        self._emit(req, "cancelled", reason="scheduler_died")
                         return req
             if self._closed:
                 self.stats.cancelled += 1
                 req.fail(SchedulerClosed("scheduler shut down during admission"))
+                self._emit(req, "cancelled", reason="closed_during_admission")
                 return req
             req.enqueued_at = time.monotonic()
             self._queue.append(req)
@@ -371,7 +395,17 @@ class Scheduler:
                 self.stats.max_queue_rows, self._queue_rows
             )
             self._cond.notify_all()
+        self._emit(req, "admitted", queue_rows=self._queue_rows)
         return req
+
+    @staticmethod
+    def _emit(req: Request, event: str, **fields: Any) -> None:
+        """One request-lifecycle line to the JSONL sink
+        (BCG_TPU_SERVE_EVENTS; no-op when unset)."""
+        obs_export.emit_event(
+            event, req_id=req.req_id, rows=req.n_rows, sig=str(req.sig),
+            **fields,
+        )
 
     def submit_and_wait(self, sig: Tuple, payload: List, temps: List[float],
                         budgets: List[int]) -> List:
@@ -427,6 +461,11 @@ class Scheduler:
                         "serve.queue_wait", wait_s, parent=r.span,
                         args={"rows": r.n_rows},
                     )
+                    self._emit(
+                        r, "dispatched",
+                        queue_wait_ms=round(wait_s * 1e3, 3),
+                        batch_requests=len(batch),
+                    )
             self._dispatch(batch)
             self._publish_stats()
 
@@ -440,6 +479,8 @@ class Scheduler:
             r.fail(RequestCancelled(
                 f"deadline expired after {now - r.enqueued_at:.3f}s in queue"
             ))
+            self._emit(r, "cancelled", reason="queue_deadline",
+                       queued_ms=round((now - r.enqueued_at) * 1e3, 3))
         self._queue = [r for r in self._queue if not r.done.is_set()]
         self._queue_rows = sum(r.n_rows for r in self._queue)
         self._cond.notify_all()
@@ -516,6 +557,7 @@ class Scheduler:
             temperature = temps[0] if len(set(temps)) == 1 else temps
             max_tokens = budgets[0] if len(set(budgets)) == 1 else budgets
         try:
+            device_t0 = time.monotonic()
             with obs_tracer.span("serve.device", parent=anchor,
                                  aggregate=self.stats.lat,
                                  args={"rows": len(merged),
@@ -538,6 +580,7 @@ class Scheduler:
                             merged, temperature=temperature,
                             max_tokens=max_tokens, top_p=sig[1],
                         )
+            device_ms = round((time.monotonic() - device_t0) * 1e3, 3)
             with obs_tracer.span("serve.scatter", parent=anchor,
                                  aggregate=self.stats.lat,
                                  args={"requests": len(batch)}):
@@ -545,6 +588,8 @@ class Scheduler:
                 for r in batch:
                     r.complete(out[pos: pos + r.n_rows])
                     pos += r.n_rows
+                    self._emit(r, "completed", device_ms=device_ms,
+                               batch_rows=len(merged))
             with self._cond:
                 self.stats.completed += len(batch)
                 self.stats.dispatches += 1
@@ -554,6 +599,7 @@ class Scheduler:
         except BaseException as e:
             for r in batch:
                 r.fail(e)
+                self._emit(r, "failed", error=f"{type(e).__name__}: {e}")
             with self._cond:
                 self.stats.failed += len(batch)
                 self.stats.engine_errors += 1
@@ -597,6 +643,7 @@ class Scheduler:
                 for r in self._queue:
                     self.stats.cancelled += 1
                     r.fail(SchedulerClosed("scheduler shut down"))
+                    self._emit(r, "cancelled", reason="scheduler_shutdown")
                 self._queue = []
                 self._queue_rows = 0
             self._cond.notify_all()

@@ -193,10 +193,11 @@ def _spec_stats_or_none():
 
 def _obs_payload() -> dict:
     """Observability attachments for the bench JSON — counters always
-    (compile/retrace accounting, serve linger buckets), span summary
-    when tracing ran (BCG_TPU_TRACE).  Attached on success AND error:
-    a failed run's counters are exactly the forensics a mid-wave crash
-    otherwise loses."""
+    (compile/retrace accounting, serve linger buckets, engine.hlo.* /
+    hbm.* gauges), span summary when tracing ran (BCG_TPU_TRACE), plus
+    the structured HBM-ledger and HLO-census views when they carry
+    anything.  Attached on success AND error: a failed run's counters
+    are exactly the forensics a mid-wave crash otherwise loses."""
     out = {}
     try:
         from bcg_tpu.obs import counters as _counters, tracer as _tracer
@@ -211,6 +212,18 @@ def _obs_payload() -> dict:
         # Inside the never-rc=1 contract: observability must not be able
         # to take the result line down with it.
         pass
+    try:
+        from bcg_tpu.obs import hlo as _hlo, ledger as _ledger
+
+        led = _ledger.snapshot()
+        if led.get("total_bytes"):
+            out["hbm_ledger"] = led
+        census = _hlo.snapshot()
+        if census:
+            out["hlo_census"] = census
+    except Exception:
+        # Same never-rc=1 contract as above.
+        pass
     return out
 
 
@@ -224,7 +237,10 @@ def _error_result(exc: BaseException, retried: bool) -> dict:
         "metric": "agent_decisions_per_sec",
         "value": 0.0,
         "unit": "decisions/sec",
-        "vs_baseline": 0.0,
+        # null, not 0.0: an outage measured NOTHING — recording it as
+        # "0% of baseline" poisoned the BENCH_r02-r05 trajectory, where
+        # accelerator-attach failures read as catastrophic regressions.
+        "vs_baseline": None,
         "error": f"{type(exc).__name__}: {str(exc)[:400]}"
                  + ("; failed again after one retry" if retried
                     else "; not retried (non-transient)"),
@@ -524,24 +540,28 @@ def _run_attempt(cfg, model: str, backend: str, concurrency: int,
     window_failed = w1[2] - w0[2]
     failed_fraction = window_failed / window_rows if window_rows else 0.0
     if backend != "fake" and window_steps <= 0:
-        return {
+        out = {
             "metric": "agent_decisions_per_sec",
             "value": 0.0,
             "unit": "decisions/sec",
-            "vs_baseline": 0.0,
+            "vs_baseline": None,  # measured nothing (see _error_result)
             "error": "engine produced no decode steps during the measured "
                      "window - every LLM call failed; see run logs",
         }
+        out.update(_obs_payload())
+        return out
     if backend != "fake" and failed_fraction > 0.5:
-        return {
+        out = {
             "metric": "agent_decisions_per_sec",
             "value": 0.0,
             "unit": "decisions/sec",
-            "vs_baseline": 0.0,
+            "vs_baseline": None,  # measured nothing (see _error_result)
             "error": f"{failed_fraction:.0%} of generation rows in the "
                      "measured window returned error dicts - throughput "
                      "would mostly measure instant failures; see run logs",
         }
+        out.update(_obs_payload())
+        return out
 
     # decide + vote are each one guided LLM generation per agent per round.
     decisions = 2 * n_agents * rounds_done
@@ -712,7 +732,7 @@ def main() -> None:
                 "metric": "agent_decisions_per_sec",
                 "value": 0.0,
                 "unit": "decisions/sec",
-                "vs_baseline": 0.0,
+                "vs_baseline": None,  # measured nothing (see _error_result)
                 "error": f"accelerator attach failed: {type(e).__name__} "
                          f"(timeout={attach_timeout}s); backend unavailable",
                 "stderr_tail": stderr[-500:],

@@ -1,0 +1,408 @@
+#!/usr/bin/env python
+"""Hermetic perf-regression gate: counter-derived metrics vs banded
+baselines, CPU-only.
+
+BENCH_r02-r05 lost an entire benchmark trajectory to accelerator-attach
+outages — wall-clock on flaky hardware cannot gate anything.  This gate
+re-derives the perf story from COUNTERS, which are exact on any
+backend:
+
+* ``engine`` scenario — a tiny real ``JaxEngine`` (``bcg-tpu/
+  tiny-test``) runs the guided-JSON decision benchmark twice (plain and
+  speculative): device decode iterations per decision, the speculative
+  step-reduction ratio, the draft acceptance rate, and ZERO
+  steady-state retraces (counter deltas over a warm repeat call).
+* ``serve`` scenario — a scripted FakeEngine serving run (16 concurrent
+  requests against one scheduler bucket, spec mirror on): completion
+  fraction, engine errors, batch-merge rows per dispatch, and the
+  mirrored draft acceptance rate.
+* ``hlo`` scenario — delegates to ``scripts/hlo_census.py``'s drift
+  check (kernel counts per jit entry vs ``hlo_baseline.json``) and
+  gates on zero findings.
+
+Every measured metric must have a justified entry in
+``perf_baseline.json`` (same load-bearing idiom as
+``lint_baseline.json``: an unbaselined metric is itself a failure, so
+deleting an entry RESURFACES its check rather than silencing it; a
+baseline entry the scenarios no longer produce is a stale-entry
+failure).  Bounds are tolerance-banded (``op``: ``min``/``max``/
+``range`` with ``tol_rel``/``tol_abs``); a regression failure names the
+metric, the measured value, the violated bound, and the entry's reason.
+
+Exit status: 0 = green; 2 = regression/drift (composes with
+``set -o pipefail`` harnesses); 1 = usage error.  Tier-1 runs the same
+comparisons in-process (``tests/test_perf_gate.py``).
+
+Usage:
+    python scripts/perf_gate.py                    # all scenarios
+    python scripts/perf_gate.py --scenarios serve,engine
+    python scripts/perf_gate.py --update-baseline  # regenerate (keeps reasons)
+    python scripts/perf_gate.py --inject-regression spec-off   # self-test
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SCENARIOS = ("serve", "engine", "hlo")
+REGRESSIONS = ("none", "spec-off", "fail-rows")
+
+DECISION = {
+    "type": "object",
+    "properties": {
+        "internal_strategy": {"type": "string", "minLength": 1, "maxLength": 25},
+        "value": {"type": "integer", "minimum": 0, "maximum": 50},
+        "public_reasoning": {"type": "string", "minLength": 1, "maxLength": 25},
+    },
+    "required": ["internal_strategy", "value", "public_reasoning"],
+    "additionalProperties": False,
+}
+VOTE = {
+    "type": "object",
+    "properties": {"decision": {"type": "string", "enum": ["stop", "continue"]}},
+    "required": ["decision"],
+    "additionalProperties": False,
+}
+
+
+def baseline_path() -> str:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(root, "perf_baseline.json")
+
+
+def _force_cpu() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+# ------------------------------------------------------------- scenarios
+def run_serve_scenario(inject: str = "none") -> Dict[str, float]:
+    """Scripted FakeEngine serving run: 2 waves x 8 threads x 2-row
+    guided requests against a 16-row bucket with a generous linger, so
+    full-bucket merges dominate regardless of host load.  The spec
+    mirror (BCG_TPU_SPEC=1) makes the hermetic run carry a realistic
+    draft-acceptance profile."""
+    from bcg_tpu.engine.fake import FakeEngine
+    from bcg_tpu.obs import counters as obs_counters
+    from bcg_tpu.serve.scheduler import Scheduler
+
+    # Save/restore needs the RAW value (None vs ""), not the parsed
+    # bool — the registry accessors cannot round-trip "was unset".
+    prior_spec = os.environ.get("BCG_TPU_SPEC")  # lint: ignore[BCG-ENV-RAW]
+    os.environ["BCG_TPU_SPEC"] = "0" if inject == "spec-off" else "1"
+    try:
+        engine = FakeEngine(
+            seed=0, policy="consensus",
+            fail_first_n_calls=(10**6 if inject == "fail-rows" else 0),
+        )
+        sched = Scheduler(
+            engine, linger_ms=400, bucket_rows=16,
+            max_queue_rows=4096, deadline_ms=0, strict_admission=False,
+        )
+        before = obs_counters.snapshot()
+        payload = [
+            ("agent system prompt",
+             "Round 2. agent_1 value: 17. agent_2 value: 17. "
+             "Your current value: 17. Decide.",
+             DECISION),
+        ] * 2
+        errors: List[BaseException] = []
+        row_counts = {"rows": 0, "error_rows": 0}
+        count_lock = threading.Lock()
+
+        def one_request():
+            try:
+                out = sched.submit_and_wait(
+                    ("json",), list(payload), [0.0] * 2, [64] * 2
+                )
+                bad = sum(
+                    1 for r in out if not isinstance(r, dict) or "error" in r
+                )
+                with count_lock:
+                    row_counts["rows"] += len(out)
+                    row_counts["error_rows"] += bad
+            except BaseException as e:  # collected, raised below
+                errors.append(e)
+
+        for _wave in range(2):
+            threads = [
+                threading.Thread(target=one_request) for _ in range(8)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        snap = sched.snapshot()
+        sched.close()
+        moved = obs_counters.delta(before)
+    finally:
+        if prior_spec is None:
+            os.environ.pop("BCG_TPU_SPEC", None)
+        else:
+            os.environ["BCG_TPU_SPEC"] = prior_spec
+    if errors:
+        raise errors[0]
+    drafted = moved.get("engine.spec.drafted", 0)
+    accepted = moved.get("engine.spec.accepted", 0)
+    dispatches = max(1, snap["dispatches"])
+    return {
+        "serve.completed_fraction": snap["completed"] / max(1, snap["submitted"]),
+        "serve.engine_errors": snap["engine_errors"],
+        "serve.error_row_fraction": (
+            row_counts["error_rows"] / max(1, row_counts["rows"])
+        ),
+        "serve.rows_per_dispatch": snap["dispatched_rows"] / dispatches,
+        "serve.spec_acceptance_rate": accepted / drafted if drafted else 0.0,
+    }
+
+
+def run_engine_scenario(inject: str = "none") -> Dict[str, float]:
+    """Tiny real-engine decision benchmark, plain vs speculative, at
+    temperature 0 (fully deterministic: fixed weights, fixed prompts) —
+    the counter-derived core of what BENCH measures on hardware."""
+    _force_cpu()
+    from bcg_tpu.config import EngineConfig
+    from bcg_tpu.engine.jax_engine import JaxEngine
+    from bcg_tpu.obs import counters as obs_counters
+
+    prompts = [
+        ("honest agent system prompt", "Round 3: propose a value", DECISION),
+        ("byzantine agent system prompt", "Round 3: vote now", VOTE),
+        ("honest agent system prompt", "Round 4: propose a value", DECISION),
+    ]
+
+    def cfg(**kw):
+        return EngineConfig(
+            backend="jax", model_name="bcg-tpu/tiny-test",
+            max_model_len=2048, **kw,
+        )
+
+    std = JaxEngine(cfg())
+    spec = JaxEngine(cfg(spec_decode=(inject != "spec-off")))
+    try:
+        r_std = std.batch_generate_json(prompts, temperature=0.0, max_tokens=80)
+        steps_std = std.total_decode_steps
+        before = obs_counters.snapshot()
+        r_spec = spec.batch_generate_json(prompts, temperature=0.0, max_tokens=80)
+        steps_spec = spec.total_decode_steps
+        moved = obs_counters.delta(before)
+        # Steady state: an identical-shape repeat call may compile
+        # NOTHING new — the retrace counters must not move.
+        before_warm = obs_counters.snapshot()
+        spec.batch_generate_json(prompts, temperature=0.0, max_tokens=80)
+        warm_moved = obs_counters.delta(before_warm)
+    finally:
+        std.shutdown()
+        spec.shutdown()
+    bad = sum(1 for r in r_std + r_spec if not isinstance(r, dict) or "error" in r)
+    drafted = moved.get("engine.spec.drafted", 0)
+    accepted = moved.get("engine.spec.accepted", 0)
+    retraces = sum(
+        v for k, v in warm_moved.items() if k.startswith("engine.retrace.")
+    ) + sum(
+        v for k, v in warm_moved.items() if k.startswith("engine.compile.")
+    )
+    decisions = len(prompts)
+    return {
+        "engine.decode_steps_per_decision": steps_spec / decisions,
+        "engine.spec_step_reduction": 1.0 - steps_spec / max(1, steps_std),
+        "engine.spec_acceptance_rate": accepted / drafted if drafted else 0.0,
+        "engine.steady_state_retraces": retraces,
+        "engine.error_rows": bad,
+    }
+
+
+def run_hlo_scenario(inject: str = "none") -> Dict[str, float]:
+    """Kernel-census drift findings (scripts/hlo_census.py) as a gated
+    metric — 0 findings = the lowered programs still match
+    hlo_baseline.json."""
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "hlo_census.py")
+    spec = importlib.util.spec_from_file_location("hlo_census", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    census = mod.run_scenario()
+    findings = mod.check_drift(census, mod.load_baseline())
+    for f in findings:
+        print(f"perf_gate[hlo]: {f}", file=sys.stderr)
+    return {"hlo.census_drift_findings": float(len(findings))}
+
+
+_RUNNERS = {
+    "serve": run_serve_scenario,
+    "engine": run_engine_scenario,
+    "hlo": run_hlo_scenario,
+}
+
+
+# ---------------------------------------------------------------- gating
+def load_baseline(path: Optional[str] = None) -> Optional[Dict]:
+    path = path or baseline_path()
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def _bounds(entry: Dict) -> str:
+    op = entry.get("op", "range")
+    value = float(entry["value"])
+    tol_rel = float(entry.get("tol_rel", 0.0))
+    tol_abs = float(entry.get("tol_abs", 0.0))
+    slack = abs(value) * tol_rel + tol_abs
+    if op == "min":
+        return f">= {value - slack:.4g}"
+    if op == "max":
+        return f"<= {value + slack:.4g}"
+    return f"within [{value - slack:.4g}, {value + slack:.4g}]"
+
+
+def check_metrics(measured: Dict[str, float], baseline: Optional[Dict]) -> List[str]:
+    """Findings (empty = green): banded comparison plus the
+    load-bearing-baseline contract (unbaselined measured metric and
+    stale baseline entry are both failures)."""
+    if baseline is None:
+        return [f"no baseline file at {baseline_path()} — run "
+                "scripts/perf_gate.py --update-baseline"]
+    entries = baseline.get("metrics", {})
+    findings: List[str] = []
+    for name, got in sorted(measured.items()):
+        entry = entries.get(name)
+        if entry is None:
+            findings.append(
+                f"{name}: measured {got:.4g} but metric has no entry in "
+                "perf_baseline.json — every gated metric needs a "
+                "justified baseline (run --update-baseline and add a reason)"
+            )
+            continue
+        op = entry.get("op", "range")
+        value = float(entry["value"])
+        tol_rel = float(entry.get("tol_rel", 0.0))
+        tol_abs = float(entry.get("tol_abs", 0.0))
+        slack = abs(value) * tol_rel + tol_abs
+        ok = (
+            got >= value - slack if op == "min"
+            else got <= value + slack if op == "max"
+            else value - slack <= got <= value + slack
+        )
+        if not ok:
+            findings.append(
+                f"{name}: measured {got:.4g}, required {_bounds(entry)} "
+                f"(baseline {value:.4g}, tol_rel={tol_rel}, "
+                f"tol_abs={tol_abs}) — {entry.get('reason', 'no reason')}"
+            )
+    return findings
+
+
+def check_stale(measured: Dict[str, float], baseline: Optional[Dict],
+                scenarios) -> List[str]:
+    """Baseline entries whose scenario ran but which nothing measured
+    (renamed/dropped metric = stale entry; a SKIPPED scenario's entries
+    are not stale)."""
+    if baseline is None:
+        return []
+    prefixes = tuple(f"{s}." for s in scenarios)
+    return [
+        f"perf_baseline.json entry {name!r} was not produced by its "
+        "scenario (stale — remove it, or restore the metric)"
+        for name in sorted(baseline.get("metrics", {}))
+        if name.startswith(prefixes) and name not in measured
+    ]
+
+
+def update_baseline(measured: Dict[str, float],
+                    path: Optional[str] = None) -> str:
+    path = path or baseline_path()
+    prior = load_baseline(path) or {}
+    prior_metrics = prior.get("metrics", {})
+    metrics = {}
+    for name, got in sorted(measured.items()):
+        old = prior_metrics.get(name, {})
+        metrics[name] = {
+            "value": round(float(got), 6),
+            "op": old.get("op", "range"),
+            "tol_rel": old.get("tol_rel", 0.15),
+            "tol_abs": old.get("tol_abs", 0.0),
+            "reason": old.get(
+                "reason",
+                "pinned by scripts/perf_gate.py --update-baseline; "
+                "justify intentional perf changes here",
+            ),
+        }
+    # Entries for scenarios that did not run this time survive untouched.
+    for name, entry in prior_metrics.items():
+        metrics.setdefault(name, entry)
+    data = {
+        "_comment": (
+            "Hermetic perf-gate baseline (scripts/perf_gate.py). Every "
+            "gated metric needs a justified entry; bounds are op "
+            "(min/max/range) with tol_rel/tol_abs slack. An unbaselined "
+            "measured metric and a stale entry are both gate failures — "
+            "the baseline is load-bearing, not a mute "
+            "(tests/test_perf_gate.py)."
+        ),
+        "metrics": dict(sorted(metrics.items())),
+    }
+    with open(path, "w") as f:
+        json.dump(data, f, indent=2)
+        f.write("\n")
+    return path
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="CPU-hermetic counter-derived perf gate "
+        "(FakeEngine serving + tiny real engine + HLO census drift)."
+    )
+    parser.add_argument("--scenarios", default=",".join(SCENARIOS),
+                        help=f"comma list of {SCENARIOS}")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="regenerate perf_baseline.json (keeps reasons/bands)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="print measured metrics as JSON")
+    parser.add_argument("--inject-regression", default="none",
+                        choices=REGRESSIONS,
+                        help="self-test: provoke a known regression and "
+                        "confirm the gate names it")
+    args = parser.parse_args(argv)
+
+    scenarios = tuple(s for s in args.scenarios.split(",") if s)
+    bad = [s for s in scenarios if s not in SCENARIOS]
+    if bad:
+        print(f"unknown scenarios {bad}; known: {SCENARIOS}", file=sys.stderr)
+        return 1
+    measured: Dict[str, float] = {}
+    for s in scenarios:
+        measured.update(_RUNNERS[s](args.inject_regression))
+    if args.as_json:
+        print(json.dumps(measured, indent=2, sort_keys=True))
+    else:
+        width = max(len(n) for n in measured)
+        for name, got in sorted(measured.items()):
+            print(f"{name:<{width}}  {got:.4f}")
+    if args.update_baseline:
+        path = update_baseline(measured)
+        print(f"baseline written: {path}", file=sys.stderr)
+        return 0
+    findings = check_metrics(measured, load_baseline())
+    findings += check_stale(measured, load_baseline(), scenarios)
+    for f in findings:
+        print(f"PERF REGRESSION: {f}", file=sys.stderr)
+    if findings:
+        return 2
+    print("perf gate green", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -107,8 +107,13 @@ def render_report(trace: dict, top: int = 20) -> str:
             f"(dropped {dropped} unbalanced event(s) at the ring edge)"
         )
     counters = (trace.get("otherData") or {}).get("counters") or {}
+    # engine.hlo.* and hbm.* gauges get their own sections below —
+    # ranked by raw value (op counts, FLOPs, byte totals) they would
+    # crowd every actual event counter out of the top-N list.
     ranked = sorted(
-        counters.items(), key=lambda kv: (-kv[1], kv[0])
+        ((k, v) for k, v in counters.items()
+         if not k.startswith(("engine.hlo.", "hbm."))),
+        key=lambda kv: (-kv[1], kv[0]),
     )[:max(0, top)]
     if ranked:
         lines.append("")
@@ -120,6 +125,62 @@ def render_report(trace: dict, top: int = 20) -> str:
     if spec_line:
         lines.append("")
         lines.append(spec_line)
+    hbm = hbm_ledger_section(counters)
+    if hbm:
+        lines.append("")
+        lines.append(hbm)
+    census = hlo_census_table(counters)
+    if census:
+        lines.append("")
+        lines.append(census)
+    return "\n".join(lines)
+
+
+def hbm_ledger_section(counters: Dict[str, float]) -> str:
+    """Compact hbm.* gauge listing (bcg_tpu/obs/ledger.py accounts), or
+    '' when the export carries none."""
+    rows = sorted(
+        (k, v) for k, v in counters.items() if k.startswith("hbm.")
+    )
+    if not rows:
+        return ""
+    name_w = max(len(k) for k, _ in rows)
+    lines = ["== hbm ledger gauges =="]
+    for name, value in rows:
+        lines.append(f"{name:<{name_w}}  {value:>16.0f}")
+    return "\n".join(lines)
+
+
+def hlo_census_table(counters: Dict[str, float]) -> str:
+    """Per-jit-entry kernel-census table rebuilt from the exported
+    ``engine.hlo.<entry>.<metric>`` gauges (bcg_tpu/obs/hlo.py), or ''
+    when the export carries none.  Kept bcg_tpu-import-free like the
+    rest of this report: the gauge names alone define the schema."""
+    rows: Dict[str, Dict[str, float]] = {}
+    for name, value in counters.items():
+        if not name.startswith("engine.hlo."):
+            continue
+        rest = name[len("engine.hlo."):]
+        entry, _, metric = rest.rpartition(".")
+        if entry:
+            rows.setdefault(entry, {})[metric] = value
+    if not rows:
+        return ""
+    cols = ("fusions", "custom_calls", "collectives", "step_ops",
+            "step_fusions", "total_ops", "flops", "bytes_accessed")
+    name_w = max(len("jit entry"), max(len(e) for e in rows))
+    lines = ["== hlo kernel census (engine.hlo.* gauges) =="]
+    lines.append(
+        f"{'jit entry':<{name_w}}  " + "  ".join(f"{c:>14}" for c in cols)
+    )
+    for entry in sorted(rows):
+        vals = []
+        for c in cols:
+            v = rows[entry].get(c)
+            vals.append("-" if v is None else f"{v:.0f}")
+        lines.append(
+            f"{entry:<{name_w}}  " + "  ".join(f"{v:>14}" for v in vals)
+        )
     return "\n".join(lines)
 
 

@@ -8,3 +8,7 @@ MODEL = os.environ["BENCH_MODEL"]                               # BCG-ENV-RAW
 
 def overridden():
     return "BENCH_QUANTIZATION" in os.environ                   # BCG-ENV-RAW
+
+
+def sticky_default():
+    return os.environ.setdefault("BCG_TPU_SPEC", "1")           # BCG-ENV-RAW

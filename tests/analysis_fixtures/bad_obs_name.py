@@ -1,0 +1,10 @@
+"""Seeded BCG-OBS-NAME violations: metric names off the taxonomy
+(3 findings)."""
+from bcg_tpu.obs import counters as obs_counters
+
+
+def record(entry):
+    obs_counters.inc("Serve.Requests")            # finding 1: uppercase
+    obs_counters.set_gauge("requests", 1)         # finding 2: one segment
+    obs_counters.inc(f"{entry}.retrace")          # finding 3: no static
+    #                                               subsystem prefix

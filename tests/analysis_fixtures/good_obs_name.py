@@ -1,0 +1,11 @@
+"""Clean metric-name idioms BCG-OBS-NAME must not flag."""
+from bcg_tpu.obs import counters as obs_counters
+
+
+def record(entry, name, account):
+    obs_counters.inc("serve.requests")                      # 2 segments
+    obs_counters.inc("engine.spec.drafted", 3)              # 3 segments
+    obs_counters.set_gauge("engine.hlo.decode_loop.fusions", 7)  # 4 segments
+    obs_counters.inc(f"engine.retrace.{entry}")             # prefixed f-string
+    obs_counters.set_gauge(f"hbm.{account}_bytes", 0)       # fragment chars ok
+    obs_counters.value(name)                                # variable: trusted

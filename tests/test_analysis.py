@@ -48,6 +48,7 @@ RULE_FIXTURES = {
     "BCG-MUT-DEFAULT": ("bad_mut_default.py", "good_mut_default.py"),
     "BCG-LOCK-CALL": ("bad_lock_call.py", "good_lock_call.py"),
     "BCG-TIME-WALL": ("bad_time_wall.py", "good_time_wall.py"),
+    "BCG-OBS-NAME": ("bad_obs_name.py", "good_obs_name.py"),
 }
 
 
@@ -82,7 +83,7 @@ class TestRuleFixtures:
         # a drop means a detection regression, not just "still fires".
         expected = {
             "BCG-HOST-SYNC": 4,
-            "BCG-ENV-RAW": 4,
+            "BCG-ENV-RAW": 5,
             "BCG-SHARD-DIVISOR": 3,
             "BCG-JIT-NP": 2,
             "BCG-JIT-BRANCH": 2,
@@ -94,6 +95,7 @@ class TestRuleFixtures:
             "BCG-JIT-DONATE": 1,
             "BCG-LOCK-CALL": 3,
             "BCG-TIME-WALL": 3,
+            "BCG-OBS-NAME": 3,
         }
         for rule_id, want in expected.items():
             bad, _ = RULE_FIXTURES[rule_id]
@@ -191,6 +193,35 @@ class TestRepoClean:
         )
         result = analyze_paths(baseline=[fake])
         assert fake in result.unused_baseline
+
+    def test_scan_scope_covers_scripts_and_bench(self):
+        # ISSUE-6 satellite: the ENV-RAW migration guarantee extends to
+        # scripts/ and bench.py — the default scan scope must include
+        # them, or a raw read added to a script escapes the whole suite.
+        from bcg_tpu.analysis.core import default_paths, iter_python_files
+
+        paths = default_paths()
+        names = {os.path.basename(p.rstrip(os.sep)) for p in paths}
+        assert "scripts" in names and "bench.py" in names
+        scanned = {
+            os.path.relpath(f, repo_root()).replace(os.sep, "/")
+            for f in iter_python_files(paths)
+        }
+        assert "scripts/hw_queue_report.py" in scanned
+        assert "scripts/scale_sweep.py" in scanned
+        assert "scripts/perf_gate.py" in scanned
+        assert "scripts/microbench_prefill.py" in scanned
+
+    def test_env_raw_fires_inside_scripts_scope(self, tmp_path):
+        # A seeded raw read placed under a scripts-shaped path is caught
+        # by the same analyze_paths call the repo meta-test uses.
+        scripts_dir = tmp_path / "scripts"
+        scripts_dir.mkdir()
+        (scripts_dir / "probe.py").write_text(
+            "import os\nMODE = os.environ.get('BCG_TPU_TIMING')\n"
+        )
+        findings = analyze_paths(paths=[str(scripts_dir)], baseline=None).findings
+        assert any(f.rule == "BCG-ENV-RAW" for f in findings)
 
     def test_cli_exits_zero_on_repo(self):
         proc = subprocess.run(

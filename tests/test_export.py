@@ -1,0 +1,273 @@
+"""Telemetry export (bcg_tpu/obs/export.py) + HBM ledger
+(bcg_tpu/obs/ledger.py).
+
+Covers the ISSUE-6 export satellites: Prometheus text-exposition
+conformance (HELP/TYPE lines, name sanitization, counter-vs-gauge
+typing, escaping), an end-to-end scrape of the HTTP endpoint during a
+FakeEngine serving run (serve counters + ledger gauges + seeded
+engine.hlo.* gauges all present), the request-lifecycle JSONL sink, and
+the ledger's charge/credit/headroom/reconcile semantics incl. the
+engine boot/shutdown integration.
+"""
+
+import json
+import re
+import urllib.request
+
+import pytest
+
+from bcg_tpu.obs import counters as obs_counters, export, hlo as obs_hlo
+from bcg_tpu.obs import ledger as obs_ledger
+from bcg_tpu.obs.ledger import HbmLedger
+
+_VALUE_LINE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]* -?[0-9.e+-]+$")
+
+
+class TestPrometheusFormat:
+    TYPED = {
+        "counters": {"serve.requests": 3, "engine.spec.drafted": 12},
+        "gauges": {"hbm.total_bytes": 1536.5, "engine.hlo.decode_loop.fusions": 7},
+    }
+
+    def test_help_type_value_triplets(self):
+        text = export.render_prometheus(self.TYPED)
+        lines = text.strip().splitlines()
+        assert len(lines) == 3 * 4
+        for i in range(0, len(lines), 3):
+            assert lines[i].startswith("# HELP ")
+            assert lines[i + 1].startswith("# TYPE ")
+            assert _VALUE_LINE.match(lines[i + 2]), lines[i + 2]
+            # HELP/TYPE/value agree on the metric name.
+            name = lines[i + 2].split(" ")[0]
+            assert lines[i].split(" ")[2] == name
+            assert lines[i + 1].split(" ")[2] == name
+
+    def test_counters_are_typed_counter_with_total_suffix(self):
+        text = export.render_prometheus(self.TYPED)
+        assert "# TYPE bcg_serve_requests_total counter" in text
+        assert "bcg_serve_requests_total 3" in text
+        assert "# TYPE bcg_engine_spec_drafted_total counter" in text
+
+    def test_gauges_are_typed_gauge_without_suffix(self):
+        text = export.render_prometheus(self.TYPED)
+        assert "# TYPE bcg_hbm_total_bytes gauge" in text
+        assert "bcg_hbm_total_bytes 1536.5" in text
+        assert "bcg_engine_hlo_decode_loop_fusions 7" in text
+
+    def test_name_sanitization(self):
+        assert export.prometheus_name("serve.linger_le_1ms") == \
+            "bcg_serve_linger_le_1ms"
+        assert export.prometheus_name("weird-name with spaces") == \
+            "bcg_weird_name_with_spaces"
+        assert export.prometheus_name("a.b", counter=True) == "bcg_a_b_total"
+
+    def test_help_escaping(self):
+        text = export.render_prometheus(
+            {"counters": {}, "gauges": {"x.back\\slash\nnewline": 1}}
+        )
+        help_line = [l for l in text.splitlines() if l.startswith("# HELP")][0]
+        assert "\\\\" in help_line        # backslash escaped
+        assert "\\n" in help_line         # newline escaped
+        assert "\n" not in help_line      # and not literal
+
+    def test_integer_values_render_bare(self):
+        text = export.render_prometheus(
+            {"counters": {"a.b": 5}, "gauges": {"c.d": 2.25}}
+        )
+        assert "bcg_a_b_total 5" in text
+        assert "bcg_c_d 2.25" in text
+
+    def test_empty_registry_renders_empty(self):
+        assert export.render_prometheus({"counters": {}, "gauges": {}}) == ""
+
+    def test_live_registry_roundtrip(self):
+        obs_counters.inc("export.test_counter")
+        obs_counters.set_gauge("export.test_gauge", 9)
+        text = export.render_prometheus()
+        assert "bcg_export_test_counter_total 1" in text
+        assert "bcg_export_test_gauge 9" in text
+
+
+class TestHttpEndpoint:
+    def test_scrape_during_fake_serving_run(self):
+        """Acceptance criterion: the endpoint serves engine.hlo.*,
+        ledger gauges, and serve request counters during a FakeEngine
+        serving run."""
+        from bcg_tpu.api import run_simulation
+        from bcg_tpu.engine.fake import FakeEngine
+        from bcg_tpu.serve.engine import ServingEngine
+
+        # Ledger + census gauges ride the same registry the serve run
+        # bumps: charge a synthetic params share and publish the
+        # checked-in decode_loop census (a FakeEngine lowers nothing).
+        obs_ledger.charge("params", "test-scrape", 123456)
+        obs_hlo.publish_gauges("decode_loop", {"fusions": 7, "step_ops": 42})
+        server, port = export.start_http_server(0)
+        try:
+            serving = ServingEngine(FakeEngine(seed=0), linger_ms=1)
+            out = run_simulation(n_agents=3, byzantine_count=0, max_rounds=1,
+                                 backend="fake", seed=0, engine=serving)
+            assert out["metrics"]["total_rounds"] >= 1
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10
+            ) as resp:
+                assert resp.status == 200
+                assert resp.headers["Content-Type"].startswith("text/plain")
+                body = resp.read().decode()
+            serving.shutdown()
+        finally:
+            server.shutdown()
+            server.server_close()
+            obs_ledger.credit("params", "test-scrape")
+        assert "bcg_serve_requests_total" in body
+        assert "bcg_serve_dispatches_total" in body
+        assert "bcg_hbm_params_bytes" in body
+        assert "bcg_hbm_total_bytes" in body
+        assert "bcg_engine_hlo_decode_loop_fusions 7" in body
+        assert "bcg_engine_hlo_decode_loop_step_ops 42" in body
+
+    def test_unknown_path_404(self):
+        server, port = export.start_http_server(0)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/nope", timeout=10
+                )
+            assert exc.value.code == 404
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_disabled_by_default(self, monkeypatch):
+        monkeypatch.delenv("BCG_TPU_METRICS_PORT", raising=False)
+        assert export.maybe_start_http_server() is None
+
+
+class TestEventSink:
+    def test_request_lifecycle_events(self, tmp_path, monkeypatch):
+        from bcg_tpu.engine.fake import FakeEngine
+        from bcg_tpu.serve.scheduler import AdmissionRejected, Scheduler
+
+        path = tmp_path / "events.jsonl"
+        monkeypatch.setenv("BCG_TPU_SERVE_EVENTS", str(path))
+        export.reset_sink()
+        try:
+            sched = Scheduler(FakeEngine(seed=0), linger_ms=1,
+                              bucket_rows=4, strict_admission=True)
+            schema = {
+                "type": "object",
+                "properties": {"decision": {
+                    "type": "string", "enum": ["stop", "continue"]}},
+                "required": ["decision"],
+            }
+            payload = [("s", "Round 1: vote", schema)]
+            out = sched.submit_and_wait(("json",), payload, [0.0], [16])
+            assert len(out) == 1
+            # Oversize under strict admission -> rejected event.
+            with pytest.raises(AdmissionRejected):
+                sched.submit_and_wait(("json",), payload * 5, [0.0] * 5,
+                                      [16] * 5)
+            sched.close()
+        finally:
+            export.reset_sink()
+        events = [json.loads(l) for l in path.read_text().splitlines()]
+        by_kind = {}
+        for e in events:
+            by_kind.setdefault(e["event"], []).append(e)
+        assert set(by_kind) >= {"admitted", "dispatched", "completed",
+                                "rejected"}
+        done = by_kind["completed"][0]
+        assert done["req_id"] == by_kind["admitted"][0]["req_id"]
+        assert done["rows"] == 1 and "device_ms" in done
+        assert "queue_wait_ms" in by_kind["dispatched"][0]
+        assert by_kind["rejected"][0]["rows"] == 5
+
+    def test_disabled_sink_is_noop(self, monkeypatch):
+        monkeypatch.delenv("BCG_TPU_SERVE_EVENTS", raising=False)
+        export.reset_sink()
+        try:
+            export.emit_event("admitted", req_id=1)  # must not raise
+        finally:
+            export.reset_sink()
+
+
+class TestLedger:
+    def test_charge_credit_idempotent(self):
+        led = HbmLedger(publish=False)
+        led.charge("params", "a", 100)
+        led.charge("params", "a", 150)   # re-charge replaces
+        led.charge("kv_cache", "b", 50)
+        assert led.total("params") == 150
+        assert led.total() == 200
+        led.credit("params", "a")
+        led.credit("params", "never-charged")  # no-op
+        assert led.total() == 50
+
+    def test_unknown_account_raises(self):
+        led = HbmLedger(publish=False)
+        with pytest.raises(KeyError):
+            led.charge("scratch", "k", 1)
+        with pytest.raises(KeyError):
+            led.credit("scratch", "k")
+
+    def test_headroom_and_snapshot(self):
+        led = HbmLedger(publish=False)
+        assert led.headroom() is None
+        led.set_limit(1000)
+        led.charge("params", "p", 600)
+        led.charge("spec_slots", "s", 100)
+        assert led.headroom() == 300
+        snap = led.snapshot()
+        assert snap["params_bytes"] == 600
+        assert snap["spec_slots_bytes"] == 100
+        assert snap["total_bytes"] == 700
+        assert snap["headroom_bytes"] == 300
+
+    def test_gauges_published_on_mutation(self):
+        obs_ledger.reset()
+        try:
+            obs_ledger.set_limit(10_000)
+            obs_ledger.charge("kv_cache", "t", 4_000)
+            snap = obs_counters.snapshot()
+            assert snap["hbm.kv_cache_bytes"] == 4_000
+            assert snap["hbm.total_bytes"] == 4_000
+            assert snap["hbm.limit_bytes"] == 10_000
+            assert snap["hbm.headroom_bytes"] == 6_000
+        finally:
+            obs_ledger.reset()
+
+    def test_reconcile_on_cpu_returns_none_readings(self):
+        led = HbmLedger(publish=False)
+        led.charge("params", "p", 10)
+        snap = led.reconcile()
+        # CPU backend exposes no allocator stats.
+        assert snap["device_bytes_in_use"] is None
+        assert snap["unaccounted_bytes"] is None
+        assert snap["total_bytes"] == 10
+
+    def test_engine_boot_charges_and_shutdown_credits(self):
+        from bcg_tpu.config import EngineConfig
+        from bcg_tpu.engine.jax_engine import JaxEngine
+
+        base = obs_ledger.LEDGER.total("params")
+        eng = JaxEngine(EngineConfig(
+            backend="jax", model_name="bcg-tpu/tiny-test", max_model_len=512,
+        ))
+        charged = obs_ledger.LEDGER.total("params") - base
+        assert charged == eng._param_bytes_per_device > 0
+        eng.shutdown()
+        assert obs_ledger.LEDGER.total("params") == base
+
+    def test_serve_snapshot_carries_hbm_block(self):
+        from bcg_tpu.engine.fake import FakeEngine
+        from bcg_tpu.serve.scheduler import Scheduler
+
+        obs_ledger.charge("params", "serve-test", 777)
+        try:
+            sched = Scheduler(FakeEngine(seed=0), linger_ms=1)
+            snap = sched.snapshot()
+            sched.close()
+        finally:
+            obs_ledger.credit("params", "serve-test")
+        assert snap["hbm"]["params_bytes"] >= 777
+        assert "headroom_bytes" in snap["hbm"]

@@ -1,0 +1,168 @@
+"""Hermetic perf gate (scripts/perf_gate.py + perf_baseline.json) in
+tier-1.
+
+The gate's contract, asserted here:
+
+* green at HEAD — both CPU scenarios (FakeEngine serving, tiny real
+  engine) measure inside every baseline band;
+* an injected regression (disabling spec-decode acceptance in the gate
+  scenario) FAILS with the metric and tolerance named in the message;
+* the baseline is load-bearing: every entry justified, every entry
+  matched by a measured metric, removing an entry resurfaces an
+  "unbaselined metric" failure (the lint_baseline.json idiom);
+* the script exits non-zero on regression (pipefail-composable).
+
+The ``hlo`` scenario is exercised by tests/test_hlo_census.py (same
+drift check, no double census cost here).
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "perf_gate.py")
+
+
+def _load_script():
+    spec = importlib.util.spec_from_file_location("perf_gate", SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def gate():
+    mod = _load_script()
+    measured = {}
+    measured.update(mod.run_serve_scenario())
+    measured.update(mod.run_engine_scenario())
+    return mod, measured
+
+
+class TestGreenAtHead:
+    def test_gate_passes(self, gate):
+        mod, measured = gate
+        findings = mod.check_metrics(measured, mod.load_baseline())
+        findings += mod.check_stale(
+            measured, mod.load_baseline(), ("serve", "engine")
+        )
+        assert findings == [], "\n".join(findings)
+
+    def test_scenarios_measure_the_advertised_metrics(self, gate):
+        _, measured = gate
+        for name in (
+            "engine.decode_steps_per_decision",
+            "engine.spec_step_reduction",
+            "engine.spec_acceptance_rate",
+            "engine.steady_state_retraces",
+            "serve.completed_fraction",
+            "serve.rows_per_dispatch",
+            "serve.spec_acceptance_rate",
+        ):
+            assert name in measured, sorted(measured)
+
+    def test_steady_state_retraces_are_zero(self, gate):
+        _, measured = gate
+        assert measured["engine.steady_state_retraces"] == 0
+
+    def test_speculation_reduces_decode_iterations(self, gate):
+        _, measured = gate
+        assert measured["engine.spec_step_reduction"] >= 0.30
+
+
+class TestInjectedRegression:
+    def test_spec_off_fails_with_named_metric_and_tolerance(self, gate):
+        """Acceptance criterion: disabling spec-decode acceptance in the
+        gate scenario fails the gate, and the failure message carries
+        the metric name and its tolerance band."""
+        mod, _ = gate
+        measured = mod.run_serve_scenario(inject="spec-off")
+        findings = mod.check_metrics(measured, mod.load_baseline())
+        hits = [f for f in findings if "serve.spec_acceptance_rate" in f]
+        assert hits, findings
+        assert "tol_rel" in hits[0] and ">=" in hits[0]
+
+    def test_failing_rows_fail_the_gate(self, gate):
+        mod, _ = gate
+        measured = mod.run_serve_scenario(inject="fail-rows")
+        findings = mod.check_metrics(measured, mod.load_baseline())
+        assert any("serve.error_row_fraction" in f for f in findings), findings
+
+
+class TestBaselineLoadBearing:
+    def test_every_entry_has_a_reason_and_band(self):
+        mod = _load_script()
+        baseline = mod.load_baseline()
+        assert baseline and baseline["metrics"]
+        for name, entry in baseline["metrics"].items():
+            assert entry.get("reason", "").strip(), name
+            assert entry.get("op") in ("min", "max", "range"), name
+            assert "value" in entry, name
+
+    def test_every_entry_is_matched_by_a_measurement(self, gate):
+        mod, measured = gate
+        baseline = mod.load_baseline()
+        hlo_entries = [
+            n for n in baseline["metrics"] if n.startswith("hlo.")
+        ]
+        assert hlo_entries == ["hlo.census_drift_findings"]
+        for name in baseline["metrics"]:
+            if name.startswith("hlo."):
+                continue  # exercised by tests/test_hlo_census.py
+            assert name in measured, name
+
+    def test_removing_an_entry_resurfaces_its_finding(self, gate):
+        mod, measured = gate
+        baseline = mod.load_baseline()
+        for removed in baseline["metrics"]:
+            if removed.startswith("hlo."):
+                continue
+            pruned = json.loads(json.dumps(baseline))
+            del pruned["metrics"][removed]
+            findings = mod.check_metrics(measured, pruned)
+            assert any(
+                removed in f and "no entry" in f for f in findings
+            ), (removed, findings)
+
+    def test_stale_entry_is_a_finding(self, gate):
+        mod, measured = gate
+        baseline = json.loads(json.dumps(mod.load_baseline()))
+        baseline["metrics"]["serve.ghost_metric"] = {
+            "value": 1, "op": "min", "reason": "synthetic",
+        }
+        stale = mod.check_stale(measured, baseline, ("serve", "engine"))
+        assert any("serve.ghost_metric" in f for f in stale), stale
+
+    def test_skipped_scenarios_entries_are_not_stale(self, gate):
+        mod, measured = gate
+        serve_only = {
+            k: v for k, v in measured.items() if k.startswith("serve.")
+        }
+        stale = mod.check_stale(serve_only, mod.load_baseline(), ("serve",))
+        assert stale == [], stale
+
+
+class TestScriptExitCodes:
+    def test_green_scenario_exits_zero(self):
+        proc = subprocess.run(
+            [sys.executable, SCRIPT, "--scenarios", "serve"],
+            cwd=REPO, capture_output=True, text=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_injected_regression_exits_nonzero_and_names_metric(self):
+        proc = subprocess.run(
+            [sys.executable, SCRIPT, "--scenarios", "serve",
+             "--inject-regression", "spec-off"],
+            cwd=REPO, capture_output=True, text=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 2, proc.stdout + proc.stderr
+        assert "serve.spec_acceptance_rate" in proc.stderr
+        assert "PERF REGRESSION" in proc.stderr

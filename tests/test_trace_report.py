@@ -81,6 +81,62 @@ def test_report_derives_spec_acceptance(tmp_path):
     assert "speculative" not in proc2.stdout
 
 
+def test_report_renders_hlo_census_table(tmp_path):
+    """engine.hlo.* gauges in an export render as the per-jit-entry
+    kernel-census table — still with no bcg_tpu import (the report must
+    read a trace copied off a TPU host anywhere)."""
+    trace = {
+        "traceEvents": [],
+        "otherData": {"counters": {
+            "engine.hlo.decode_loop.fusions": 114,
+            "engine.hlo.decode_loop.custom_calls": 0,
+            "engine.hlo.decode_loop.collectives": 0,
+            "engine.hlo.decode_loop.step_ops": 297,
+            "engine.hlo.decode_loop.step_fusions": 77,
+            "engine.hlo.decode_loop.total_ops": 443,
+            "engine.hlo.decode_loop.flops": 1750287.0,
+            "engine.hlo.decode_loop.bytes_accessed": 4306799.0,
+            "engine.hlo.prefill.fusions": 29,
+            "engine.hlo.prefill.total_ops": 130,
+            "hbm.params_bytes": 1650000000,
+            "hbm.total_bytes": 1650000000,
+            "serve.requests": 12,
+        }},
+    }
+    path = tmp_path / "census_trace.json"
+    path.write_text(json.dumps(trace))
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, str(path)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "hlo kernel census" in proc.stdout
+    assert "decode_loop" in proc.stdout and "prefill" in proc.stdout
+    # hbm gauges get their own section AND stay out of the ranked
+    # top-counter list (their byte values would crowd event counters
+    # out — serve.requests must survive at the top).
+    assert "hbm ledger gauges" in proc.stdout
+    assert "hbm.params_bytes" in proc.stdout
+    top_section = proc.stdout.split("top counters")[1].split("\n==")[0]
+    assert "serve.requests" in top_section
+    assert "hbm.params_bytes" not in top_section
+    assert "engine.hlo" not in top_section
+    # Row values land under their columns (spot-check the step family).
+    row = [l for l in proc.stdout.splitlines() if l.startswith("decode_loop")][0]
+    assert "297" in row and "77" in row and "114" in row
+    # The script itself stays dependency-free.
+    src = open(SCRIPT).read()
+    assert "import bcg_tpu" not in src and "from bcg_tpu" not in src
+    # No census gauges -> no census section.
+    bare = tmp_path / "bare2.json"
+    bare.write_text(json.dumps({"traceEvents": [], "otherData": {}}))
+    proc2 = subprocess.run(
+        [sys.executable, SCRIPT, str(bare)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert "hlo kernel census" not in proc2.stdout
+
+
 def test_report_handles_empty_trace(tmp_path):
     empty = tmp_path / "empty.json"
     empty.write_text(json.dumps({"traceEvents": [], "otherData": {}}))
