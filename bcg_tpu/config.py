@@ -112,6 +112,27 @@ class EngineConfig:
     # KV across every round's calls (auto-disabled for template families
     # whose prefix/suffix split is not a special-token boundary).
     prefix_caching: bool = True
+    # Block-paged KV cache with radix-tree prefix sharing
+    # (engine/paged_kv.py + ops/paged_attention.py): replaces the per-row
+    # dense KV slab with a preallocated block pool plus per-row block
+    # tables; prompt prefixes shared across rows/rounds (system prompt,
+    # accumulated round history) are matched by TOKEN CONTENT in a radix
+    # index, stored once, and referenced N times — only each row's short
+    # tail prefills.  Greedy output is token-identical to the dense path
+    # (tested); admission derives from free blocks instead of the dense
+    # worst-case slab.  Opt-in during the transition (env override
+    # BCG_TPU_PAGED_KV=1); requires sequence_parallel_size == 1 and
+    # prefill_chunk == 0.
+    paged_kv: bool = False
+    # Tokens per KV block (env override BCG_TPU_KV_BLOCK_SIZE).  Smaller
+    # blocks share finer prefixes but widen block tables; 16 balances
+    # the two at BCG prompt scales (a future Pallas paged kernel wants
+    # multiples of the TPU lane count — see DESIGN.md).
+    kv_block_size: int = 16
+    # Pool size in blocks (0 = auto: sized from the HBM budget when the
+    # device exposes a limit, else a CPU-test allowance; env override
+    # BCG_TPU_KV_POOL_BLOCKS).
+    kv_pool_blocks: int = 0
     # Chunked prefill: process full-prompt prefills in slices of this
     # many tokens (0 = one pass).  Caps activation memory at
     # O(batch * chunk) — required to serve 8B-class models on a single

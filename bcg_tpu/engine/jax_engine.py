@@ -428,6 +428,25 @@ class JaxEngine(InferenceEngine):
                 f"prefill_chunk={self.prefill_chunk}: expected 0 (disabled) "
                 "or a positive token count"
             )
+        # Block-paged KV cache (engine/paged_kv.py + ops/paged_attention):
+        # per-row block tables over one preallocated pool; prompt
+        # prefixes shared across rows/rounds are radix-matched by token
+        # content, stored once, referenced N times.  Env flag as the
+        # bench/sweep override; the pool itself is allocated after the
+        # weights (its auto-sizing needs the weight bytes + mem limit).
+        self.paged_kv = (
+            bool(getattr(config, "paged_kv", False))
+            or env_flag("BCG_TPU_PAGED_KV")
+        )
+        self._paged = None
+        self._paged_call_private: List[int] = []
+        self._paged_dirty = False
+        self._paged_toks_memo: Dict[str, np.ndarray] = {}
+        if self.paged_kv and self.prefill_chunk:
+            raise ValueError(
+                "paged_kv does not compose with prefill_chunk yet; the "
+                "paged suffix prefill is single-pass — disable one"
+            )
 
         quant_mode = config.quantization  # None | "int8" | "int4"
         quantize = quant_mode is not None
@@ -791,6 +810,40 @@ class JaxEngine(InferenceEngine):
         # credits exactly what this instance charged.
         obs_ledger.set_limit(self._mem_limit)
         obs_ledger.charge("params", id(self), self._param_bytes_per_device)
+        if self.paged_kv:
+            if self._sp_devices > 1:
+                raise ValueError(
+                    "paged_kv does not compose with sequence parallelism "
+                    f"(sp={self._sp_devices}) yet: pool blocks are shared "
+                    "across rows so the sequence dim cannot shard"
+                )
+            from bcg_tpu.engine.paged_kv import PagedKV
+            from bcg_tpu.models.transformer import prefill_paged
+
+            bs_blk = (
+                _get_int("BCG_TPU_KV_BLOCK_SIZE")
+                or int(getattr(config, "kv_block_size", 16) or 16)
+            )
+            pool_blocks = (
+                _get_int("BCG_TPU_KV_POOL_BLOCKS")
+                or int(getattr(config, "kv_pool_blocks", 0) or 0)
+            )
+            if pool_blocks <= 0:
+                pool_blocks = self._auto_pool_blocks(bs_blk)
+            self._paged = PagedKV(
+                self.spec, pool_blocks, bs_blk,
+                quantized=self.kv_quantized, stacked=self.scan_layers,
+                mesh=mesh,
+            )
+            # The radix-resident working set is the paged successor of
+            # the dense prefix cache — same ledger account, same
+            # engine-keyed idempotent charge, credited by shutdown().
+            self._paged.set_ledger_key(id(self))
+            self._prefill_paged = jax.jit(
+                partial(prefill_paged, spec=self.spec,
+                        impl=self.attention_impl),
+                donate_argnames=("cache",),
+            )
         # Telemetry endpoint (BCG_TPU_METRICS_PORT): idempotent, off by
         # default — a scraped deployment gets engine.hlo.* / hbm.* /
         # serve.* without further wiring.
@@ -965,6 +1018,8 @@ class JaxEngine(InferenceEngine):
         # their (padded) positions must count toward prefill_tokens or
         # miss-heavy windows understate MFU (advisor round-2).
         self.prefill_tokens += Pb
+        obs_counters.inc("engine.prefill.positions_padded", Pb)
+        obs_counters.inc("engine.prefill.positions_real", len(toks))
         # "toks" rides along for the speculative drafter's history
         # buffer (prompt-lookup matches against the FULL prompt, and the
         # prefix tokens are otherwise only present as cached KV).
@@ -1175,6 +1230,8 @@ class JaxEngine(InferenceEngine):
         # Counted for the same reason as in _get_prefix_entry: this
         # prefill happens inside the caller's prefill timing window.
         self.prefill_tokens += Cb
+        obs_counters.inc("engine.prefill.positions_padded", Cb)
+        obs_counters.inc("engine.prefill.positions_real", len(core_toks))
         entry = {
             "kv": kv,
             "valid": np.concatenate([pv[0], cvalid[0]]),
@@ -1355,6 +1412,281 @@ class JaxEngine(InferenceEngine):
             prefix_toks.append(e["toks"])
         return (tokens, valid, Ls, cache, prefix_valid, prefix_lens,
                 prefix_toks, P, P + tail)
+
+    # ----------------------------------------------------------- paged assembly
+
+    @staticmethod
+    def _rightpad_tokens(
+        token_lists, limits: List[int], bucket_ladder: Tuple[int, ...],
+    ) -> Tuple[np.ndarray, np.ndarray, int]:
+        """RIGHT-pad per-row token lists (already truncated to their row
+        limits) into a bucketed [B, L] batch — the paged counterpart of
+        :meth:`_encode_leftpad`: tokens left-ALIGNED so full real-token
+        blocks are radix-insertable (see ``transformer.prefill_paged``).
+        Same ladder semantics (doubling extension past the static tail,
+        clamp to the largest row limit)."""
+        max_len = max((len(t) for t in token_lists), default=0)
+        max_limit = max(limits)
+        buckets = list(bucket_ladder)
+        while buckets[-1] < max_limit:
+            buckets.append(buckets[-1] * 2)
+        L = next((b for b in buckets if b >= max_len), max_limit)
+        L = max(min(L, max_limit), max_len, 1)
+        B = len(token_lists)
+        tokens = np.zeros((B, L), dtype=np.int32)
+        valid = np.zeros((B, L), dtype=bool)
+        for i, toks in enumerate(token_lists):
+            tokens[i, : len(toks)] = toks
+            valid[i, : len(toks)] = True
+        return tokens, valid, L
+
+    def _paged_tokens(self, text: str) -> np.ndarray:
+        """Tokenize (memoized — radix keys are token arrays, and every
+        batch re-derives its entries)."""
+        toks = self._paged_toks_memo.get(text)
+        if toks is None:
+            toks = np.asarray(self.tokenizer.encode(text), dtype=np.int32)
+            self._paged_toks_memo[text] = toks
+            if len(self._paged_toks_memo) > 512:
+                # Same retention bound as the dense length memo: keyed
+                # by multi-KB prompt strings, a long-lived process would
+                # otherwise hold every prompt ever seen.
+                self._paged_toks_memo = dict(
+                    list(self._paged_toks_memo.items())[-256:]
+                )
+        return toks
+
+    def _get_paged_entry(self, text: str, limit: int) -> Optional[Dict[str, Any]]:
+        """Resolve a cachable prompt prefix against the radix index:
+        longest full-block match, then ONE B=1 prefill of the unmatched
+        remainder (up to the last full block boundary) into fresh blocks
+        grafted onto the tree — the paged successor of
+        :meth:`_get_prefix_entry`/:meth:`_get_core_entry`, with string
+        keys replaced by token content (two different prefixes share
+        exactly their common token-prefix blocks, and round ``r``'s
+        grown history extends round ``r-1``'s chain).  The sub-block
+        leftover (< block_size tokens) is returned for the caller's
+        per-row suffix.  Returns None when the prefix cannot fit the
+        prompt window (caller falls back to the uncached paged path)."""
+        mgr = self._paged
+        bs = mgr.block_size
+        toks = self._paged_tokens(text)
+        if toks.size == 0 or len(toks) > limit - 64:
+            return None
+        path, blocks = mgr.lookup(toks)
+        mgr.pin(path)
+        matched = len(blocks) * bs
+        full_end = (len(toks) // bs) * bs
+        if full_end > matched:
+            Lr = full_end - matched
+            # Bucket the build chunk for stable compile shapes; the pad
+            # tail lands in scratch blocks freed at call end.
+            Lr_pad = next((b for b in self._suffix_buckets if b >= Lr), Lr)
+            Lr_pad = -(-Lr_pad // bs) * bs
+            Pm_pad = 0
+            if matched:
+                Pm_rung = next(
+                    (b for b in _PREFIX_BUCKETS if b >= matched), matched
+                )
+                Pm_pad = -(-Pm_rung // bs) * bs
+            n_real = Lr // bs
+            new_ids = mgr.alloc(Lr_pad // bs)
+            # Provisional ownership: freed by the call's finally unless
+            # the insert below grafts them into the radix tree.
+            self._paged_call_private.extend(new_ids)
+            tbl = np.zeros((1, Pm_pad // bs + Lr_pad // bs), dtype=np.int32)
+            tbl[0, : len(blocks)] = blocks
+            tbl[0, Pm_pad // bs:] = new_ids
+            tokens = np.zeros((1, Lr_pad), dtype=np.int32)
+            tokens[0, :Lr] = toks[matched:full_end]
+            valid = np.zeros((1, Lr_pad), dtype=bool)
+            valid[0, :Lr] = True
+            pv = np.zeros((1, Pm_pad), dtype=bool)
+            pv[0, :matched] = True
+            cache = mgr.entries(tbl)
+            self._paged_dirty = True
+            _, cache = obs_hlo.wrap("prefill_paged", self._prefill_paged)(
+                self.params, tokens=jnp.asarray(tokens),
+                valid=jnp.asarray(valid), cache=cache,
+                prefix_valid=jnp.asarray(pv),
+                prefix_lens=jnp.asarray([matched], np.int32),
+            )
+            mgr.adopt(cache)
+            self._paged_dirty = False
+            grafted = mgr.insert(path, toks, matched, new_ids[:n_real])
+            kept = {node.block for node in grafted}
+            # Everything not grafted is dead the moment insert returns —
+            # the scratch pad tail AND any duplicate-content blocks.
+            # Free them NOW rather than in the call's finally: holding
+            # them would inflate peak pool demand past what cap_for
+            # admission accounts for (B cold entries x ~bucket-padding
+            # blocks), hard-failing admitted batches with PoolExhausted.
+            dead = set(new_ids) - kept
+            mgr.free(dead)
+            self._paged_call_private = [
+                i for i in self._paged_call_private
+                if i not in kept and i not in dead
+            ]
+            path = path + grafted
+            blocks = [node.block for node in path]
+            # Entry builds run inside the caller's prefill window — same
+            # accounting rationale as the dense entry builds.
+            self.prefill_tokens += Lr_pad
+            obs_counters.inc("engine.prefill.positions_padded", Lr_pad)
+            obs_counters.inc("engine.prefill.positions_real", Lr)
+        return {
+            "blocks": blocks,
+            "len": full_end,
+            "toks": toks[:full_end],
+            "leftover": toks[full_end:],
+        }
+
+    def _prepare_paged_batch(self, parts, budgets: List[int],
+                             decode_slots: int):
+        """Assemble a batch over the block pool: per-row block tables of
+        radix-shared prefix blocks (padded with the null block to a
+        bucketed prefix region) plus freshly allocated private blocks
+        for the suffix window and decode tail.  Handles BOTH prompt
+        paths — radix-cached prefixes when the batch qualifies (same
+        safety conditions as the dense prefix cache), else the whole
+        prompt as suffix over private blocks — so paged engines never
+        fall back to dense slabs."""
+        mgr = self._paged
+        bs = mgr.block_size
+        B = len(parts)
+        limits = [self.max_model_len - b - 1 for b in budgets]
+        if min(limits) < 1:
+            raise BudgetError(
+                f"max_tokens={max(budgets)} leaves no room for a prompt "
+                f"within max_model_len={self.max_model_len}"
+            )
+        limit = self.max_model_len - max(budgets) - 1
+        cacheable = (
+            self.prefix_caching and self._prefix_safe
+            and all(p for p, _, _ in parts)
+        )
+        rows = None
+        entries: Optional[Dict[Tuple[str, str], Dict[str, Any]]] = None
+        if cacheable:
+            # Seam safety decides per ROW whether its core is usable —
+            # identical policy to _prepare_prefixed_batch.
+            rows = []
+            seam_memo: Dict[Tuple[str, str], bool] = {}
+            for p, c, t in parts:
+                if c:
+                    ok = seam_memo.get((c, t))
+                    if ok is None:
+                        ok = self._core_seam_safe(c, t)
+                        seam_memo[(c, t)] = ok
+                    rows.append((p, c, t) if ok else (p, "", c + t))
+                else:
+                    rows.append((p, "", t))
+            entries = {}
+            for p, c, _ in rows:
+                if (p, c) in entries:
+                    continue
+                e = self._get_paged_entry(p + c, limit)
+                if e is None:
+                    entries = None
+                    break
+                entries[(p, c)] = e
+            if entries is None:
+                cacheable = False
+                self.prefix_fallbacks += 1
+                if not self._prefix_fallback_warned:
+                    import warnings
+
+                    warnings.warn(
+                        "radix prefix sharing disengaged for this batch "
+                        "(prefix too long for the prompt window) — the "
+                        "whole prompt prefills into private blocks; "
+                        "further fallbacks are counted in "
+                        "engine.prefix_fallbacks",
+                        stacklevel=2,
+                    )
+                    self._prefix_fallback_warned = True
+        if cacheable:
+            res = [entries[(p, c)] for p, c, _ in rows]
+            suffix_toks = [
+                list(e["leftover"]) + list(self._paged_tokens(t))
+                for e, (_, _, t) in zip(res, rows)
+            ]
+            ladder = self._suffix_buckets
+        else:
+            res = [None] * B
+            suffix_toks = [
+                list(self._paged_tokens(p + c + t)) for p, c, t in parts
+            ]
+            ladder = _LEN_BUCKETS
+        res_lens = [e["len"] if e else 0 for e in res]
+        max_res = max(res_lens)
+        P = 0
+        if max_res:
+            P_rung = next(
+                (b for b in _PREFIX_BUCKETS if b >= max_res and b <= limit),
+                # Clamp idiom (see _prepare_prefixed_batch): the entry
+                # guard bounds max_res <= limit - 64, so the clamp fits.
+                max(max_res, limit - 64),
+            )
+            P = -(-P_rung // bs) * bs
+        limits_s = [l - P for l in limits]
+        if min(limits_s) < 1:
+            # A mixed-budget row cannot fit any suffix past the shared
+            # prefix region: serve the batch uncached instead (the
+            # dense path's None-return, without abandoning paging).
+            # Counted + warned like every other prefix disengagement —
+            # a deployment hitting this on every batch loses the
+            # sharing win N-fold and must not look cache-healthy.
+            self.prefix_fallbacks += 1
+            if not self._prefix_fallback_warned:
+                import warnings
+
+                warnings.warn(
+                    "radix prefix sharing disengaged for this batch (a "
+                    "row's token budget leaves no suffix room past the "
+                    "shared prefix region) — the whole prompt prefills "
+                    "into private blocks; further fallbacks are counted "
+                    "in engine.prefix_fallbacks",
+                    stacklevel=2,
+                )
+                self._prefix_fallback_warned = True
+            for i in range(B):
+                res[i] = None
+                res_lens[i] = 0
+            suffix_toks = [
+                list(self._paged_tokens(p + c + t)) for p, c, t in parts
+            ]
+            ladder = _LEN_BUCKETS
+            P = 0
+            limits_s = limits
+            cacheable = False
+        suffix_toks = [
+            t[-lim:] for t, lim in zip(suffix_toks, limits_s)
+        ]
+        tokens, valid, Ls = self._rightpad_tokens(suffix_toks, limits_s, ladder)
+        S = P + Ls + decode_slots
+        S += (-S) % bs
+        nblk = S // bs
+        n_priv = (S - P) // bs
+        priv = mgr.alloc(B * n_priv)
+        self._paged_call_private.extend(priv)
+        tbl = np.zeros((B, nblk), dtype=np.int32)
+        prefix_valid = np.zeros((B, P), dtype=bool)
+        prefix_lens = np.zeros((B,), dtype=np.int32)
+        prefix_toks = []
+        for i in range(B):
+            e = res[i]
+            if e is not None:
+                tbl[i, : len(e["blocks"])] = e["blocks"]
+                prefix_valid[i, : e["len"]] = True
+                prefix_lens[i] = e["len"]
+                prefix_toks.append(e["toks"])
+            else:
+                prefix_toks.append(np.zeros(0, dtype=np.int32))
+            tbl[i, P // bs:] = priv[i * n_priv:(i + 1) * n_priv]
+        cache = mgr.entries(tbl)
+        return (tokens, valid, Ls, cache, prefix_valid, prefix_lens,
+                prefix_toks, P, S, tbl)
 
     # ------------------------------------------------------------ decode loop
 
@@ -1883,6 +2215,22 @@ class JaxEngine(InferenceEngine):
                 parts, batch, sig_prefix, real_B, temps, budgets, top_p
             )
         finally:
+            if self._paged is not None:
+                if self._paged_dirty:
+                    # A jit call raised AFTER donating the pool: the old
+                    # buffers are dead and the radix's resident blocks
+                    # with them — reallocate a zeroed pool so the engine
+                    # stays serviceable (working set re-prefills).
+                    self._paged_dirty = False
+                    self._paged_call_private = []
+                    self._paged.invalidate()
+                else:
+                    # Release this call's private (suffix/decode) blocks
+                    # and the refcount pins on its radix paths — shared
+                    # prefix blocks stay resident for the next round.
+                    self._paged.free(self._paged_call_private)
+                    self._paged_call_private = []
+                    self._paged.unpin_all()
             obs_ledger.credit("kv_cache", id(self))
             obs_ledger.credit("spec_slots", id(self))
             if self._mem_limit is not None:
@@ -1933,7 +2281,33 @@ class JaxEngine(InferenceEngine):
         t0 = time.perf_counter()
         with obs_tracer.span("engine.prefill", args={"rows": B}):
             prepped = None
-            if self.prefix_caching and self._prefix_safe and all(p for p, _, _ in parts):
+            paged = self._paged is not None
+            if paged:
+                # Block-paged path: radix-shared prefix blocks + private
+                # suffix/decode blocks per row; the pool rides the jit
+                # calls via donation and is re-adopted after each.
+                (tokens, valid, Ls, cache, prefix_valid, prefix_lens,
+                 prefix_toks, P, S, _tbl) = self._prepare_paged_batch(
+                    parts, budgets, decode_slots
+                )
+                self._paged_dirty = True
+                first_logits, cache = obs_hlo.wrap(
+                    "prefill_paged", self._prefill_paged
+                )(
+                    self.params, tokens=self._put_batch(tokens),
+                    valid=self._put_batch(valid), cache=cache,
+                    prefix_valid=self._put_batch(prefix_valid),
+                    prefix_lens=self._put_batch(prefix_lens),
+                )
+                self._paged.adopt(cache)
+                self._paged_dirty = False
+                cache = self._paged.entries(_tbl)
+                L = P + Ls
+                valid_mask = np.zeros((B, S), dtype=bool)
+                valid_mask[:, :P] = prefix_valid
+                valid_mask[:, P:L] = valid
+                prompt_lens = (prefix_lens + valid.sum(axis=1)).astype(np.int32)
+            elif self.prefix_caching and self._prefix_safe and all(p for p, _, _ in parts):
                 prepped = self._prepare_prefixed_batch(parts, budgets, decode_slots)
                 if prepped is None:
                     self.prefix_fallbacks += 1
@@ -1964,7 +2338,7 @@ class JaxEngine(InferenceEngine):
                 valid_mask[:, :P] = prefix_valid
                 valid_mask[:, P:L] = valid
                 prompt_lens = (prefix_lens + valid.sum(axis=1)).astype(np.int32)
-            else:
+            elif not paged:
                 prefix_toks = None
                 full_prompts = [p + c + t for p, c, t in parts]
                 tokens, valid, L = self._prepare_batch(full_prompts, budgets)
@@ -1983,8 +2357,17 @@ class JaxEngine(InferenceEngine):
             # window / fast-forward's compacted tail, the slots past
             # max_new+1).  Per-device bytes via the same placement
             # function admission uses; credited by _decode_batch's
-            # finally.
-            slab = self._kv_bytes_per_device(B, S)
+            # finally.  The paged path charges its PRIVATE blocks only —
+            # the radix-shared prefix region already lives in the
+            # prefix_cache account, which is the HBM-side shape of the
+            # sharing win (N rows, one prefix charge).
+            if paged:
+                slab = (
+                    B * ((S - P) // self._paged.block_size)
+                    * self._paged.block_bytes_dev
+                )
+            else:
+                slab = self._kv_bytes_per_device(B, S)
             extra = max(0, decode_slots - (max_new + 1))
             spec_part = int(slab * extra / S) if S else 0
             obs_ledger.charge("kv_cache", id(self), slab - spec_part)
@@ -2009,8 +2392,21 @@ class JaxEngine(InferenceEngine):
             # tuple that decides whether jax.jit re-traces.
             self._note_jit_shape(
                 "prefill",
-                (("suffix", B, Ls, P, S) if prepped is not None
+                (("paged", B, Ls, P, S) if paged
+                 else ("suffix", B, Ls, P, S) if prepped is not None
                  else ("full", B, L, S)),
+            )
+            # Prefill-position counters, split real vs padded (pads cost
+            # FLOPs but are not progress — cache-hit savings must be
+            # measurable without pad noise; entry builds count in their
+            # creators).  `prefill_tokens` keeps its documented
+            # padded-positions semantics for bench compatibility.
+            obs_counters.inc(
+                "engine.prefill.positions_padded",
+                B * (L if (prepped is None and not paged) else Ls),
+            )
+            obs_counters.inc(
+                "engine.prefill.positions_real", int(valid.sum())
             )
             # Always sync here: prefill/decode wall-clock split feeds the
             # achieved-GB/s / MFU accounting (the extra host round-trip is a
@@ -2020,12 +2416,21 @@ class JaxEngine(InferenceEngine):
 
         self._key, sub = jax.random.split(self._key)
         drafted = accepted = None
+        # HLO-census entry names: the paged loops lower different
+        # programs (block gather/scatter), so they pin under their own
+        # names instead of drifting the dense entries.
+        census_prefix = "paged_" if paged else ""
+        if paged:
+            self._paged_dirty = True  # pool rides the donated loop call
         with obs_tracer.span("engine.decode",
                              args={"rows": B, "max_new": max_new}):
             if use_spec:
-                loop = obs_hlo.wrap("spec_decode_loop", self._get_spec_decode_loop(
-                    sig_prefix + (B, L), max_new, top_p
-                ))
+                loop = obs_hlo.wrap(
+                    census_prefix + "spec_decode_loop",
+                    self._get_spec_decode_loop(
+                        sig_prefix + (B, L), max_new, top_p
+                    ),
+                )
                 with obs_tracer.span(
                     "engine.spec_verify",
                     args={"rows": B, "k": self.spec_k,
@@ -2046,7 +2451,7 @@ class JaxEngine(InferenceEngine):
                     )
             elif use_ff:
                 loop = obs_hlo.wrap(
-                    "ff_decode_loop",
+                    census_prefix + "ff_decode_loop",
                     self._get_ff_decode_loop(sig_prefix + (B, L), max_new, top_p),
                 )
                 out, (_, steps), _cache_out = loop(
@@ -2063,7 +2468,7 @@ class JaxEngine(InferenceEngine):
                 )
             else:
                 loop = obs_hlo.wrap(
-                    "decode_loop",
+                    census_prefix + "decode_loop",
                     self._get_decode_loop(sig_prefix + (B, L), max_new, top_p),
                 )
                 out, (_, steps), _cache_out = loop(
@@ -2077,7 +2482,13 @@ class JaxEngine(InferenceEngine):
                     self._put_batch(np.asarray(budgets, np.int32)),
                     sub,
                 )
-            del _cache_out  # dropped immediately; exists only for aliasing
+            if paged:
+                # The loop wrote decode KV into private pool blocks
+                # through the donated carry: retain the returned pool
+                # (the pre-call buffers are dead).
+                self._paged.adopt(_cache_out)
+                self._paged_dirty = False
+            del _cache_out  # dense: dropped immediately (aliasing only)
             out_np = np.asarray(out)
         t2 = time.perf_counter()
         if not self._first_call_recorded:
@@ -2109,7 +2520,7 @@ class JaxEngine(InferenceEngine):
         # slots, masked), plus one full weight pass per loop iteration.
         spec = self.spec
         slot_bytes = self._kv_slot_bytes
-        self.prefill_tokens += B * (L if prepped is None else Ls)
+        self.prefill_tokens += B * (L if (prepped is None and not paged) else Ls)
         self.prefill_seconds += t1 - t0
         self.decode_seconds += t2 - t1
         self.decode_kv_bytes += int(steps) * B * S * slot_bytes * spec.num_layers
@@ -2124,7 +2535,7 @@ class JaxEngine(InferenceEngine):
                 f"steps={int(steps)} "
                 f"prompt_max={int(prompt_lens.max())} "
                 f"prefill={t1 - t0:.2f}s decode={t2 - t1:.2f}s "
-                f"prefix={'hit' if prepped is not None else 'miss'} "
+                f"prefix={'hit' if (prepped is not None or (paged and P)) else 'miss'} "
                 f"prefix_fallbacks={self.prefix_fallbacks}",
                 flush=True, file=_sys.stderr,
             )
@@ -2205,9 +2616,42 @@ class JaxEngine(InferenceEngine):
         b = max(1, self.max_model_len - 2)
         return (self.max_model_len - b - 1) + self._decode_reserve(b)
 
+    def _auto_pool_blocks(self, block_size: int) -> int:
+        """Paged-pool auto-sizing: the WHOLE KV budget becomes one pool.
+        With a known device limit that is the ``hbm_utilization``
+        fraction minus the weight shard — unlike the dense provisioner
+        there is NO separate prefix-cache reserve to carve out (radix-
+        resident prefixes and decode tails draw from the same blocks),
+        which is one of the two structural reasons paged admission caps
+        come out strictly higher at the same budget (the other: no
+        ``ALIGN_S`` padding of per-row windows).  Without a limit (CPU
+        tests) the pool affords 16 worst-case rows."""
+        tp = self.mesh.shape.get("tp", 1) if self.mesh is not None else 1
+        div = tp if tp > 1 and self.spec.num_kv_heads % tp == 0 else 1
+        block_bytes = max(
+            1, block_size * self._kv_slot_bytes * self.spec.num_layers // div
+        )
+        if self._mem_limit:
+            budget = (
+                self.config.hbm_utilization * self._mem_limit
+                - self._param_bytes_per_device
+            )
+            return max(64, min(1 << 20, int(budget // block_bytes)))
+        blocks_per_row = -(-self.worst_case_decode_window() // block_size) + 1
+        return 16 * blocks_per_row + 1
+
     def cap_for(self, S: int) -> Optional[int]:
         """Concurrent-row cap for decode-cache length ``S``, derived
         from the mesh axes that actually engage (ADVICE round-5 medium).
+
+        PAGED mode derives from free-block accounting instead: the pool
+        is the budget, a row of window ``S`` needs ``ceil(S / bs)``
+        blocks, and the cap is the usable block count over that — a
+        static quantity (total blocks, not the fluctuating free count),
+        for the same reason the dense budget ignores current prefix
+        fill: a volatile cap re-chunks identical batches into fresh
+        compiled shapes.  Shared prefix blocks make the real per-row
+        need smaller still; the cap is the conservative floor.
 
         Two regimes, mirroring ``_dp_mult``: if the engaged-axes cap
         admits at least ``dp`` rows, the caller will dp-align the batch
@@ -2219,6 +2663,9 @@ class JaxEngine(InferenceEngine):
         dp×.  tp/sp engagement (Hkv and S divisibility) is read off the
         same placement function the cache allocation uses, so engaged
         configs get every row the layout genuinely affords."""
+        if self._paged is not None:
+            blocks_per_row = -(-S // self._paged.block_size)
+            return max(1, (self._paged.num_blocks - 1) // blocks_per_row)
         budget = self._kv_row_budget()
         if budget is None:
             return None
@@ -2245,8 +2692,11 @@ class JaxEngine(InferenceEngine):
         decoded rows so cache + weights + live prefix entries fit the
         budgeted fraction of device memory; oversized batches then chunk
         through the max_num_seqs machinery.  Returns None when the
-        device limit is unknown (CPU tests) or the whole batch fits."""
-        if self._mem_limit is None:
+        device limit is unknown (CPU tests) or the whole batch fits.
+        PAGED mode provisions even without a device limit: the pool is
+        finite everywhere, and ``cap_for`` answers from free-block
+        accounting."""
+        if self._mem_limit is None and self._paged is None:
             return None
         max_new = max(budgets)
         decode_res = self._decode_reserve(max_new)
@@ -2282,8 +2732,29 @@ class JaxEngine(InferenceEngine):
         exact here: a B that skips dp alignment counts replicated.
         ``decode_res`` is the decode-tail reservation of the loop that
         will actually run (plain / fast-forward / speculative — the
-        caller's ``decode_slots``)."""
-        if self._kv_budget_warned or self._mem_limit is None:
+        caller's ``decode_slots``).  PAGED mode guards in blocks: the
+        worst-case block need of the batch against the usable pool."""
+        if self._kv_budget_warned:
+            return
+        if self._paged is not None:
+            bs_blk = self._paged.block_size
+            S = self.max_model_len - min(budgets) - 1 + decode_res
+            needed = B * (-(-S // bs_blk))
+            usable = self._paged.num_blocks - 1
+            if needed > usable:
+                import warnings
+
+                warnings.warn(
+                    f"worst-case KV need ({needed} blocks for B={B}, "
+                    f"S={S}) exceeds the paged pool ({usable} usable "
+                    f"blocks of {bs_blk} tokens); bound it with "
+                    "max_num_seqs, a smaller max_model_len, or a larger "
+                    "BCG_TPU_KV_POOL_BLOCKS",
+                    stacklevel=3,
+                )
+                self._kv_budget_warned = True
+            return
+        if self._mem_limit is None:
             return
         spec = self.spec
         # Worst case for a mixed-budget batch: a min-budget row's prompt
@@ -2420,10 +2891,21 @@ class JaxEngine(InferenceEngine):
         )
         return [t.strip() for t in texts]
 
+    def kv_pool_stats(self) -> Optional[Dict[str, Any]]:
+        """Paged-pool snapshot (block counts, free-block headroom bytes,
+        radix prefix hit rate) for serve stats and bench JSON; None on
+        dense engines so consumers can render conditionally."""
+        return self._paged.stats() if self._paged is not None else None
+
     def shutdown(self) -> None:
         self.params = None
         self._decode_loops.clear()
         self._prefix_cache.clear()
+        if self._paged is not None:
+            self._paged.close()
+        self._paged = None
+        self._paged_call_private = []
+        self._paged_toks_memo.clear()
         self._prefix_bytes = 0
         self._prefix_bytes_dev = 0
         self._prefix_lens_memo.clear()

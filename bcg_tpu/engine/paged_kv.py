@@ -1,0 +1,384 @@
+"""Host-side manager for the block-paged KV cache: block allocator +
+radix-tree prefix index with refcounts and LRU eviction.
+
+The device side (pool layout, block-indexed scatter/gather, paged
+attention) lives in :mod:`bcg_tpu.ops.paged_attention`; this module owns
+everything the host decides per call:
+
+* **Block pool bookkeeping** — a free list over ``[1, num_blocks)``
+  (block 0 is the reserved null block that table padding points at),
+  allocation with eviction pressure, and per-device byte accounting
+  through the HBM ledger's ``prefix_cache`` account (radix-resident
+  blocks) so the paged working set shows up in ``hbm.*`` gauges next to
+  the dense engine's accounts.
+
+* **Radix index** — a tree over TOKEN IDS at block granularity: each
+  node is one full block (``block_size`` tokens, the edge label from
+  its parent) holding the physical block id.  ``lookup`` walks the
+  longest matching full-block chain; ``insert`` extends a matched path
+  with freshly prefilled blocks.  Matching on token content means
+  sharing needs no string-level keys: two different system prompts
+  share exactly their common token-prefix blocks, and round ``r``'s
+  grown history prompt extends round ``r-1``'s resident chain instead
+  of re-prefilling it.
+
+* **Refcounts / eviction** — nodes on a batch's matched or inserted
+  paths are PINNED (refcount) for the duration of the call, so eviction
+  can never free a block an in-flight decode still references.
+  Eviction (LRU over leaf nodes, only at refcount 0) runs under
+  allocation pressure; every resident-set mutation re-syncs the ledger
+  charge idempotently (re-charging one key replaces the amount, so
+  evict/re-admit cycles cannot drift the account).
+
+Thread-safety: the manager is called only from the engine's generation
+path, which the serving scheduler already serializes behind its device
+lock — no internal locking, same contract as the dense prefix cache.
+"""
+
+from __future__ import annotations
+
+import itertools
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bcg_tpu.obs import counters as obs_counters, ledger as obs_ledger
+
+
+class PoolExhausted(RuntimeError):
+    """Allocation failed even after evicting every unpinned block —
+    the pinned working set plus the request exceeds the pool."""
+
+
+class _Node:
+    """One radix node = one full resident block.  ``key`` is the
+    block's token chunk (the edge label from ``parent``)."""
+
+    __slots__ = ("key", "block", "children", "parent", "refcount", "last_use")
+
+    def __init__(self, key: Optional[Tuple[int, ...]], block: Optional[int],
+                 parent: Optional["_Node"]):
+        self.key = key
+        self.block = block
+        self.parent = parent
+        self.children: Dict[Tuple[int, ...], "_Node"] = {}
+        self.refcount = 0
+        self.last_use = 0
+
+
+class PagedKV:
+    """Block pool + radix prefix index for one engine.
+
+    ``pool`` is the device-resident per-layer block pool
+    (:func:`bcg_tpu.ops.paged_attention.init_block_pool`), replaced
+    wholesale by :meth:`adopt` after every donated jit call.  The
+    manager never touches block CONTENTS — only ids, refcounts and the
+    ledger.
+    """
+
+    def __init__(self, spec, num_blocks: int, block_size: int, *,
+                 quantized: bool = False, stacked: bool = False, mesh=None):
+        if num_blocks < 2:
+            raise ValueError(f"num_blocks={num_blocks}: need >= 2 (block 0 "
+                             "is the reserved null block)")
+        if block_size < 1:
+            raise ValueError(f"block_size={block_size}: need >= 1")
+        self.spec = spec
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.quantized = quantized
+        self.stacked = stacked
+        self.mesh = mesh
+        self._free: List[int] = list(range(1, self.num_blocks))
+        self._root = _Node(None, None, None)
+        self._clock = itertools.count(1)
+        self._pinned: List[_Node] = []
+        self.resident_blocks = 0
+        self._ledger_key: Optional[object] = None
+        self._invalidated = False
+        # Instance-local hit accounting (the process-wide kvpool.*
+        # counters aggregate every pool in the process — a baseline
+        # subtraction would blend a CONCURRENT second engine's lookups
+        # into this one's rate).
+        self._hit_positions = 0
+        self._lookup_positions = 0
+        self.pool = self._init_pool()
+        self.block_bytes_dev = self._block_bytes_per_device()
+        obs_counters.set_gauge("kvpool.blocks_total", self.num_blocks - 1)
+        self._publish()
+
+    # ------------------------------------------------------------ device pool
+
+    def _init_pool(self):
+        """Allocate the pool, sharded over the mesh where one exists
+        (jitted zero-init with out_shardings — the `_init_cache_sharded`
+        idiom: no device ever stages more than its shard)."""
+        import jax
+
+        from bcg_tpu.ops.paged_attention import init_block_pool
+
+        init = partial(
+            init_block_pool, self.spec, self.num_blocks, self.block_size,
+            quantized=self.quantized, stacked=self.stacked,
+        )
+        if self.mesh is None or self.mesh.size <= 1:
+            return init()
+        from bcg_tpu.parallel.sharding import paged_pool_tree_sharding
+
+        outs = paged_pool_tree_sharding(
+            self.mesh, jax.eval_shape(init),
+            quantized=self.quantized, stacked=self.stacked,
+        )
+        return jax.jit(init, out_shardings=outs)()
+
+    def _block_bytes_per_device(self) -> int:
+        """ONE device's share of one block across every layer — the unit
+        the ledger and the free-block admission math account in."""
+        import jax
+
+        if self.mesh is None or self.mesh.size <= 1:
+            total = sum(
+                int(np.prod(l.shape)) * l.dtype.itemsize
+                for l in jax.tree.leaves(self.pool)
+            )
+        else:
+            from bcg_tpu.parallel.sharding import tree_bytes_per_device
+
+            total = tree_bytes_per_device(self.pool)
+        return max(1, total // self.num_blocks)
+
+    def entries(self, tbl: np.ndarray):
+        """Paged cache entries for a jit call: the pool plus the block
+        table as a regular pytree leaf.  Each layer gets its OWN device
+        copy of the (tiny) table so donated trees never alias one
+        buffer across leaves."""
+        import jax.numpy as jnp
+
+        tbl = np.asarray(tbl, dtype=np.int32)
+        if self.stacked:
+            lyr = self.spec.num_layers
+            stacked_tbl = np.broadcast_to(tbl[None], (lyr,) + tbl.shape)
+            return {**self.pool, "tbl": jnp.asarray(stacked_tbl.copy())}
+        return [{**e, "tbl": jnp.asarray(tbl.copy())} for e in self.pool]
+
+    def adopt(self, cache_out) -> None:
+        """Retain the updated pool returned by a donated jit call
+        (stripping the table leaf) — the donated input buffers are dead
+        the moment the call ran, so every pool-writing call must be
+        followed by an adopt."""
+        if self.stacked:
+            self.pool = {k: v for k, v in cache_out.items() if k != "tbl"}
+        else:
+            self.pool = [
+                {k: v for k, v in e.items() if k != "tbl"} for e in cache_out
+            ]
+
+    def invalidate(self) -> None:
+        """Engine-failure recovery: a jit call that raised AFTER
+        donation leaves the old pool buffers deleted — drop every
+        resident block and reallocate a zeroed pool so the engine stays
+        usable (the radix working set re-prefills on demand)."""
+        self._invalidated = True
+        self._root = _Node(None, None, None)
+        self._pinned = []
+        self._free = list(range(1, self.num_blocks))
+        self.resident_blocks = 0
+        self.pool = self._init_pool()
+        self._sync_ledger()
+        self._publish()
+
+    # -------------------------------------------------------------- allocator
+
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> List[int]:
+        """Take ``n`` block ids off the free list, evicting unpinned
+        radix leaves (LRU-first) under pressure.  Raises
+        :class:`PoolExhausted` when the pinned resident set leaves no
+        room — admission (``cap_for`` on free blocks) exists to make
+        that unreachable in correctly-sized deployments."""
+        if n > len(self._free):
+            self.evict(n - len(self._free))
+        if n > len(self._free):
+            raise PoolExhausted(
+                f"need {n} KV blocks but only {len(self._free)} free and "
+                f"nothing evictable ({self.resident_blocks} resident, "
+                f"{sum(1 for _ in self._iter_nodes())} radix nodes pinned "
+                "or interior); raise BCG_TPU_KV_POOL_BLOCKS or lower "
+                "concurrency"
+            )
+        out = self._free[:n]
+        del self._free[:n]
+        self._publish()
+        return out
+
+    def free(self, ids: Sequence[int]) -> None:
+        """Return PRIVATE (never radix-inserted) blocks to the free
+        list.  Contents are dead; the null block 0 is never accepted."""
+        self._free.extend(i for i in ids if i != 0)
+        self._publish()
+
+    def _iter_nodes(self):
+        stack = list(self._root.children.values())
+        while stack:
+            node = stack.pop()
+            yield node
+            stack.extend(node.children.values())
+
+    def evict(self, need: int) -> int:
+        """Free up to ``need`` radix-resident blocks: leaf nodes only
+        (children pin their parents structurally), refcount 0 only
+        (in-flight batches pin their paths), LRU order.  Cascades —
+        evicting a leaf may expose its parent, which joins the heap the
+        moment it becomes evictable.  ONE tree walk per call (heap of
+        candidates), not one per freed block: eviction sits on the
+        allocation hot path inside the scheduler-serialized device
+        section, where an O(need x resident_nodes) rescan would stall
+        serving for seconds at pool scale.  Returns blocks freed."""
+        import heapq
+
+        heap = [
+            (node.last_use, id(node), node) for node in self._iter_nodes()
+            if not node.children and node.refcount == 0
+        ]
+        heapq.heapify(heap)
+        freed = 0
+        while freed < need and heap:
+            _, _, victim = heapq.heappop(heap)
+            parent = victim.parent
+            del parent.children[victim.key]
+            self._free.append(victim.block)
+            self.resident_blocks -= 1
+            freed += 1
+            obs_counters.inc("kvpool.evicted_blocks")
+            if (parent is not self._root and not parent.children
+                    and parent.refcount == 0):
+                heapq.heappush(heap, (parent.last_use, id(parent), parent))
+        if freed:
+            self._sync_ledger()
+            self._publish()
+        return freed
+
+    # ------------------------------------------------------------ radix index
+
+    def lookup(self, toks: np.ndarray) -> Tuple[List[_Node], List[int]]:
+        """Longest full-block match of ``toks`` against the tree.
+        Returns the matched node path and their block ids; counts
+        hit/lookup positions for the prefix-hit-rate metrics."""
+        bs = self.block_size
+        node = self._root
+        path: List[_Node] = []
+        blocks: List[int] = []
+        now = next(self._clock)
+        full = len(toks) // bs
+        for i in range(full):
+            key = tuple(int(t) for t in toks[i * bs:(i + 1) * bs])
+            child = node.children.get(key)
+            if child is None:
+                break
+            child.last_use = now
+            path.append(child)
+            blocks.append(child.block)
+            node = child
+        obs_counters.inc("kvpool.hit_positions", len(blocks) * bs)
+        obs_counters.inc("kvpool.lookup_positions", full * bs)
+        self._hit_positions += len(blocks) * bs
+        self._lookup_positions += full * bs
+        return path, blocks
+
+    def pin(self, nodes: Sequence[_Node]) -> None:
+        """Refcount-pin a path for the duration of the current call —
+        pinned nodes are invisible to :meth:`evict`."""
+        for node in nodes:
+            node.refcount += 1
+            self._pinned.append(node)
+
+    def unpin_all(self) -> None:
+        """Release every pin taken since the last release (end of the
+        engine call's ``finally``)."""
+        for node in self._pinned:
+            node.refcount -= 1
+        self._pinned = []
+
+    def insert(self, parent_path: List[_Node], toks: np.ndarray,
+               start_tok: int, block_ids: Sequence[int]) -> List[_Node]:
+        """Graft freshly prefilled blocks onto the tree after
+        ``parent_path`` (the lookup result): block ``j`` holds tokens
+        ``[start_tok + j*bs, start_tok + (j+1)*bs)`` of ``toks``.  A
+        chunk already present (raced in by an earlier entry of the same
+        batch) reuses the existing node; the duplicate block stays
+        CALLER-owned (the caller frees whatever the grafted path did
+        not keep — insert freeing it too would double-free, putting one
+        id on the free list twice and eventually handing the same block
+        to two rows).  New nodes are pinned."""
+        bs = self.block_size
+        node = parent_path[-1] if parent_path else self._root
+        now = next(self._clock)
+        grafted: List[_Node] = []
+        for j, block in enumerate(block_ids):
+            lo = start_tok + j * bs
+            key = tuple(int(t) for t in toks[lo:lo + bs])
+            child = node.children.get(key)
+            if child is None:
+                child = _Node(key, int(block), node)
+                node.children[key] = child
+                self.resident_blocks += 1
+            # else: duplicate content — existing node wins, the caller
+            # keeps (and later frees) its unreferenced block.
+            child.last_use = now
+            grafted.append(child)
+            node = child
+        self.pin(grafted)
+        self._sync_ledger()
+        self._publish()
+        return grafted
+
+    # ------------------------------------------------------------ accounting
+
+    def set_ledger_key(self, key: object) -> None:
+        self._ledger_key = key
+
+    def _sync_ledger(self) -> None:
+        """Idempotent re-charge of the ``prefix_cache`` account with the
+        resident set's per-device bytes (the dense engine's
+        `_evict_prefix_over_budget` idiom) — evict/re-admit cycles
+        replace the amount instead of accumulating drift."""
+        if self._ledger_key is not None:
+            obs_ledger.charge(
+                "prefix_cache", self._ledger_key,
+                self.resident_blocks * self.block_bytes_dev,
+            )
+
+    def _publish(self) -> None:
+        obs_counters.set_gauge("kvpool.blocks_free", len(self._free))
+        obs_counters.set_gauge("kvpool.blocks_resident", self.resident_blocks)
+        obs_counters.set_gauge(
+            "kvpool.headroom_bytes", len(self._free) * self.block_bytes_dev
+        )
+
+    def close(self) -> None:
+        """Engine shutdown: zero the published pool gauges so dead-pool
+        telemetry (resident blocks, headroom) cannot outlive the engine
+        in the Prometheus export or trace reports."""
+        for name in ("kvpool.blocks_total", "kvpool.blocks_free",
+                     "kvpool.blocks_resident", "kvpool.headroom_bytes"):
+            obs_counters.set_gauge(name, 0)
+
+    def stats(self) -> Dict[str, Optional[float]]:
+        """Pool/headroom snapshot for serve stats and bench JSON."""
+        hits = self._hit_positions
+        lookups = self._lookup_positions
+        return {
+            "block_size": self.block_size,
+            "blocks_total": self.num_blocks - 1,
+            "blocks_free": len(self._free),
+            "blocks_resident": self.resident_blocks,
+            "free_block_headroom_bytes": (
+                len(self._free) * self.block_bytes_dev
+            ),
+            "prefix_hit_rate": (
+                round(hits / lookups, 4) if lookups else None
+            ),
+        }

@@ -352,7 +352,16 @@ def _write_cache(entry: Dict, k, v, pos) -> Dict:
     int8 arrays tile as (32, 128) on the last two dims, so a kernel block
     slicing S x Dh is native — the bf16 layout's [.., S, Hkv, Dh] would
     hand Mosaic (1, 128)-row int8 blocks (measured ~70x slower decode).
+
+    A PAGED entry (block pool + per-row block table, ``"tbl"`` present —
+    :mod:`bcg_tpu.ops.paged_attention`) routes both position forms
+    through the block-indexed scatter instead; the logical semantics
+    are identical.
     """
+    if "tbl" in entry:
+        from bcg_tpu.ops.paged_attention import paged_write
+
+        return paged_write(entry, k, v, pos)
     if getattr(pos, "ndim", 0) == 1:
         return _write_cache_rows(entry, k, v, pos)
     new = dict(entry)
@@ -409,6 +418,13 @@ def _cache_attention(q, entry: Dict, mask, scale, impl: str):
     kernel streams the cache once and dequantizes in VMEM; off-TPU (or
     non-lane-aligned head dims) falls back to dequantize + stock einsum.
     """
+    if "tbl" in entry:
+        # Paged cache: the block-table gather + stock masked attention
+        # (ops/paged_attention.py) — bit-identical to the dense path
+        # given identical block contents.
+        from bcg_tpu.ops.paged_attention import paged_decode_attention
+
+        return paged_decode_attention(q, entry, mask, scale)
     quantized = "k_scale" in entry
     Dh = q.shape[-1]
     if impl == "pallas" and jax.default_backend() == "tpu" and Dh % 128 == 0:
@@ -439,7 +455,18 @@ def _cache_len(cache) -> int:
 
 def _dequant_slice(entry: Dict, name: str, upto: int, dtype) -> jax.Array:
     """Cache slots [0, upto) of k or v as [B, upto, Hkv, Dh], dequantized
-    (and transposed out of the [B, Hkv, S, Dh] storage) if stored int8."""
+    (and transposed out of the [B, Hkv, S, Dh] storage) if stored int8.
+    Paged entries gather only the table's first ``upto / bs`` block
+    columns (the caller block-aligns the prefix region) to the same
+    dense view first."""
+    if "tbl" in entry:
+        from bcg_tpu.ops.paged_attention import block_size, paged_gather_entry
+
+        bs = block_size(entry)
+        assert upto % bs == 0, (
+            f"paged history window {upto} not block-aligned (bs={bs})"
+        )
+        entry = paged_gather_entry(entry, upto_blocks=upto // bs)
     scale_name = f"{name}_scale"
     if scale_name not in entry:
         return entry[name][:, :upto].astype(dtype)
@@ -788,6 +815,52 @@ def prefill_with_prefix(
     return logits, new_cache
 
 
+def prefill_paged(
+    params: TransformerParams,
+    spec: ModelSpec,
+    tokens: jax.Array,         # [B, Ls] RIGHT-padded (left-aligned) tokens
+    valid: jax.Array,          # [B, Ls] bool, False on trailing pads
+    cache: Dict,               # paged entries; logical slots [0, P) hold
+                               # radix-shared prefix blocks
+    prefix_valid: jax.Array,   # [B, P] attendable prefix slots (P may be 0)
+    prefix_lens: jax.Array,    # [B] valid prefix token counts (RoPE offset)
+    impl: str = "xla",
+) -> Tuple[jax.Array, Dict]:
+    """Prefill into a PAGED cache: the per-call chunk (full prompt when
+    ``P == 0``, or the suffix past the radix-resident prefix) is written
+    at logical slots ``[P, P+Ls)`` through each row's block table.
+
+    Differs from :func:`prefill_with_prefix` in exactly two ways, both
+    forced by block paging: tokens arrive LEFT-aligned (so full
+    real-token blocks are radix-insertable — a left-pad would interleave
+    pad KV into shareable blocks), and logits are taken at each row's
+    last VALID position instead of the last physical one (with trailing
+    pads those differ).  Attention math is unchanged: causality is by
+    physical position, pads are masked, RoPE counts only valid tokens.
+    """
+    B, Ls = tokens.shape
+    P = prefix_valid.shape[1]
+    positions = prefix_lens[:, None] + jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1
+    positions = jnp.maximum(positions, 0)
+    cos, sin = rope_table(positions, spec.head_dim, spec.rope_theta, spec.rope_scaling)
+
+    causal = jnp.tril(jnp.ones((Ls, Ls), bool))
+    chunk_mask = causal[None] & valid[:, None, :] & valid[:, :, None]   # [B, Ls, Ls]
+    hist_mask = prefix_valid[:, None, :] & valid[:, :, None]            # [B, Ls, P]
+    attn_mask = jnp.concatenate([hist_mask, chunk_mask], axis=2)        # [B, Ls, P+Ls]
+
+    x = params["embed"][tokens]
+    x, new_cache = _run_layers(
+        params, spec, x, cos, sin, jnp.int32(P), cache, attn_mask, impl,
+        hist_len=P,
+    )
+    last = jnp.sum(valid.astype(jnp.int32), axis=1) - 1                 # [B]
+    last = jnp.maximum(last, 0)
+    h_last = jnp.take_along_axis(x, last[:, None, None], axis=1)        # [B, 1, D]
+    logits = _logits(params, spec, h_last)[:, 0, :]
+    return logits, new_cache
+
+
 def prefill_chunk_at(
     params: TransformerParams,
     spec: ModelSpec,
@@ -1010,7 +1083,26 @@ def _block_chunk(
     # Attend over the full cache including the just-written chunk.
     scale = 1.0 / math.sqrt(spec.head_dim)
     quantized = "k_scale" in new_entry
-    if ring is not None:
+    if "tbl" in new_entry:
+        # Paged cache (chunk form — fast-forward and speculative-verify
+        # loops): gather the row's blocks to the dense layout, attend,
+        # and return the PAGED entry for the carry.  The gathered view
+        # is a per-step transient; see ops/paged_attention.py.
+        from bcg_tpu.ops.paged_attention import paged_gather_entry
+
+        dense_view = paged_gather_entry(new_entry)
+        ck, cv = dense_view["k"], dense_view["v"]
+        if quantized:
+            from bcg_tpu.ops.decode_attention import dequantize_kv
+
+            ck = dequantize_kv(
+                ck, dense_view["k_scale"]).transpose(0, 2, 1, 3).astype(q.dtype)
+            cv = dequantize_kv(
+                cv, dense_view["v_scale"]).transpose(0, 2, 1, 3).astype(q.dtype)
+        attn_out = attention(
+            q, ck, cv, attn_mask, scale, "xla" if quantized else impl
+        )
+    elif ring is not None:
         # Sequence-parallel chunk decode: cache stays sharded over sp,
         # partials merge via pmax/psum (same loud-on-indivisible policy
         # as the single-token path — the engine sp-aligns its caches).

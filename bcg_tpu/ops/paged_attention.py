@@ -1,0 +1,185 @@
+"""Block-paged KV-cache primitives: pool init, block-indexed
+gather/scatter, gather-to-dense views, and the paged decode-attention
+variant of :mod:`bcg_tpu.ops.decode_attention`.
+
+The dense engine provisions one ``[B, S]`` KV slab per batch row, sized
+at the worst-case decode window — N agents sharing a system prompt and
+round history hold N copies of identical prefix KV.  The paged layout
+replaces the per-row slab with ONE preallocated pool of fixed-size
+blocks per layer plus a per-row **block table**: logical cache slot
+``s`` of row ``b`` lives at physical slot ``tbl[b, s // bs] * bs +
+s % bs`` of the pool.  Rows that share a token prefix reference the
+same physical blocks (refcounted by the host-side radix index,
+:mod:`bcg_tpu.engine.paged_kv`), so shared prefixes are stored and
+prefilled once.
+
+Layouts mirror the dense cache exactly, with the batch/sequence pair
+``[B, S]`` replaced by ``[N_blocks, bs]``:
+
+* bf16: ``k``/``v`` ``[N, bs, Hkv, Dh]`` (dense: ``[B, S, Hkv, Dh]``)
+* int8: ``k``/``v`` ``[N, Hkv, bs, Dh]`` with f32 scales
+  ``[N, Hkv, bs]`` (dense: ``[B, Hkv, S, Dh]`` / ``[B, Hkv, S]``)
+
+A paged cache ENTRY is the pool plus the traced block table:
+``{"k", "v"[, "k_scale", "v_scale"], "tbl": [B, nblk] int32}`` — the
+table is a regular pytree leaf, so varying its CONTENTS between calls
+never re-traces a decode loop (only ``nblk``/pool shapes key compiles).
+Block 0 is reserved as the null block: table padding points at it, it
+is never written, and every slot it backs is masked out of attention.
+
+This module is the XLA REFERENCE implementation: attention gathers the
+row's blocks into the dense layout (exact — a gather moves bits) and
+delegates to the stock masked attention, so paged output is
+bit-identical to the dense path given identical block contents.  The
+gathered view is a per-step transient (one layer live at a time under
+scan-over-layers); steady-state residency is the pool alone.  A fused
+Pallas kernel (double-buffered page DMA, the
+``jax.experimental.pallas.ops.tpu.paged_attention`` shape) can replace
+the gather without touching callers — the entry layout above matches
+the kernel's ``[num_pages, page_size, ...]`` paging convention.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+
+def is_paged(entry: Dict) -> bool:
+    """True for a paged cache entry (carries a block table)."""
+    return "tbl" in entry
+
+
+def block_size(entry: Dict) -> int:
+    """Tokens per block, read off the pool's physical layout."""
+    return entry["k"].shape[2 if "k_scale" in entry else 1]
+
+
+def init_block_pool(
+    spec, num_blocks: int, block_size: int, quantized: bool = False,
+    stacked: bool = False,
+):
+    """Preallocated per-layer block pool (no tables yet): the paged
+    counterpart of ``transformer.init_kv_cache``.  Returns a per-layer
+    list of entry dicts, or — ``stacked`` — one dict whose leaves carry
+    a leading ``[num_layers]`` dim (scan-over-layers form).  Block 0 is
+    the null block by convention (reserved by the allocator)."""
+    shape = (num_blocks, block_size, spec.num_kv_heads, spec.head_dim)
+    qshape = (num_blocks, spec.num_kv_heads, block_size, spec.head_dim)
+    scale_shape = (num_blocks, spec.num_kv_heads, block_size)
+
+    def entry(lead=()):
+        if quantized:
+            return {
+                "k": jnp.zeros(lead + qshape, jnp.int8),
+                "v": jnp.zeros(lead + qshape, jnp.int8),
+                "k_scale": jnp.ones(lead + scale_shape, jnp.float32),
+                "v_scale": jnp.ones(lead + scale_shape, jnp.float32),
+            }
+        return {
+            "k": jnp.zeros(lead + shape, jnp.bfloat16),
+            "v": jnp.zeros(lead + shape, jnp.bfloat16),
+        }
+
+    if stacked:
+        return entry(lead=(spec.num_layers,))
+    return [entry() for _ in range(spec.num_layers)]
+
+
+def paged_write(entry: Dict, k, v, pos) -> Dict:
+    """Write fresh ``[B, T]`` KV through the block table (quantizing for
+    int8 pools) — the block-indexed generalization of
+    ``transformer._write_cache``: ``pos`` is a scalar logical slot
+    shared by the batch (prefill chunks, the standard/fast-forward
+    loops) or a ``[B]`` vector of per-row slots (the speculative loop's
+    compacted writes); either way row ``b``'s token ``t`` lands at
+    physical slot ``(tbl[b, p // bs], p % bs)`` with ``p = pos(+b) + t``.
+
+    Callers guarantee the written logical range is backed by PRIVATE
+    (unshared) blocks — decode/suffix regions are freshly allocated per
+    row, so the scatter can never touch a radix-shared block."""
+    B, T = k.shape[0], k.shape[1]
+    tbl = entry["tbl"]
+    bs = block_size(entry)
+    if getattr(pos, "ndim", 0) == 1:
+        p = pos[:, None] + jnp.arange(T)[None, :]          # [B, T]
+    else:
+        p = jnp.broadcast_to((pos + jnp.arange(T))[None, :], (B, T))
+    bidx = jnp.arange(B)[:, None]                          # [B, 1]
+    blk = tbl[bidx, p // bs]                               # [B, T]
+    off = p % bs                                           # [B, T]
+    new = dict(entry)
+    if "k_scale" in entry:
+        from bcg_tpu.ops.decode_attention import quantize_kv
+
+        kq, ksc = quantize_kv(k)   # kq: [B, T, Hkv, Dh]; ksc: [B, T, Hkv]
+        vq, vsc = quantize_kv(v)
+        # Pool [N, Hkv, bs, Dh] / scales [N, Hkv, bs]: advanced indices
+        # on axes (0, 2) move to the front, so the target region is
+        # [B, T, Hkv, Dh] / [B, T, Hkv] — already the fresh-KV layout
+        # (the same trick _write_cache_rows uses on the dense slab).
+        new["k"] = entry["k"].at[blk, :, off].set(kq)
+        new["v"] = entry["v"].at[blk, :, off].set(vq)
+        new["k_scale"] = entry["k_scale"].at[blk, :, off].set(ksc)
+        new["v_scale"] = entry["v_scale"].at[blk, :, off].set(vsc)
+    else:
+        new["k"] = entry["k"].at[blk, off].set(k.astype(entry["k"].dtype))
+        new["v"] = entry["v"].at[blk, off].set(v.astype(entry["v"].dtype))
+    return new
+
+
+def paged_gather_entry(entry: Dict, upto_blocks: int = 0) -> Dict:
+    """Dense-layout VIEW of a paged entry: gather each row's blocks and
+    reshape to the dense cache layout (bf16 ``[B, S, Hkv, Dh]``; int8
+    ``[B, Hkv, S, Dh]`` + ``[B, Hkv, S]`` scales), ``S = nblk * bs``.
+    ``upto_blocks`` limits the gather to the table's first columns
+    (suffix prefill reads only the prefix region).  The result carries
+    no ``tbl`` — downstream attention/dequant code treats it exactly
+    like a dense entry, which is what makes paged decode bit-identical
+    to dense decode."""
+    tbl = entry["tbl"]
+    if upto_blocks:
+        tbl = tbl[:, :upto_blocks]
+    B, nblk = tbl.shape
+    bs = block_size(entry)
+    S = nblk * bs
+    if "k_scale" in entry:
+        def kv(name):
+            g = entry[name][tbl]                  # [B, nblk, Hkv, bs, Dh]
+            g = g.transpose(0, 2, 1, 3, 4)        # [B, Hkv, nblk, bs, Dh]
+            return g.reshape(B, g.shape[1], S, g.shape[-1])
+
+        def sc(name):
+            g = entry[name][tbl]                  # [B, nblk, Hkv, bs]
+            g = g.transpose(0, 2, 1, 3)           # [B, Hkv, nblk, bs]
+            return g.reshape(B, g.shape[1], S)
+
+        return {
+            "k": kv("k"), "v": kv("v"),
+            "k_scale": sc("k_scale"), "v_scale": sc("v_scale"),
+        }
+    def kv(name):
+        g = entry[name][tbl]                      # [B, nblk, bs, Hkv, Dh]
+        return g.reshape(B, S, g.shape[-2], g.shape[-1])
+
+    return {"k": kv("k"), "v": kv("v")}
+
+
+def paged_decode_attention(q, entry: Dict, mask, scale):
+    """Single-token decode attention over a paged cache: gather the
+    row's blocks to the dense layout and run the stock masked einsum
+    attention (``transformer._xla_attention``) — the paged variant of
+    ``ops/decode_attention.decode_attention``.  q: ``[B, 1, H, Dh]``;
+    mask: ``[B, S]`` attendable logical slots.  Bit-identical to the
+    dense path by construction; the Pallas replacement slots in here."""
+    from bcg_tpu.models.transformer import _xla_attention
+    from bcg_tpu.ops.decode_attention import dequantize_kv
+
+    dense = paged_gather_entry(entry)
+    k, v = dense["k"], dense["v"]
+    if "k_scale" in dense:
+        k = dequantize_kv(k, dense["k_scale"]).transpose(0, 2, 1, 3).astype(q.dtype)
+        v = dequantize_kv(v, dense["v_scale"]).transpose(0, 2, 1, 3).astype(q.dtype)
+    return _xla_attention(q, k, v, mask[:, None, :], scale)

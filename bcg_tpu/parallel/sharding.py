@@ -172,6 +172,37 @@ def kv_cache_tree_sharding(mesh: Mesh, cache_shapes, quantized: bool = False,
     return jax.tree.map(place, cache_shapes)
 
 
+def paged_pool_tree_sharding(mesh: Mesh, pool_shapes, quantized: bool = False,
+                             stacked: bool = False):
+    """Per-leaf shardings for a block-paged KV pool
+    (:func:`bcg_tpu.ops.paged_attention.init_block_pool`) — the same
+    axis logic as :func:`kv_cache_tree_sharding` with the dense
+    ``[B, S]`` pair replaced by ``[N_blocks, block_size]``: blocks are
+    SHARED across batch rows, so neither pool dim may shard over ``dp``
+    or ``sp`` (every device must read any block) — only the kv-head dim
+    partitions, over ``tp``, with the same divisibility guard."""
+    lead = (None,) if stacked else ()
+    if quantized:
+        kv = lead + (None, "tp", None, None)      # [N, Hkv, bs, Dh] int8
+        scale = lead + (None, "tp", None)         # [N, Hkv, bs]
+    else:
+        kv = lead + (None, None, "tp", None)      # [N, bs, Hkv, Dh]
+        scale = None
+
+    def place(leaf):
+        axes = kv if leaf.ndim == len(kv) else scale
+        spec = tuple(
+            ax
+            if ax is not None and leaf.shape[i] % mesh.shape.get(ax, 1) == 0
+            and mesh.shape.get(ax, 1) > 1
+            else None
+            for i, ax in enumerate(axes)
+        )
+        return NamedSharding(mesh, P(*spec))
+
+    return jax.tree.map(place, pool_shapes)
+
+
 def shard_bytes(shape, dtype, sharding=None) -> int:
     """Bytes of ONE device's shard of an array (full bytes when
     ``sharding`` is None).  The single shard-size computation behind

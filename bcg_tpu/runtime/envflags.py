@@ -116,6 +116,25 @@ _register(
     "to the last N emitted tokens.",
 )
 
+# BCG_TPU_PAGED_KV* — block-paged KV cache (engine/paged_kv.py).
+_register(
+    "BCG_TPU_PAGED_KV", "bool", False,
+    "Enable the block-paged KV cache with radix-tree prefix sharing "
+    "(EngineConfig.paged_kv override): shared prompt prefixes are "
+    "stored once in a block pool and referenced per row via block "
+    "tables; greedy output token-identical to the dense path.",
+)
+_register(
+    "BCG_TPU_KV_BLOCK_SIZE", "int", 0,
+    "Tokens per KV block for the paged cache (0 = use "
+    "EngineConfig.kv_block_size, default 16).",
+)
+_register(
+    "BCG_TPU_KV_POOL_BLOCKS", "int", 0,
+    "Paged KV pool size in blocks (0 = use EngineConfig.kv_pool_blocks, "
+    "whose 0 = auto-size from the HBM budget / CPU-test allowance).",
+)
+
 # BCG_TPU_TRACE* — span tracer / observability (bcg_tpu/obs).
 _register(
     "BCG_TPU_TRACE", "bool", False,

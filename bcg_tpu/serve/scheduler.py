@@ -161,7 +161,8 @@ class SchedulerStats:
         obs_counters.inc(_LINGER_COUNTERS[-1])
 
     def snapshot(self, row_cap: Optional[int] = None,
-                 queue_rows: int = 0) -> Dict[str, Any]:
+                 queue_rows: int = 0,
+                 kv_pool: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         done = self.completed + self.failed + self.cancelled + self.rejected
         hist_keys = [f"<={b}ms" for b in _LINGER_BUCKETS_MS] + [
             f">{_LINGER_BUCKETS_MS[-1]}ms"
@@ -218,6 +219,10 @@ class SchedulerStats:
             # limit — the byte-level counterpart of row_cap (None
             # throughout on CPU where no limit is known).
             "hbm": obs_ledger.snapshot(),
+            # Block-paged pool view (engine.kv_pool_stats): free-block
+            # headroom + radix prefix hit rate — the block-level
+            # counterpart of row_cap on paged engines (None on dense).
+            "kv_pool": kv_pool,
         }
 
     def _spec_snapshot(self) -> Optional[Dict[str, Any]]:
@@ -246,10 +251,15 @@ def derive_row_cap(engine) -> Optional[int]:
         return None
     # Engines whose decode loops over-allocate cache past the token
     # budget (fast-forward's compacted tail, speculation's K+1 verify
-    # window) expose the true worst-case window; max_model_len is only
-    # exact for the plain loop.
+    # window) expose the true worst-case window — as a method OR a plain
+    # int attribute (a non-callable int was once silently ignored in
+    # favor of max_model_len, under-sizing the window exactly for the
+    # engines that declared one); max_model_len only covers engines
+    # declaring nothing.
     window = getattr(engine, "worst_case_decode_window", None)
-    return cap_for(int(window()) if callable(window) else int(max_len))
+    if callable(window):
+        window = window()
+    return cap_for(int(window) if window else int(max_len))
 
 
 class Scheduler:
@@ -627,8 +637,12 @@ class Scheduler:
             return self._queue_rows
 
     def snapshot(self) -> Dict[str, Any]:
+        pool_stats = getattr(self._engine, "kv_pool_stats", None)
+        kv_pool = pool_stats() if callable(pool_stats) else None
         with self._cond:
-            return self.stats.snapshot(self._row_cap, self._queue_rows)
+            return self.stats.snapshot(
+                self._row_cap, self._queue_rows, kv_pool=kv_pool
+            )
 
     def _publish_stats(self) -> None:
         from bcg_tpu.runtime import metrics

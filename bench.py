@@ -661,6 +661,12 @@ def _run_attempt(cfg, model: str, backend: str, concurrency: int,
             # BCG_TPU_SPEC/BENCH_SPEC: speculative-decoding draft
             # acceptance over the whole run (engine.spec.* counters).
             "spec_stats": _spec_stats_or_none(),
+            # BCG_TPU_PAGED_KV: block-pool snapshot (free-block headroom
+            # bytes, radix prefix hit rate); None on dense engines.
+            "kv_pool": (
+                engine.kv_pool_stats()
+                if hasattr(engine, "kv_pool_stats") else None
+            ),
             "window_decode_steps": window_steps,
             "window_failed_row_fraction": round(failed_fraction, 4),
             "baseline_denominator_dec_per_sec": (

@@ -39,7 +39,7 @@ from typing import Dict, List, Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-ARMS = ("plain", "ff", "spec")
+ARMS = ("plain", "ff", "spec", "paged")
 _MODEL = "bcg-tpu/tiny-test"
 _SCHEMA = {
     "type": "object",
@@ -92,6 +92,10 @@ def run_scenario(arms=ARMS) -> Dict[str, Dict]:
             max_model_len=512,
             decode_fast_forward=(arm == "ff"),
             spec_decode=(arm == "spec"),
+            # The paged arm lowers the block-gather/scatter programs
+            # under their own entry names (prefill_paged /
+            # paged_decode_loop) so the dense entries never drift.
+            paged_kv=(arm == "paged"),
         )
         engine = JaxEngine(cfg)
         try:

@@ -51,7 +51,7 @@ from typing import Dict, List, Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-SCENARIOS = ("serve", "engine", "hlo")
+SCENARIOS = ("serve", "engine", "paged", "hlo")
 REGRESSIONS = ("none", "spec-off", "fail-rows")
 
 DECISION = {
@@ -220,6 +220,117 @@ def run_engine_scenario(inject: str = "none") -> Dict[str, float]:
     }
 
 
+def run_paged_scenario(inject: str = "none") -> Dict[str, float]:
+    """Block-paged KV cache (engine/paged_kv.py) gates, all hermetic:
+
+    * ``positions_real_per_agent_slope`` — per-game real prefill
+      positions per agent at N=8 over N=2 (fresh engine per N, shared
+      system prompt + per-agent tail).  Radix sharing prefills the
+      shared prefix ONCE per game, so the ratio must stay well under 1
+      (the superlinear-sharing acceptance assertion);
+      ``positions_real_monotone`` is 1.0 iff strictly decreasing over
+      N in {2, 4, 8}.
+    * ``prefix_hit_rate`` — radix hit rate after a second round on a
+      persistent engine (grown history extends round 1's chain).
+    * ``greedy_parity_mismatches`` — paged vs dense greedy outputs on
+      the same prompts (must be 0: token-identical by construction).
+    * ``row_cap_gain`` — serve admission cap (derive_row_cap) of a
+      paged engine over the dense worst-case provisioner at the SAME
+      synthetic HBM budget; > 1 because the pool unifies the dense
+      path's separate prefix reserve and needs no ALIGN_S padding.
+    """
+    _force_cpu()
+    from bcg_tpu.config import EngineConfig
+    from bcg_tpu.engine.jax_engine import JaxEngine
+    from bcg_tpu.obs import counters as obs_counters
+    from bcg_tpu.serve.scheduler import derive_row_cap
+
+    def cfg(**kw):
+        return EngineConfig(
+            backend="jax", model_name="bcg-tpu/tiny-test",
+            max_model_len=2048, **kw,
+        )
+
+    shared_sys = (
+        "You are an agent in a Byzantine consensus game. The rules are "
+        "long and shared by every participant: propose integer values, "
+        "exchange them with peers, and vote to stop once values converge "
+        "within the consensus threshold. " * 3
+    )
+    per_agent: Dict[int, float] = {}
+    for n_agents in (2, 4, 8):
+        eng = JaxEngine(cfg(paged_kv=True))
+        before = obs_counters.value("engine.prefill.positions_real")
+        eng.batch_generate_json(
+            [(shared_sys, f"You are agent_{i}. Round 1. Peers said 17. "
+              "Decide.", VOTE) for i in range(n_agents)],
+            temperature=0.0, max_tokens=24,
+        )
+        moved = obs_counters.value("engine.prefill.positions_real") - before
+        per_agent[n_agents] = moved / n_agents
+        eng.shutdown()
+    monotone = float(per_agent[2] > per_agent[4] > per_agent[8])
+
+    # Parity + hit rate: two rounds on ONE paged engine vs a dense twin.
+    prompts = [
+        (shared_sys + f" You are agent_{i}.", "Round 1. Decide.", DECISION)
+        for i in range(3)
+    ]
+    dense = JaxEngine(cfg())
+    paged = JaxEngine(cfg(paged_kv=True))
+    try:
+        mismatches = 0
+        for round_no in (1, 2):
+            batch = [
+                (s, f"Round {round_no}. Peers said 17. Decide.", sch)
+                for s, _, sch in prompts
+            ]
+            r_d = dense.batch_generate_json(batch, temperature=0.0,
+                                            max_tokens=48)
+            r_p = paged.batch_generate_json(batch, temperature=0.0,
+                                            max_tokens=48)
+            mismatches += sum(1 for a, b in zip(r_d, r_p) if a != b)
+        pool = paged.kv_pool_stats() or {}
+        hit_rate = pool.get("prefix_hit_rate") or 0.0
+    finally:
+        dense.shutdown()
+        paged.shutdown()
+
+    # Admission gain at one synthetic HBM budget.  The dense reserve
+    # uses the boot formula's fraction WITHOUT its 256 MB large-model
+    # floor (which would zero the dense budget at test-sized synthetic
+    # limits and overstate the gain); the paged pool gets the same
+    # budget with no separate reserve — the structural win under test.
+    limit = 32 << 20
+    dense = JaxEngine(cfg())
+    dense._mem_limit = limit
+    free = (dense.config.hbm_utilization * limit
+            - dense._param_bytes_per_device)
+    dense._prefix_budget = max(0, int(free * 0.25))
+    dense_cap = derive_row_cap(dense) or 1
+    # Size the equivalent pool at the block size the paged engine will
+    # actually use (the config default) — a hardcoded 16 would silently
+    # desync the comparison if the default ever moves (e.g. to the
+    # Pallas kernel's 128).
+    bs_blk = EngineConfig().kv_block_size
+    block_bytes = bs_blk * dense._kv_slot_bytes * dense.spec.num_layers
+    usable = max(64, int(free // block_bytes))
+    dense.shutdown()
+    paged = JaxEngine(cfg(paged_kv=True, kv_pool_blocks=usable + 1))
+    paged_cap = derive_row_cap(paged) or 1
+    paged.shutdown()
+
+    if inject == "fail-rows":
+        mismatches += 1  # self-test hook: provoke the parity gate
+    return {
+        "paged.positions_real_per_agent_slope": per_agent[8] / per_agent[2],
+        "paged.positions_real_monotone": monotone,
+        "paged.prefix_hit_rate": hit_rate,
+        "paged.greedy_parity_mismatches": float(mismatches),
+        "paged.row_cap_gain": paged_cap / dense_cap,
+    }
+
+
 def run_hlo_scenario(inject: str = "none") -> Dict[str, float]:
     """Kernel-census drift findings (scripts/hlo_census.py) as a gated
     metric — 0 findings = the lowered programs still match
@@ -241,6 +352,7 @@ def run_hlo_scenario(inject: str = "none") -> Dict[str, float]:
 _RUNNERS = {
     "serve": run_serve_scenario,
     "engine": run_engine_scenario,
+    "paged": run_paged_scenario,
     "hlo": run_hlo_scenario,
 }
 

@@ -125,6 +125,10 @@ def render_report(trace: dict, top: int = 20) -> str:
     if spec_line:
         lines.append("")
         lines.append(spec_line)
+    prefill_line = prefill_positions(counters)
+    if prefill_line:
+        lines.append("")
+        lines.append(prefill_line)
     hbm = hbm_ledger_section(counters)
     if hbm:
         lines.append("")
@@ -194,6 +198,22 @@ def spec_acceptance(counters: Dict[str, float]) -> str:
     return (
         f"== speculative decoding: {accepted}/{drafted} draft tokens "
         f"accepted ({100.0 * accepted / drafted:.1f}%) =="
+    )
+
+
+def prefill_positions(counters: Dict[str, float]) -> str:
+    """One-line real-vs-padded prefill position summary
+    (engine.prefill.positions_*); '' when the export carries neither.
+    Real positions are actual prompt-token work — prefix-cache savings
+    show up here without pad noise; the padded total is what the FLOP
+    bill sees."""
+    padded = counters.get("engine.prefill.positions_padded")
+    if not padded:
+        return ""
+    real = counters.get("engine.prefill.positions_real", 0)
+    return (
+        f"== prefill positions: {int(real)} real / {int(padded)} padded "
+        f"({100.0 * real / padded:.1f}% real work) =="
     )
 
 

@@ -258,6 +258,36 @@ class TestLedger:
         eng.shutdown()
         assert obs_ledger.LEDGER.total("params") == base
 
+    def test_paged_evict_readmit_cycles_stay_idempotent(self):
+        """The paged radix index syncs its resident set through ONE
+        keyed prefix_cache charge: insert/evict/re-admit cycles must
+        track exactly (replace semantics), and eviction can never fire
+        on a refcount-pinned chain mid-decode."""
+        from bcg_tpu.engine.paged_kv import PagedKV
+        from bcg_tpu.models.configs import MODEL_SPECS
+        import numpy as np
+
+        mgr = PagedKV(MODEL_SPECS["bcg-tpu/tiny-test"], 8, 2)
+        key = object()
+        mgr.set_ledger_key(key)
+        bb = mgr.block_bytes_dev
+        base = obs_ledger.LEDGER.total("prefix_cache")
+        try:
+            toks = np.array([1, 2, 3, 4], dtype=np.int32)
+            for _cycle in range(3):
+                mgr.insert([], toks, 0, mgr.alloc(2))
+                assert (obs_ledger.LEDGER.total("prefix_cache") - base
+                        == 2 * bb)
+                # Pinned (in-flight): eviction must not fire.
+                assert mgr.evict(2) == 0
+                assert (obs_ledger.LEDGER.total("prefix_cache") - base
+                        == 2 * bb)
+                mgr.unpin_all()
+                assert mgr.evict(2) == 2
+                assert obs_ledger.LEDGER.total("prefix_cache") - base == 0
+        finally:
+            obs_ledger.credit("prefix_cache", key)
+
     def test_serve_snapshot_carries_hbm_block(self):
         from bcg_tpu.engine.fake import FakeEngine
         from bcg_tpu.serve.scheduler import Scheduler

@@ -110,14 +110,16 @@ class TestCensusScenario:
     def test_all_loop_families_recorded(self, scenario):
         _, census = scenario
         for entry in ("prefill", "prefill_suffix", "decode_loop",
-                      "ff_decode_loop", "spec_decode_loop"):
+                      "ff_decode_loop", "spec_decode_loop",
+                      "prefill_paged", "paged_decode_loop"):
             assert entry in census, sorted(census)
             assert "error" not in census[entry], census[entry]
             assert census[entry]["total_ops"] > 0
 
     def test_decode_loops_have_step_kernels(self, scenario):
         _, census = scenario
-        for entry in ("decode_loop", "ff_decode_loop", "spec_decode_loop"):
+        for entry in ("decode_loop", "ff_decode_loop", "spec_decode_loop",
+                      "paged_decode_loop"):
             assert census[entry]["step_fusions"] > 0
             assert census[entry]["whiles"] >= 1
 

@@ -397,6 +397,11 @@ class TestRetraceCounters:
         steady = {
             k: v for k, v in obs_counters.delta(after_first).items()
             if k.startswith("engine.")
+            # engine.prefill.positions_* are per-call PROGRESS counters
+            # (real/padded prefill work) — they legitimately move every
+            # call; this test pins the compile/retrace/spec families,
+            # where any steady-state movement is a regression.
+            and not k.startswith("engine.prefill.positions_")
         }
         assert steady == {}, f"steady-state decode retraced: {steady}"
         # A new token budget is a new decode-loop signature: exactly +1
@@ -412,6 +417,7 @@ class TestRetraceCounters:
         repeat = {
             k: v for k, v in obs_counters.delta(before_repeat).items()
             if k.startswith("engine.")
+            and not k.startswith("engine.prefill.positions_")  # per-call progress
         }
         assert repeat == {}, repeat
         engine.shutdown()

@@ -1,0 +1,421 @@
+"""Block-paged KV cache with radix-tree prefix sharing.
+
+Four layers of guarantees:
+
+* **Host bookkeeping** (no engine): radix match/insert over token ids,
+  refcount pins blocking eviction mid-call, LRU order at refcount 0,
+  allocator pressure and exhaustion, ledger idempotence across
+  evict/re-admit cycles.
+* **Transformer parity**: paged write/gather against the dense slab is
+  BIT-identical (bf16 and int8 pools) — the property the engine-level
+  token-identity claims reduce to.
+* **Engine parity + stability**: greedy outputs token-identical paged
+  vs dense (incl. speculative decoding and the int8-KV compose), and
+  zero steady-state retraces while block-table CONTENTS vary.
+* **The win, gated**: per-game real prefill positions drop
+  superlinearly with agent count, radix hit rate across rounds, and a
+  strictly higher admission cap than the dense provisioner at the same
+  synthetic HBM budget — asserted here (tier-1) against the same
+  numbers ``scripts/perf_gate.py``'s ``paged`` scenario gates in CI.
+"""
+
+import dataclasses
+import importlib.util
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bcg_tpu.config import EngineConfig
+from bcg_tpu.engine.jax_engine import JaxEngine
+from bcg_tpu.engine.paged_kv import PagedKV, PoolExhausted
+from bcg_tpu.models import init_params, prefill, spec_for_model
+from bcg_tpu.models.transformer import decode_step, init_kv_cache, prefill_paged
+from bcg_tpu.obs import counters as obs_counters, ledger as obs_ledger
+from bcg_tpu.ops.paged_attention import init_block_pool
+
+SCHEMA = {
+    "type": "object",
+    "properties": {
+        "decision": {"type": "string", "enum": ["stop", "continue"]},
+        "value": {"type": "integer", "minimum": 0, "maximum": 50},
+    },
+    "required": ["decision", "value"],
+    "additionalProperties": False,
+}
+
+
+def _cfg(**kw):
+    return EngineConfig(
+        backend="jax", model_name="bcg-tpu/tiny-test", max_model_len=2048,
+        **kw,
+    )
+
+
+def _mgr(num_blocks=16, block_size=2):
+    return PagedKV(
+        spec_for_model("bcg-tpu/tiny-test"), num_blocks, block_size
+    )
+
+
+class TestRadixIndex:
+    def test_lookup_matches_longest_full_block_chain(self):
+        mgr = _mgr()
+        toks = np.arange(7, dtype=np.int32)  # 3 full blocks + 1 leftover
+        path, blocks = mgr.lookup(toks)
+        assert path == [] and blocks == []
+        ids = mgr.alloc(3)
+        mgr.insert([], toks, 0, ids)
+        path, blocks = mgr.lookup(toks)
+        assert blocks == ids and len(path) == 3
+        # A diverging sequence shares exactly its common prefix blocks.
+        other = np.array([0, 1, 2, 3, 9, 9], dtype=np.int32)
+        path2, blocks2 = mgr.lookup(other)
+        assert blocks2 == ids[:2]
+        mgr.unpin_all()
+
+    def test_shared_chain_between_different_sequences(self):
+        mgr = _mgr()
+        a = np.array([5, 6, 7, 8], dtype=np.int32)
+        ids = mgr.alloc(2)
+        mgr.insert([], a, 0, ids)
+        # Second sequence with the same first block grafts only its own
+        # second block; the first is shared (same node, same id).
+        b = np.array([5, 6, 1, 2], dtype=np.int32)
+        path_b, blocks_b = mgr.lookup(b)
+        assert blocks_b == ids[:1]
+        ids_b = mgr.alloc(1)
+        mgr.insert(path_b, b, 2, ids_b)
+        assert mgr.resident_blocks == 3
+        mgr.unpin_all()
+
+    def test_duplicate_insert_reuses_node_and_keeps_caller_ownership(self):
+        mgr = _mgr()
+        toks = np.array([1, 2, 3, 4], dtype=np.int32)
+        ids = mgr.alloc(2)
+        mgr.insert([], toks, 0, ids)
+        dup = mgr.alloc(2)
+        grafted = mgr.insert([], toks, 0, dup)
+        # The existing nodes win; the duplicate ids are NOT freed by
+        # insert (the caller keeps and frees them — a double-free here
+        # once meant one block allocated twice).
+        assert [n.block for n in grafted] == ids
+        assert mgr.resident_blocks == 2
+        assert all(i not in mgr._free for i in dup)
+        mgr.free(dup)
+        mgr.unpin_all()
+
+    def test_refcount_pin_blocks_eviction_mid_call(self):
+        """The satellite guarantee: eviction under allocation pressure
+        must never free a block an in-flight batch references."""
+        mgr = _mgr(num_blocks=6, block_size=2)  # 5 usable
+        toks = np.array([1, 2, 3, 4], dtype=np.int32)
+        ids = mgr.alloc(2)
+        mgr.insert([], toks, 0, ids)  # insert pins the grafted path
+        # 3 free remain; asking for 5 must NOT evict the pinned chain.
+        with pytest.raises(PoolExhausted):
+            mgr.alloc(5)
+        assert mgr.resident_blocks == 2
+        path, blocks = mgr.lookup(toks)
+        assert blocks == ids  # still resident
+        # After the call's unpin, the same pressure may evict.
+        mgr.unpin_all()
+        got = mgr.alloc(5)
+        assert len(got) == 5 and mgr.resident_blocks == 0
+
+    def test_eviction_is_lru_and_leaf_only(self):
+        mgr = _mgr(num_blocks=8, block_size=2)
+        old = np.array([1, 2], dtype=np.int32)
+        young = np.array([3, 4, 5, 6], dtype=np.int32)  # chain of 2
+        mgr.insert([], old, 0, mgr.alloc(1))
+        mgr.insert([], young, 0, mgr.alloc(2))
+        mgr.unpin_all()
+        mgr.lookup(young)  # touch: young chain is now most recent
+        mgr.unpin_all()
+        assert mgr.evict(1) == 1
+        # The stale single-block chain went first; the touched chain
+        # survives intact (its interior node is not a leaf).
+        _, blocks = mgr.lookup(young)
+        assert len(blocks) == 2
+        _, blocks_old = mgr.lookup(old)
+        assert blocks_old == []
+        mgr.unpin_all()
+
+    def test_ledger_charge_idempotent_across_evict_readmit(self):
+        """Satellite 3: evict/re-admit cycles must leave the
+        prefix_cache account exactly tracking the resident set — the
+        keyed charge REPLACES, never accumulates."""
+        mgr = _mgr(num_blocks=8, block_size=2)
+        key = object()
+        mgr.set_ledger_key(key)
+        bb = mgr.block_bytes_dev
+        try:
+            toks = np.array([1, 2, 3, 4], dtype=np.int32)
+            for _cycle in range(3):
+                mgr.insert([], toks, 0, mgr.alloc(2))
+                mgr.unpin_all()
+                assert obs_ledger.LEDGER._entries["prefix_cache"][key] == 2 * bb
+                assert mgr.evict(2) == 2
+                assert obs_ledger.LEDGER._entries["prefix_cache"][key] == 0
+        finally:
+            obs_ledger.credit("prefix_cache", key)
+
+    def test_stats_surface(self):
+        mgr = _mgr(num_blocks=8, block_size=2)
+        toks = np.array([1, 2, 3, 4], dtype=np.int32)
+        mgr.lookup(toks)  # cold miss: 0 of 4 positions
+        mgr.insert([], toks, 0, mgr.alloc(2))
+        mgr.unpin_all()
+        mgr.lookup(toks)  # warm hit: 4 of 4 positions
+        mgr.unpin_all()
+        s = mgr.stats()
+        assert s["blocks_total"] == 7
+        assert s["blocks_resident"] == 2
+        assert s["blocks_free"] == 5
+        assert s["free_block_headroom_bytes"] == 5 * mgr.block_bytes_dev
+        assert s["prefix_hit_rate"] == 0.5  # 4 hit of 8 looked-up positions
+
+
+class TestTransformerParity:
+    @pytest.mark.parametrize("quantized", [False, True])
+    def test_paged_prefill_decode_bit_identical_to_dense(self, quantized):
+        spec = spec_for_model("bcg-tpu/tiny-test")
+        params = init_params(spec, jax.random.PRNGKey(0))
+        B, L, bs = 2, 10, 4
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(3), (B, L), 0, spec.vocab_size
+        )
+        valid = jnp.ones((B, L), bool)
+
+        S = L + 6
+        cache = init_kv_cache(spec, B, S, quantized=quantized)
+        logits_d, cache = prefill(params, spec, tokens, valid, cache)
+        vm = np.zeros((B, S), bool)
+        vm[:, :L] = True
+        ref = [logits_d]
+        tok = jnp.argmax(logits_d, -1)
+        plens = jnp.full((B,), L, jnp.int32)
+        for i in range(3):
+            vm[:, L + i] = True
+            lg, cache = decode_step(
+                params, spec, tok, L + i, plens + i, cache, jnp.asarray(vm)
+            )
+            ref.append(lg)
+            tok = jnp.argmax(lg, -1)
+
+        nblk = -(-S // bs)
+        Sp = nblk * bs
+        pool = init_block_pool(spec, 32, bs, quantized=quantized)
+        tbl = np.stack(
+            [np.arange(1, 1 + nblk), np.arange(10, 10 + nblk)]
+        ).astype(np.int32)
+        entries = [
+            {**pool[li], "tbl": jnp.asarray(tbl)}
+            for li in range(spec.num_layers)
+        ]
+        logits_p, entries = prefill_paged(
+            params, spec, tokens, valid, entries,
+            jnp.zeros((B, 0), bool), jnp.zeros((B,), jnp.int32),
+        )
+        np.testing.assert_array_equal(np.asarray(logits_p), np.asarray(ref[0]))
+        vmp = np.zeros((B, Sp), bool)
+        vmp[:, :L] = True
+        tok = jnp.argmax(logits_p, -1)
+        for i in range(3):
+            vmp[:, L + i] = True
+            lg, entries = decode_step(
+                params, spec, tok, L + i, plens + i, entries, jnp.asarray(vmp)
+            )
+            np.testing.assert_array_equal(np.asarray(lg), np.asarray(ref[i + 1]))
+            tok = jnp.argmax(lg, -1)
+
+
+class TestEnginePagedParity:
+    def test_greedy_token_identical_and_radix_persists(self):
+        prompts = [
+            ("You are honest agent_1 in a consensus game.",
+             "Round 1. decide now.", SCHEMA),
+            ("You are byzantine agent_2 in a consensus game.",
+             "Round 1. decide now.", SCHEMA),
+        ]
+        dense = JaxEngine(_cfg())
+        r_dense = dense.batch_generate_json(
+            prompts, temperature=0.0, max_tokens=40
+        )
+        dense.shutdown()
+        paged = JaxEngine(_cfg(paged_kv=True))
+        try:
+            r_paged = paged.batch_generate_json(
+                prompts, temperature=0.0, max_tokens=40
+            )
+            assert r_paged == r_dense
+            stats1 = paged.kv_pool_stats()
+            assert stats1["blocks_resident"] > 0
+            # Round 2 reuses the resident chains: hit rate appears and
+            # identical-shape calls with DIFFERENT table contents must
+            # not retrace (contents are traced values, not shapes).
+            before = obs_counters.snapshot()
+            paged.batch_generate_json(
+                [(s, "Round 1. decide now.", SCHEMA)
+                 for s, _, _ in prompts],
+                temperature=0.0, max_tokens=40,
+            )
+            paged.batch_generate_json(
+                [("You are sneaky agent_9 in a consensus game.",
+                  "Round 1. decide now.", SCHEMA),
+                 ("You are honest agent_1 in a consensus game.",
+                  "Round 1. decide now.", SCHEMA)],
+                temperature=0.0, max_tokens=40,
+            )
+            moved = obs_counters.delta(before)
+            retraces = {
+                k: v for k, v in moved.items()
+                if k.startswith(("engine.retrace.", "engine.compile."))
+            }
+            assert retraces == {}, retraces
+            stats2 = paged.kv_pool_stats()
+            assert stats2["prefix_hit_rate"] > 0
+            # Private decode blocks were all returned: only the radix-
+            # resident set holds blocks between calls.
+            assert (stats2["blocks_free"]
+                    == stats2["blocks_total"] - stats2["blocks_resident"])
+        finally:
+            paged.shutdown()
+
+    def test_spec_decode_int8_compose_token_identical(self):
+        """The acceptance compose: speculative decoding + int8 KV over
+        the paged pool, greedy outputs identical to the dense twin."""
+        prompts = [
+            ("You are honest agent_1 in a consensus game.",
+             "Round 1. decide now.", SCHEMA),
+            ("You are byzantine agent_2 in a consensus game.",
+             "Round 1. decide now.", SCHEMA),
+        ]
+        extra = dict(spec_decode=True, kv_cache_dtype="int8")
+        with pytest.warns(UserWarning, match="int8 KV cache"):
+            dense = JaxEngine(_cfg(**extra))
+        r_dense = dense.batch_generate_json(
+            prompts, temperature=0.0, max_tokens=40
+        )
+        dense.shutdown()
+        with pytest.warns(UserWarning, match="int8 KV cache"):
+            paged = JaxEngine(_cfg(paged_kv=True, **extra))
+        try:
+            r_paged = paged.batch_generate_json(
+                prompts, temperature=0.0, max_tokens=40
+            )
+            assert r_paged == r_dense
+        finally:
+            paged.shutdown()
+
+    def test_paged_rejects_sequence_parallel_and_chunked_prefill(self):
+        with pytest.raises(ValueError, match="prefill_chunk"):
+            JaxEngine(_cfg(paged_kv=True, prefill_chunk=128))
+        # sp > 1 must be a LOUD boot error: pool blocks are shared
+        # across rows, so the sequence dim structurally cannot shard —
+        # silently serving replicated would defeat the configured
+        # parallelism (and a broken guard would serve wrong attention).
+        from jax.sharding import Mesh
+
+        mesh = Mesh(
+            np.asarray(jax.devices()[:2]).reshape(1, 1, 2),
+            ("dp", "tp", "sp"),
+        )
+        with pytest.raises(ValueError, match="sequence parallelism"):
+            JaxEngine(_cfg(paged_kv=True), mesh=mesh)
+
+
+class TestAdmission:
+    def test_free_block_cap_and_serve_snapshot(self):
+        """The serving surface of the win: derive_row_cap answers from
+        free-block accounting (no device limit needed), and the
+        scheduler snapshot carries the pool's headroom block."""
+        from bcg_tpu.serve.scheduler import Scheduler, derive_row_cap
+
+        engine = JaxEngine(_cfg(paged_kv=True, kv_pool_blocks=513,
+                                kv_block_size=16))
+        try:
+            cap = derive_row_cap(engine)
+            # worst window 2048 tokens -> 128 blocks/row over 512 usable.
+            assert cap == 4
+            sched = Scheduler(engine, linger_ms=1)
+            try:
+                snap = sched.snapshot()
+                assert snap["row_cap"] == 4
+                assert snap["kv_pool"]["blocks_total"] == 512
+                assert snap["kv_pool"]["free_block_headroom_bytes"] > 0
+            finally:
+                sched.close()
+        finally:
+            engine.shutdown()
+
+    def test_budget_guard_warns_in_blocks(self):
+        engine = JaxEngine(_cfg(paged_kv=True, kv_pool_blocks=66,
+                                kv_block_size=16))
+        try:
+            with pytest.warns(UserWarning, match="paged pool"):
+                engine._check_kv_budget(8, [64], 65)
+        finally:
+            engine.shutdown()
+
+
+@pytest.fixture(scope="module")
+def paged_gate_metrics():
+    """One run of the perf-gate ``paged`` scenario — tier-1 asserts the
+    acceptance criteria against the SAME numbers CI gates."""
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts", "perf_gate.py",
+    )
+    spec = importlib.util.spec_from_file_location("perf_gate", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod, mod.run_paged_scenario()
+
+
+class TestSuperlinearSharing:
+    def test_positions_real_per_agent_strictly_decreasing(self, paged_gate_metrics):
+        _, m = paged_gate_metrics
+        assert m["paged.positions_real_monotone"] == 1.0
+        # Superlinear: doubling agents far more than halves the shared
+        # cost — per-agent positions at N=8 must be well under N=2's.
+        assert m["paged.positions_real_per_agent_slope"] < 0.6
+
+    def test_round_over_round_hit_rate_and_parity(self, paged_gate_metrics):
+        _, m = paged_gate_metrics
+        assert m["paged.greedy_parity_mismatches"] == 0.0
+        assert m["paged.prefix_hit_rate"] > 0.5
+
+    def test_admission_cap_strictly_beats_dense_at_same_budget(
+        self, paged_gate_metrics
+    ):
+        _, m = paged_gate_metrics
+        assert m["paged.row_cap_gain"] > 1.0
+
+    def test_metrics_conform_to_perf_baseline(self, paged_gate_metrics):
+        """The load-bearing-baseline contract extends to the paged
+        scenario: every metric baselined, every bound met."""
+        mod, m = paged_gate_metrics
+        findings = mod.check_metrics(m, mod.load_baseline())
+        findings += mod.check_stale(m, mod.load_baseline(), ("paged",))
+        assert findings == [], findings
+
+    def test_removing_a_paged_entry_resurfaces_its_finding(
+        self, paged_gate_metrics
+    ):
+        """Deleting a paged baseline entry RESURFACES its check instead
+        of silencing it (the test_perf_gate contract, owned here for
+        the paged.* namespace)."""
+        import json
+
+        mod, m = paged_gate_metrics
+        baseline = mod.load_baseline()
+        for removed in m:
+            pruned = json.loads(json.dumps(baseline))
+            del pruned["metrics"][removed]
+            findings = mod.check_metrics(m, pruned)
+            assert any(
+                removed in f and "no entry" in f for f in findings
+            ), (removed, findings)

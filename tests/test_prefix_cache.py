@@ -219,3 +219,52 @@ class TestEnginePrefixCaching:
         assert r_on == r_off
         on.shutdown()
         off.shutdown()
+
+
+class TestPagedEvictionSafety:
+    def test_eviction_pressure_never_corrupts_in_flight_batches(self):
+        """Paged engine with a pool barely larger than one call's
+        working set: alternating distinct system prompts forces radix
+        eviction on nearly every call, but refcount pins guarantee the
+        CURRENT batch's chain survives — outputs stay token-identical
+        to an unpressured dense engine throughout, and the ledger's
+        prefix_cache account keeps tracking the post-eviction resident
+        set exactly (idempotent keyed charge)."""
+        import numpy as np
+
+        from bcg_tpu.obs import ledger as obs_ledger
+
+        cfg = EngineConfig(backend="jax", model_name="bcg-tpu/tiny-test",
+                           max_model_len=2048)
+        dense = JaxEngine(cfg)
+        paged = JaxEngine(dataclasses.replace(
+            cfg, paged_kv=True, kv_block_size=16, kv_pool_blocks=48,
+        ))
+        sys_a = "You are the honest consensus agent with detailed rules. " * 2
+        sys_b = "You are the byzantine saboteur with long instructions. " * 2
+        try:
+            for round_no in range(3):
+                for sysp in (sys_a, sys_b):
+                    rows = [(sysp, f"Round {round_no}. decide.", SCHEMA)]
+                    r_d = dense.batch_generate_json(
+                        rows, temperature=0.0, max_tokens=24
+                    )
+                    r_p = paged.batch_generate_json(
+                        rows, temperature=0.0, max_tokens=24
+                    )
+                    assert r_p == r_d
+                    # Ledger tracks the resident set exactly after every
+                    # evict/re-admit cycle.
+                    charged = obs_ledger.LEDGER._entries["prefix_cache"][
+                        id(paged)
+                    ]
+                    assert charged == (
+                        paged._paged.resident_blocks
+                        * paged._paged.block_bytes_dev
+                    )
+            assert int(np.asarray(
+                paged.kv_pool_stats()["blocks_resident"]
+            )) > 0
+        finally:
+            dense.shutdown()
+            paged.shutdown()

@@ -328,6 +328,41 @@ class TestDeadlines:
         assert len(out) == 1
 
 
+class TestDeriveRowCap:
+    """Both forms of ``worst_case_decode_window`` must be honored: the
+    JaxEngine method AND a plain int attribute (stubs, foreign engines).
+    The int form was once silently ignored in favor of max_model_len —
+    under-sizing the admission window exactly for engines that declared
+    a wider one."""
+
+    class WindowedStub(CappedStubEngine):
+        def __init__(self, window, **kw):
+            super().__init__(cap=0, **kw)
+            self.worst_case_decode_window = window
+            self.seen = None
+
+        def cap_for(self, S: int):
+            self.seen = S
+            return 7
+
+    def test_int_valued_window_is_honored(self):
+        stub = self.WindowedStub(window=3000)
+        assert derive_row_cap(stub) == 7
+        assert stub.seen == 3000  # NOT the 2048 max_model_len
+
+    def test_callable_window_still_works(self):
+        stub = self.WindowedStub(window=lambda: 2500)
+        assert derive_row_cap(stub) == 7
+        assert stub.seen == 2500
+
+    def test_absent_window_falls_back_to_max_len(self):
+        inner = CappedStubEngine(cap=4)
+        seen = []
+        inner.cap_for = lambda S: seen.append(S) or 4
+        assert derive_row_cap(inner) == 4
+        assert seen == [2048]
+
+
 class TestAdmission:
     def test_oversize_request_rejected_at_synthetic_budget(self):
         """Strict admission (explicit bucket): a request that can never
