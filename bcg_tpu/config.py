@@ -121,13 +121,21 @@ class EngineConfig:
     # tail prefills.  Greedy output is token-identical to the dense path
     # (tested); admission derives from free blocks instead of the dense
     # worst-case slab.  Opt-in during the transition (env override
-    # BCG_TPU_PAGED_KV=1); requires sequence_parallel_size == 1 and
-    # prefill_chunk == 0.
+    # BCG_TPU_PAGED_KV=1); requires sequence_parallel_size == 1.
     paged_kv: bool = False
+    # Paged decode-attention implementation (env override
+    # BCG_TPU_PAGED_KV_IMPL): "pallas" = the fused page-gather kernel
+    # (ops/paged_attention.py — double-buffered page DMA indexed by the
+    # row's block table, online softmax, in-VMEM int8 dequant; interpret
+    # mode off-TPU), "xla" = the block-gather reference (bit-identical
+    # to dense, the conformance oracle), "auto" = pallas on TPU and xla
+    # elsewhere.
+    paged_kv_impl: str = "auto"
     # Tokens per KV block (env override BCG_TPU_KV_BLOCK_SIZE).  Smaller
     # blocks share finer prefixes but widen block tables; 16 balances
-    # the two at BCG prompt scales (a future Pallas paged kernel wants
-    # multiples of the TPU lane count — see DESIGN.md).
+    # the two at BCG prompt scales (the Pallas paged kernel streams
+    # BCG_TPU_PAGED_PAGES_PER_PROGRAM blocks per program, so lane-count
+    # windows come from page grouping, not block size — see DESIGN.md).
     kv_block_size: int = 16
     # Pool size in blocks (0 = auto: sized from the HBM budget when the
     # device exposes a limit, else a CPU-test allowance; env override

@@ -442,11 +442,6 @@ class JaxEngine(InferenceEngine):
         self._paged_call_private: List[int] = []
         self._paged_dirty = False
         self._paged_toks_memo: Dict[str, np.ndarray] = {}
-        if self.paged_kv and self.prefill_chunk:
-            raise ValueError(
-                "paged_kv does not compose with prefill_chunk yet; the "
-                "paged suffix prefill is single-pass — disable one"
-            )
 
         quant_mode = config.quantization  # None | "int8" | "int4"
         quantize = quant_mode is not None
@@ -839,8 +834,79 @@ class JaxEngine(InferenceEngine):
             # the dense prefix cache — same ledger account, same
             # engine-keyed idempotent charge, credited by shutdown().
             self._paged.set_ledger_key(id(self))
+            # Paged decode-attention impl: the fused Pallas page-gather
+            # kernel vs the XLA block-gather reference (the oracle).
+            # Env wins over the config field; "auto" = pallas where the
+            # kernel can lower natively (TPU, lane-aligned head dim),
+            # xla elsewhere.  An EXPLICIT pallas off-TPU runs the
+            # kernel in interpret mode (the parity-test path).
+            from bcg_tpu.runtime.envflags import get_str as _get_str
+
+            raw_impl = (
+                (_get_str("BCG_TPU_PAGED_KV_IMPL") or "").strip().lower()
+                or str(getattr(config, "paged_kv_impl", "auto") or "auto").lower()
+            )
+            if raw_impl not in ("auto", "xla", "pallas"):
+                raise ValueError(
+                    f"paged_kv_impl={raw_impl!r}: expected 'auto', 'xla' "
+                    "or 'pallas'"
+                )
+            on_tpu = jax.default_backend() == "tpu"
+            lane_ok = self.spec.head_dim % 128 == 0
+            if raw_impl == "auto":
+                # "where the kernel can lower natively": a head dim
+                # Mosaic cannot tile silently stays on the reference —
+                # default boots must not warn about a choice nobody made.
+                resolved = "pallas" if on_tpu and lane_ok else "xla"
+            else:
+                resolved = raw_impl
+            if resolved == "pallas" and on_tpu and not lane_ok:
+                import warnings
+
+                # EXPLICIT pallas only: same lane-alignment guard as the
+                # dense decode kernel, falling back LOUDLY.
+                warnings.warn(
+                    f"paged_kv_impl='pallas' with head_dim "
+                    f"{self.spec.head_dim} not a multiple of 128: the "
+                    "kernel cannot lower on TPU — using the XLA gather "
+                    "reference",
+                    stacklevel=2,
+                )
+                resolved = "xla"
+            self.paged_kv_impl = resolved  # "xla" | "pallas" (stats/bench)
+            from bcg_tpu.ops.paged_attention import (
+                PALLAS as _PAGED_PALLAS,
+                PALLAS_INTERPRET as _PAGED_PALLAS_IT,
+            )
+
+            # The marker the decode loops pass through transformer's
+            # ``impl`` parameter (models/transformer._cache_attention /
+            # _block_chunk dispatch on it for "tbl" entries).
+            self._paged_loop_impl = (
+                "xla" if resolved == "xla"
+                else _PAGED_PALLAS if on_tpu
+                else _PAGED_PALLAS_IT
+            )
+            if self.prefill_chunk:
+                # Paged chunked prefill gathers each chunk's history at
+                # BLOCK granularity (whole table columns), so the chunk
+                # size aligns UP to the pool's block size — at most
+                # bs-1 extra tokens of activation per chunk.
+                self.prefill_chunk += (-self.prefill_chunk) % bs_blk
+            # Worst-case transient blocks of one radix entry build (the
+            # bucketed scratch tail) — carved out of the admission math
+            # so an admitted batch cannot hit PoolExhausted mid-prefill
+            # (see _paged_scratch_blocks).
+            self._paged_scratch_blocks = self._paged_build_scratch_blocks()
             self._prefill_paged = jax.jit(
                 partial(prefill_paged, spec=self.spec,
+                        impl=self.attention_impl),
+                donate_argnames=("cache",),
+            )
+            from bcg_tpu.models.transformer import prefill_paged_chunk_at
+
+            self._prefill_paged_chunk_at = jax.jit(
+                partial(prefill_paged_chunk_at, spec=self.spec,
                         impl=self.attention_impl),
                 donate_argnames=("cache",),
             )
@@ -1505,11 +1571,13 @@ class JaxEngine(InferenceEngine):
             pv[0, :matched] = True
             cache = mgr.entries(tbl)
             self._paged_dirty = True
-            _, cache = obs_hlo.wrap("prefill_paged", self._prefill_paged)(
-                self.params, tokens=jnp.asarray(tokens),
-                valid=jnp.asarray(valid), cache=cache,
-                prefix_valid=jnp.asarray(pv),
-                prefix_lens=jnp.asarray([matched], np.int32),
+            # Long remainders chunk through the same driver as batch
+            # prefills (prefill_chunk configured): an 8B-scale cold
+            # prefix build must not regress to the O(L) activation
+            # spike chunked prefill exists to cap.
+            _, cache = self._prefill_paged_possibly_chunked(
+                tokens, valid, Lr_pad, cache, pv,
+                np.asarray([matched], np.int32),
             )
             mgr.adopt(cache)
             self._paged_dirty = False
@@ -1731,15 +1799,47 @@ class JaxEngine(InferenceEngine):
         # (transformer.decode_step ring= -> sp_decode_attention).  An
         # int8 cache dequantizes only its local S/sp slice in there.
         ring = (self.mesh, "sp") if self._sp_devices > 1 else None
-        key = (guided_sig, int(max_new), float(top_p),
-               self.decode_attention_impl)
+        impl = self._resolved_loop_impl()
+        key = (guided_sig, int(max_new), float(top_p), impl)
         if key in self._decode_loops:
             return self._decode_loops[key]
         self._note_jit_shape("decode_loop", key)
         self._decode_ring_active = ring is not None
+        compiled = self._build_decode_loop(impl, max_new, top_p, ring)
+        self._decode_loops[key] = compiled
+        return compiled
 
+    def _resolved_loop_impl(self, chunk: bool = False) -> str:
+        """Attention impl marker a decode loop passes through the
+        transformer's ``impl`` parameter — ONE resolution for all three
+        loop families, so a change to the selection logic can never give
+        the plain/ff/spec loops different kernels for the same config.
+        Paged engines pass the resolved paged marker (the "tbl" dispatch
+        in ``_cache_attention``/``_block_chunk`` reads it; dense impls
+        never see a paged entry and vice versa).  Dense chunk windows
+        (``chunk=True``: the ff and spec K+1 verify forms) run the
+        Pallas chunk kernel only for int8 caches — for bf16, flash would
+        pad the K chunk rows to a 128-row query block, so stock XLA
+        attention wins."""
+        if self._paged is not None:
+            return self._paged_loop_impl
+        if not chunk:
+            return self.decode_attention_impl
+        return (
+            "pallas"
+            if self.kv_quantized and self.decode_attention_impl == "pallas"
+            else "xla"
+        )
+
+    def _build_decode_loop(self, impl: str, max_new: int, top_p: float,
+                           ring=None):
+        """The standard decode loop as an (unmemoized) jitted callable
+        with an EXPLICIT attention impl — :meth:`_get_decode_loop` is
+        the memoized resolver; the census's TPU cross-lowering twins
+        (:meth:`_maybe_record_paged_tpu_lowering`) build gather and
+        fused variants of the same program without touching the
+        executed loops' cache or compile counters."""
         spec = self.spec
-        impl = self.decode_attention_impl
         eos_id = self.tokenizer.eos_id
         sampler = self._make_masked_sampler(eos_id, top_p)
 
@@ -1797,9 +1897,34 @@ class JaxEngine(InferenceEngine):
             # (measured: pushed an 8B compile 8 GB past HBM capacity).
             return out, (rng, i), cache
 
-        compiled = jax.jit(loop, static_argnames=("L",), donate_argnums=(1,))
-        self._decode_loops[key] = compiled
-        return compiled
+        return jax.jit(loop, static_argnames=("L",), donate_argnums=(1,))
+
+    def _maybe_record_paged_tpu_lowering(self, max_new: int, top_p: float,
+                                         args: tuple) -> None:
+        """Census-only (BCG_TPU_HLO_CENSUS): pin the TPU CROSS-LOWERING
+        of the paged decode loop under both impls — the XLA block-gather
+        and the fused Pallas kernel — from this call's concrete
+        arguments, WITHOUT executing either (trace + lower only, so the
+        non-interpret kernel records its real Mosaic ``tpu_custom_call``
+        lowering even on a CPU host; see obs/hlo.py's stablehlo-census
+        note).  These two entries carry the acceptance inequality: the
+        fused loop's step ops strictly below the gather loop's, the
+        per-layer attention gather/dot chains replaced by exactly one
+        ``tpu_custom_call`` per layer (tests/test_hlo_census.py;
+        hlo_baseline.json drift-gates both directions — the remaining
+        step gathers are the write-path table lookups and the embedding
+        gather, identical in both arms).  Must run BEFORE the real loop
+        call — tracing reads the donated pool buffers, execution
+        consumes them."""
+        from bcg_tpu.ops.paged_attention import PALLAS
+
+        for entry, impl in (("tpu_paged_decode_loop", "xla"),
+                            ("tpu_paged_pallas_decode_loop", PALLAS)):
+            if obs_hlo.recorded(entry):
+                continue
+            obs_hlo.record_tpu_lowering(
+                entry, self._build_decode_loop(impl, max_new, top_p), args,
+            )
 
     def _get_ff_decode_loop(self, guided_sig: Tuple, max_new: int,
                             top_p: float = 1.0):
@@ -1816,14 +1941,7 @@ class JaxEngine(InferenceEngine):
         """
         from bcg_tpu.guided.processor import FF_CHUNK as K
 
-        # int8 cache -> the Pallas chunk kernel (when the engine resolved
-        # a Pallas decode impl); bf16 -> stock XLA attention (flash would
-        # pad the K chunk rows to a 128-row query block).
-        chunk_impl = (
-            "pallas"
-            if self.kv_quantized and self.decode_attention_impl == "pallas"
-            else "xla"
-        )
+        chunk_impl = self._resolved_loop_impl(chunk=True)
         # Sequence-parallel chunk decode: the cache stays sp-sharded
         # inside the ff loop too (sp_chunk_decode_attention); an int8
         # cache dequantizes only its local S/sp slice in there.
@@ -1940,11 +2058,7 @@ class JaxEngine(InferenceEngine):
         win is weight-streaming passes ~ verify passes, not tokens.
         Per-row acceptance counts live in the while-loop CARRY, never in
         a shape — steady-state speculative decode is retrace-free."""
-        chunk_impl = (
-            "pallas"
-            if self.kv_quantized and self.decode_attention_impl == "pallas"
-            else "xla"
-        )
+        chunk_impl = self._resolved_loop_impl(chunk=True)
         ring = (self.mesh, "sp") if self._sp_devices > 1 else None
         key = ("spec", guided_sig, int(max_new), float(top_p),
                self.spec_k, self.spec_ngram, chunk_impl)
@@ -2202,6 +2316,75 @@ class JaxEngine(InferenceEngine):
             )
         return first_logits, cache
 
+    def _prefill_paged_possibly_chunked(self, tokens, valid, Ls: int, cache,
+                                        prefix_valid, prefix_lens):
+        """Paged prefill — single-pass, or ``prefill_chunk``-sized slices
+        streamed through the block pool when configured and the window
+        exceeds the chunk.  The paged sibling of
+        :meth:`_prefill_possibly_chunked`, closing the former
+        ``paged + prefill_chunk`` boot exclusion: long prompts no longer
+        force an O(B * L) activation pass to use paging.
+
+        Chunk ``k`` writes logical slots ``[P + kC, P + kC + C)`` through
+        each row's block table and attends the radix prefix plus every
+        earlier chunk via a FIXED ``[B, H]`` history mask + traced write
+        position (transformer.prefill_paged_chunk_at), so all full-width
+        chunks share ONE compiled program per (B, C, H) — same
+        zero-steady-state-retrace contract as the dense chunk path.
+        Because chunks are RIGHT-padded, per-row last-valid logits thread
+        through a carry instead of reading the final physical position.
+        Serves batch prefills AND the radix entry builds (B=1 remainder
+        prefills route here too)."""
+        C = self.prefill_chunk
+        if not C or Ls <= C:
+            return obs_hlo.wrap("prefill_paged", self._prefill_paged)(
+                self.params, tokens=self._put_batch(np.asarray(tokens)),
+                valid=self._put_batch(np.asarray(valid)), cache=cache,
+                prefix_valid=self._put_batch(np.asarray(prefix_valid)),
+                prefix_lens=self._put_batch(
+                    np.asarray(prefix_lens, np.int32)
+                ),
+            )
+        tokens = np.asarray(tokens)
+        valid = np.asarray(valid)
+        prefix_valid = np.asarray(prefix_valid)
+        bs = self._paged.block_size
+        if Ls % bs:
+            # The fixed history window H = P + Ls - C must be
+            # block-aligned (the chunk gathers whole table columns), and
+            # C already is (boot alignment) — align the WINDOW up with
+            # trailing pad columns.  Safe: the pad slots lie inside the
+            # table's block-rounded coverage and are masked everywhere;
+            # the decode loop overwrites them before unmasking.
+            pad = (-Ls) % bs
+            tokens = np.pad(tokens, ((0, 0), (0, pad)))
+            valid = np.pad(valid, ((0, 0), (0, pad)))
+            Ls += pad
+        B = tokens.shape[0]
+        P = prefix_valid.shape[1]
+        base_lens = np.asarray(prefix_lens, dtype=np.int64)
+        logits = jnp.zeros((B, self.spec.vocab_size), jnp.float32)
+        for start in range(0, Ls, C):
+            Ct = min(C, Ls - start)
+            H = P + Ls - Ct
+            hist = np.zeros((B, H), dtype=bool)
+            hist[:, :P] = prefix_valid
+            hist[:, P:P + start] = valid[:, :start]
+            pos_off = base_lens + valid[:, :start].sum(axis=1)
+            logits, cache = obs_hlo.wrap(
+                "prefill_paged_chunk", self._prefill_paged_chunk_at
+            )(
+                self.params,
+                tokens=self._put_batch(tokens[:, start:start + Ct]),
+                valid=self._put_batch(valid[:, start:start + Ct]),
+                cache=cache,
+                hist_valid=self._put_batch(hist),
+                pos_offset=self._put_batch(pos_off.astype(np.int32)),
+                write_pos=jnp.int32(P + start),
+                carry_logits=logits,
+            )
+        return logits, cache
+
     def _decode_batch(
         self, parts, batch, sig_prefix, real_B, temps, budgets,
         top_p,
@@ -2231,6 +2414,13 @@ class JaxEngine(InferenceEngine):
                     self._paged.free(self._paged_call_private)
                     self._paged_call_private = []
                     self._paged.unpin_all()
+                # Publish the post-call pool snapshot (incl. the active
+                # impl) for consumers without an engine handle — the
+                # bench error path's forensics (runtime/metrics idiom,
+                # same as LAST_SERVE_STATS).
+                from bcg_tpu.runtime import metrics as _metrics
+
+                _metrics.publish_kv_pool(self.kv_pool_stats())
             obs_ledger.credit("kv_cache", id(self))
             obs_ledger.credit("spec_slots", id(self))
             if self._mem_limit is not None:
@@ -2291,13 +2481,8 @@ class JaxEngine(InferenceEngine):
                     parts, budgets, decode_slots
                 )
                 self._paged_dirty = True
-                first_logits, cache = obs_hlo.wrap(
-                    "prefill_paged", self._prefill_paged
-                )(
-                    self.params, tokens=self._put_batch(tokens),
-                    valid=self._put_batch(valid), cache=cache,
-                    prefix_valid=self._put_batch(prefix_valid),
-                    prefix_lens=self._put_batch(prefix_lens),
+                first_logits, cache = self._prefill_paged_possibly_chunked(
+                    tokens, valid, Ls, cache, prefix_valid, prefix_lens
                 )
                 self._paged.adopt(cache)
                 self._paged_dirty = False
@@ -2418,8 +2603,16 @@ class JaxEngine(InferenceEngine):
         drafted = accepted = None
         # HLO-census entry names: the paged loops lower different
         # programs (block gather/scatter), so they pin under their own
-        # names instead of drifting the dense entries.
-        census_prefix = "paged_" if paged else ""
+        # names instead of drifting the dense entries — and the fused
+        # Pallas loops under theirs, so the census can assert the
+        # kernel's step counts BELOW the gather baseline.
+        if paged:
+            census_prefix = (
+                "paged_" if self._paged_loop_impl == "xla"
+                else "paged_pallas_"
+            )
+        else:
+            census_prefix = ""
         if paged:
             self._paged_dirty = True  # pool rides the donated loop call
         with obs_tracer.span("engine.decode",
@@ -2471,7 +2664,7 @@ class JaxEngine(InferenceEngine):
                     census_prefix + "decode_loop",
                     self._get_decode_loop(sig_prefix + (B, L), max_new, top_p),
                 )
-                out, (_, steps), _cache_out = loop(
+                loop_args = (
                     self.params, cache, first_logits,
                     self._put_batch(valid_mask),
                     self._put_batch(prompt_lens), L,
@@ -2482,6 +2675,14 @@ class JaxEngine(InferenceEngine):
                     self._put_batch(np.asarray(budgets, np.int32)),
                     sub,
                 )
+                if paged and obs_hlo.enabled():
+                    # Lowering-only census twins (gather vs fused) from
+                    # the same concrete args; must precede the call —
+                    # it consumes the donated pool.
+                    self._maybe_record_paged_tpu_lowering(
+                        max_new, top_p, loop_args
+                    )
+                out, (_, steps), _cache_out = loop(*loop_args)
             if paged:
                 # The loop wrote decode KV into private pool blocks
                 # through the donated carry: retain the returned pool
@@ -2640,6 +2841,48 @@ class JaxEngine(InferenceEngine):
         blocks_per_row = -(-self.worst_case_decode_window() // block_size) + 1
         return 16 * blocks_per_row + 1
 
+    def _paged_build_scratch_blocks(self) -> int:
+        """Worst-case TRANSIENT blocks one radix entry build holds past
+        its real content: the bucket pad tail (``_get_paged_entry``
+        rounds the remainder prefill up a suffix-ladder rung for stable
+        compile shapes; the pad blocks are freed the moment the insert
+        returns, but they are LIVE during the build).  Admission
+        (:meth:`cap_for`) carves this out of the usable pool — without
+        the reserve, a boundary-sized pool admits a batch whose cold
+        entry builds then hit ``PoolExhausted`` mid-prefill, exactly the
+        failure admission exists to make unreachable.  One build's worth
+        suffices: builds run sequentially and each frees its scratch
+        before the next allocates."""
+        bs = self._paged.block_size
+        worst = 0
+        prev = 0
+        for rung in self._suffix_buckets:
+            # Smallest block-aligned remainder mapping to this rung
+            # (remainders are whole-block by construction).
+            lr = (prev // bs + 1) * bs
+            if lr > self.max_model_len:
+                break
+            worst = max(worst, -(-rung // bs) - lr // bs)
+            prev = rung
+        return worst
+
+    def _paged_scratch_reserve(self) -> int:
+        """The entry-build scratch reserve admission subtracts — 0 when
+        radix prefix sharing cannot engage (uncached engines never build
+        entries)."""
+        return (
+            self._paged_scratch_blocks
+            if self.prefix_caching and self._prefix_safe
+            else 0
+        )
+
+    def _paged_usable_blocks(self) -> int:
+        """Blocks admission may budget: the pool minus the null block
+        minus the entry-build scratch reserve, floored at 1 (a pool
+        smaller than the reserve still admits single rows — the
+        exhaustion warning in ``_check_kv_budget`` owns that case)."""
+        return max(1, self._paged.num_blocks - 1 - self._paged_scratch_reserve())
+
     def cap_for(self, S: int) -> Optional[int]:
         """Concurrent-row cap for decode-cache length ``S``, derived
         from the mesh axes that actually engage (ADVICE round-5 medium).
@@ -2665,7 +2908,7 @@ class JaxEngine(InferenceEngine):
         configs get every row the layout genuinely affords."""
         if self._paged is not None:
             blocks_per_row = -(-S // self._paged.block_size)
-            return max(1, (self._paged.num_blocks - 1) // blocks_per_row)
+            return max(1, self._paged_usable_blocks() // blocks_per_row)
         budget = self._kv_row_budget()
         if budget is None:
             return None
@@ -2740,7 +2983,7 @@ class JaxEngine(InferenceEngine):
             bs_blk = self._paged.block_size
             S = self.max_model_len - min(budgets) - 1 + decode_res
             needed = B * (-(-S // bs_blk))
-            usable = self._paged.num_blocks - 1
+            usable = self._paged_usable_blocks()
             if needed > usable:
                 import warnings
 
@@ -2893,9 +3136,30 @@ class JaxEngine(InferenceEngine):
 
     def kv_pool_stats(self) -> Optional[Dict[str, Any]]:
         """Paged-pool snapshot (block counts, free-block headroom bytes,
-        radix prefix hit rate) for serve stats and bench JSON; None on
-        dense engines so consumers can render conditionally."""
-        return self._paged.stats() if self._paged is not None else None
+        radix prefix hit rate, the ACTIVE attention impl + kernel knobs)
+        for serve stats and bench JSON; None on dense engines so
+        consumers can render conditionally."""
+        if self._paged is None:
+            return None
+        from bcg_tpu.ops.paged_attention import (
+            PALLAS_INTERPRET, configured_pages_per_program,
+        )
+
+        stats = self._paged.stats()
+        stats["impl"] = self.paged_kv_impl
+        stats["interpret"] = self._paged_loop_impl == PALLAS_INTERPRET
+        # The CONFIGURED group size — each kernel call clamps it to its
+        # table width at trace time (ops/paged_attention).
+        stats["pages_per_program"] = (
+            configured_pages_per_program(stats["interpret"])
+            if self.paged_kv_impl == "pallas" else None
+        )
+        # The TRUE reserve, not num_blocks-1-usable: when the pool is
+        # smaller than the reserve, usable's floor of 1 would otherwise
+        # fabricate a smaller reserve in exactly the PoolExhausted
+        # forensics this field exists for.
+        stats["scratch_reserve_blocks"] = self._paged_scratch_reserve()
+        return stats
 
     def shutdown(self) -> None:
         self.params = None

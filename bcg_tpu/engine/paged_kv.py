@@ -152,15 +152,26 @@ class PagedKV:
         """Paged cache entries for a jit call: the pool plus the block
         table as a regular pytree leaf.  Each layer gets its OWN device
         copy of the (tiny) table so donated trees never alias one
-        buffer across leaves."""
+        buffer across leaves.  On a mesh the table is placed explicitly
+        REPLICATED (``sharding.paged_table_sharding``): it is the fused
+        kernel's scalar-prefetch operand, read whole by every device's
+        kernel instance."""
         import jax.numpy as jnp
 
         tbl = np.asarray(tbl, dtype=np.int32)
+        place = jnp.asarray
+        if self.mesh is not None and self.mesh.size > 1:
+            import jax
+
+            from bcg_tpu.parallel.sharding import paged_table_sharding
+
+            sharding = paged_table_sharding(self.mesh, stacked=self.stacked)
+            place = partial(jax.device_put, device=sharding)
         if self.stacked:
             lyr = self.spec.num_layers
             stacked_tbl = np.broadcast_to(tbl[None], (lyr,) + tbl.shape)
-            return {**self.pool, "tbl": jnp.asarray(stacked_tbl.copy())}
-        return [{**e, "tbl": jnp.asarray(tbl.copy())} for e in self.pool]
+            return {**self.pool, "tbl": place(stacked_tbl.copy())}
+        return [{**e, "tbl": place(tbl.copy())} for e in self.pool]
 
     def adopt(self, cache_out) -> None:
         """Retain the updated pool returned by a donated jit call

@@ -419,12 +419,14 @@ def _cache_attention(q, entry: Dict, mask, scale, impl: str):
     non-lane-aligned head dims) falls back to dequantize + stock einsum.
     """
     if "tbl" in entry:
-        # Paged cache: the block-table gather + stock masked attention
-        # (ops/paged_attention.py) — bit-identical to the dense path
-        # given identical block contents.
+        # Paged cache (ops/paged_attention.py): ``impl`` carries the
+        # engine-resolved paged marker — "paged_pallas"(+"_it") runs
+        # the fused page-gather kernel, anything else the block-table
+        # gather + stock masked attention (bit-identical to the dense
+        # path given identical block contents).
         from bcg_tpu.ops.paged_attention import paged_decode_attention
 
-        return paged_decode_attention(q, entry, mask, scale)
+        return paged_decode_attention(q, entry, mask, scale, impl=impl)
     quantized = "k_scale" in entry
     Dh = q.shape[-1]
     if impl == "pallas" and jax.default_backend() == "tpu" and Dh % 128 == 0:
@@ -861,6 +863,61 @@ def prefill_paged(
     return logits, new_cache
 
 
+def prefill_paged_chunk_at(
+    params: TransformerParams,
+    spec: ModelSpec,
+    tokens: jax.Array,         # [B, C] one RIGHT-padded prefill chunk
+    valid: jax.Array,          # [B, C] bool, False on trailing pads
+    cache: Dict,               # paged entries; slots [0, H) may hold
+                               # prior context (prefix + earlier chunks)
+    hist_valid: jax.Array,     # [B, H] attendable prior slots (False at
+                               # and past the chunk's own write region)
+    pos_offset: jax.Array,     # [B] RoPE position of each row's first
+                               # valid chunk token
+    write_pos: jax.Array,      # scalar int32: cache slot of chunk col 0
+    carry_logits: jax.Array,   # [B, V] f32: last-valid logits so far
+    impl: str = "xla",
+) -> Tuple[jax.Array, Dict]:
+    """One chunk of a PAGED chunked prefill — :func:`prefill_chunk_at`'s
+    block-pool sibling: the history window is a fixed ``[B, H]`` mask
+    and the write slot a traced scalar, so every full-width chunk of
+    every offset shares one compiled program per ``(B, C, H)``, with
+    two paged differences.  Chunks arrive RIGHT-padded (left-aligned,
+    the radix-insertable orientation — see :func:`prefill_paged`), so a
+    row's last valid token may sit mid-chunk; and because rows END in
+    different chunks, the final logits thread through ``carry_logits``:
+    each call takes logits at the row's last valid position *within
+    this chunk* and keeps the carry for rows with no valid tokens here.
+    Right-padding makes valid tokens contiguous from column 0, so after
+    the final chunk the carry holds every row's true last-valid logits.
+    The chunk's KV lands at logical slots ``[write_pos, write_pos+C)``
+    through each row's block table; ``H`` must be block-aligned (the
+    history gather reads whole table columns — the engine aligns its
+    chunk size to the pool's block size)."""
+    B, C = tokens.shape
+    positions = pos_offset[:, None] + jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1
+    positions = jnp.maximum(positions, 0)
+    cos, sin = rope_table(positions, spec.head_dim, spec.rope_theta, spec.rope_scaling)
+
+    H = hist_valid.shape[1]
+    causal = jnp.tril(jnp.ones((C, C), bool))
+    chunk_mask = causal[None] & valid[:, None, :] & valid[:, :, None]   # [B, C, C]
+    hist_mask = hist_valid[:, None, :] & valid[:, :, None]              # [B, C, H]
+    attn_mask = jnp.concatenate([hist_mask, chunk_mask], axis=2)        # [B, C, H+C]
+
+    x = params["embed"][tokens]
+    x, new_cache = _run_layers(
+        params, spec, x, cos, sin, write_pos, cache, attn_mask, impl,
+        hist_len=H,
+    )
+    nvalid = jnp.sum(valid.astype(jnp.int32), axis=1)                   # [B]
+    last = jnp.maximum(nvalid - 1, 0)
+    h_last = jnp.take_along_axis(x, last[:, None, None], axis=1)        # [B, 1, D]
+    logits = _logits(params, spec, h_last)[:, 0, :]
+    logits = jnp.where((nvalid > 0)[:, None], logits, carry_logits)
+    return logits, new_cache
+
+
 def prefill_chunk_at(
     params: TransformerParams,
     spec: ModelSpec,
@@ -1084,23 +1141,17 @@ def _block_chunk(
     scale = 1.0 / math.sqrt(spec.head_dim)
     quantized = "k_scale" in new_entry
     if "tbl" in new_entry:
-        # Paged cache (chunk form — fast-forward and speculative-verify
-        # loops): gather the row's blocks to the dense layout, attend,
-        # and return the PAGED entry for the carry.  The gathered view
-        # is a per-step transient; see ops/paged_attention.py.
-        from bcg_tpu.ops.paged_attention import paged_gather_entry
+        # Paged cache (chunk form — the fast-forward / speculative-
+        # verify decode windows; paged chunked PREFILL attends via
+        # ``_block``'s cached-prefix path instead): ``impl`` carries
+        # the engine-resolved paged marker — the fused kernel, or
+        # "xla" = gather to the dense layout and attend.  Either way
+        # the PAGED entry returns for the carry; see
+        # ops/paged_attention.py.
+        from bcg_tpu.ops.paged_attention import paged_chunk_attention
 
-        dense_view = paged_gather_entry(new_entry)
-        ck, cv = dense_view["k"], dense_view["v"]
-        if quantized:
-            from bcg_tpu.ops.decode_attention import dequantize_kv
-
-            ck = dequantize_kv(
-                ck, dense_view["k_scale"]).transpose(0, 2, 1, 3).astype(q.dtype)
-            cv = dequantize_kv(
-                cv, dense_view["v_scale"]).transpose(0, 2, 1, 3).astype(q.dtype)
-        attn_out = attention(
-            q, ck, cv, attn_mask, scale, "xla" if quantized else impl
+        attn_out = paged_chunk_attention(
+            q, new_entry, attn_mask, scale, impl=impl
         )
     elif ring is not None:
         # Sequence-parallel chunk decode: cache stays sharded over sp,

@@ -61,6 +61,7 @@ COUNT_METRICS = (
     "total_ops", "fusions", "custom_calls", "collectives", "scatters",
     "gathers", "dynamic_slices", "dots", "whiles",
     "step_ops", "step_fusions", "step_dots", "step_collectives",
+    "step_gathers", "step_custom_calls",
 )
 
 _comp_header_re = re.compile(r"^(ENTRY\s+)?%([\w.\-]+)\s*\(.*\)\s*->.*\{$")
@@ -114,7 +115,7 @@ def _categorize(ops: List[str]) -> Dict[str, int]:
         "dynamic_slices": sum(
             1 for o in ops if o in ("dynamic-slice", "dynamic-update-slice")
         ),
-        "dots": sum(1 for o in ops if o in ("dot", "convolution")),
+        "dots": sum(1 for o in ops if o in ("dot", "dot-general", "convolution")),
         "whiles": sum(1 for o in ops if o == "while"),
     }
 
@@ -142,11 +143,77 @@ def census_from_text(hlo_text: str) -> Dict[str, int]:
         if name in body_names:
             step_ops.extend(ops)
     census = _categorize(all_ops)
-    step = _categorize(step_ops)
-    census["step_ops"] = step["total_ops"]
-    census["step_fusions"] = step["fusions"]
-    census["step_dots"] = step["dots"]
-    census["step_collectives"] = step["collectives"]
+    census.update(_step_family(_categorize(step_ops)))
+    return census
+
+
+def _step_family(step: Dict[str, int]) -> Dict[str, int]:
+    return {
+        "step_ops": step["total_ops"],
+        "step_fusions": step["fusions"],
+        "step_dots": step["dots"],
+        "step_collectives": step["collectives"],
+        "step_gathers": step["gathers"],
+        "step_custom_calls": step["custom_calls"],
+    }
+
+
+# --------------------------------------------------- stablehlo (TPU lowering)
+# The compiled-HLO census above is post-fusion and backend-exact, but it
+# can only be taken on the backend the process runs on.  The claims the
+# Pallas paged-attention kernel makes are TPU claims — on CPU the kernel
+# runs through the interpret-mode EMULATION, whose lowering machinery
+# inflates op counts and proves nothing about the hardware program.
+# jax can, however, cross-LOWER a traced program for the TPU platform on
+# any host (Mosaic kernels serialize into ``tpu_custom_call`` at
+# lowering time; only the final compile needs hardware), so the fused-
+# vs-gather comparison is taken on the TPU StableHLO lowering instead:
+# both arms carry the identical transformer skeleton, and the attention
+# inner region is the only difference — N gather/reshape/softmax ops per
+# layer per step versus ONE fused kernel custom-call.  Pre-fusion op
+# counts are not kernel counts, but at the same IR level with the same
+# skeleton the strict inequality (and the per-layer attention gathers
+# and dots vanishing from the step body in favor of one custom call per
+# layer) is exactly the fusion claim, hermetically.
+
+_mlir_op_re = re.compile(r'(?:=\s*|^\s*)"?stablehlo\.([a-z_0-9]+)"?[\s("]')
+
+
+def census_from_stablehlo(text: str) -> Dict[str, int]:
+    """Op census over a StableHLO (MLIR) module, with the ``step_*``
+    family counting ops nested inside ``stablehlo.while`` regions.
+    ``constant``/``return`` lines are excluded (materialization noise at
+    this IR level); ``fusions`` is structurally 0 — StableHLO is
+    pre-fusion, which is why entries recorded this way pin the
+    comparison-bearing counts (gathers, custom calls, dots, step totals)
+    rather than claiming kernel counts."""
+    all_ops: List[str] = []
+    step_ops: List[str] = []
+    depth = 0
+    # Active while ops: [region base depth, regions-opened flag].  An op
+    # is in a step body iff it sits deeper than the OUTERMOST active
+    # while; a while is popped once its regions opened and closed back
+    # to base (`} do {` nets zero braces, so depth only returns to base
+    # at the real end).
+    stack: List[List] = []
+    for line in text.splitlines():
+        m = _mlir_op_re.search(line)
+        if m:
+            op = m.group(1).replace("_", "-")
+            if op not in ("constant", "return"):
+                all_ops.append(op)
+                if stack and depth > stack[0][0]:
+                    step_ops.append(op)
+                if op == "while":
+                    stack.append([depth, False])
+        depth += line.count("{") - line.count("}")
+        for entry in stack:
+            if depth > entry[0]:
+                entry[1] = True
+        while stack and stack[-1][1] and depth <= stack[-1][0]:
+            stack.pop()
+    census = _categorize(all_ops)
+    census.update(_step_family(_categorize(step_ops)))
     return census
 
 
@@ -228,6 +295,38 @@ def maybe_record(entry: str, jitted, args: tuple, kwargs: Optional[dict] = None)
         except Exception as exc:
             # A census failure must never take the serving call down;
             # the partial record names the failure for the script/test.
+            census["error"] = f"{type(exc).__name__}: {str(exc)[:200]}"
+        CENSUS[entry] = census
+    publish_gauges(entry, census)
+
+
+def recorded(entry: str) -> bool:
+    """True once ``entry`` has a census (callers can skip building the
+    arguments for a record that would be a no-op)."""
+    return entry in CENSUS
+
+
+def record_tpu_lowering(entry: str, jitted, args: tuple,
+                        kwargs: Optional[dict] = None) -> None:
+    """Record a census of ``jitted``'s TPU cross-lowering (StableHLO)
+    WITHOUT executing or compiling it — no hardware needed, and safe
+    for programs (like the non-interpret Pallas paged loop) that could
+    not run on this host at all.  The engine uses this to pin the
+    fused-kernel-vs-gather comparison hermetically; see the
+    stablehlo-census comment above.  First record per entry wins; a
+    failure is contained as an error record like :func:`maybe_record`."""
+    if not enabled() or entry in CENSUS:
+        return
+    with _lock:
+        if entry in CENSUS:  # raced
+            return
+        census: Dict[str, Any] = {}
+        try:
+            traced = jitted.trace(*args, **(kwargs or {}))
+            lowered = traced.lower(lowering_platforms=("tpu",))
+            census.update(census_from_stablehlo(lowered.as_text()))
+            census["backend"] = "stablehlo-tpu"
+        except Exception as exc:
             census["error"] = f"{type(exc).__name__}: {str(exc)[:200]}"
         CENSUS[entry] = census
     publish_gauges(entry, census)

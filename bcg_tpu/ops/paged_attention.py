@@ -27,24 +27,54 @@ never re-traces a decode loop (only ``nblk``/pool shapes key compiles).
 Block 0 is reserved as the null block: table padding points at it, it
 is never written, and every slot it backs is masked out of attention.
 
-This module is the XLA REFERENCE implementation: attention gathers the
-row's blocks into the dense layout (exact — a gather moves bits) and
-delegates to the stock masked attention, so paged output is
-bit-identical to the dense path given identical block contents.  The
-gathered view is a per-step transient (one layer live at a time under
-scan-over-layers); steady-state residency is the pool alone.  A fused
-Pallas kernel (double-buffered page DMA, the
-``jax.experimental.pallas.ops.tpu.paged_attention`` shape) can replace
-the gather without touching callers — the entry layout above matches
-the kernel's ``[num_pages, page_size, ...]`` paging convention.
+Two attention implementations share these layouts:
+
+* **XLA reference** (``impl="xla"``): gather the row's blocks into the
+  dense layout (exact — a gather moves bits) and delegate to the stock
+  masked attention, so paged output is bit-identical to the dense path
+  given identical block contents.  The gathered view is a per-step
+  transient — but it IS a per-step dense materialization, so on real
+  TPUs the HBM-bandwidth win of paging is unrealized on this path.
+* **Fused Pallas kernel** (``impl="paged_pallas"`` /
+  ``"paged_pallas_it"`` for interpret mode): the
+  ``jax.experimental.pallas.ops.tpu.paged_attention`` shape — grid over
+  (rows, page groups), the row's block table rides as a SCALAR-PREFETCH
+  operand so each page's BlockSpec index map reads its physical pool
+  slot from the table (``tbl[b, i]``), and the Pallas pipeline
+  double-buffers the page DMA from the HBM pool into VMEM.  Online-
+  softmax accumulation in VMEM scratch; int8 pools dequantize per page
+  in VMEM (no full-precision view ever materializes).  One program
+  covers all kv heads (the ``_decode_kernel_allheads`` lesson: per-head
+  programs paid ~2 us fixed cost each) and
+  ``BCG_TPU_PAGED_PAGES_PER_PROGRAM`` pages (amortizing program
+  overhead over small blocks; 128-token blocks = lane count need less
+  of it).  Steady-state decode reads each block exactly once.
+
+The engine resolves the impl (``EngineConfig.paged_kv_impl`` /
+``BCG_TPU_PAGED_KV_IMPL``): ``pallas`` is the default on TPU, the XLA
+gather stays the conformance oracle, and off-TPU the kernel runs in
+interpret mode (tests) — the gather path remains the CPU default.
 """
 
 from __future__ import annotations
 
+import functools
 from typing import Dict
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from bcg_tpu.parallel.compat import pallas_compiler_params
+
+_NEG_INF = -1e30
+
+# Engine-resolved impl markers for the paged attention dispatch
+# (models/transformer.py passes them through the decode loops' ``impl``
+# parameter; anything else selects the XLA gather reference).
+PALLAS = "paged_pallas"
+PALLAS_INTERPRET = "paged_pallas_it"
 
 
 def is_paged(entry: Dict) -> bool:
@@ -167,13 +197,41 @@ def paged_gather_entry(entry: Dict, upto_blocks: int = 0) -> Dict:
     return {"k": kv("k"), "v": kv("v")}
 
 
-def paged_decode_attention(q, entry: Dict, mask, scale):
-    """Single-token decode attention over a paged cache: gather the
-    row's blocks to the dense layout and run the stock masked einsum
-    attention (``transformer._xla_attention``) — the paged variant of
-    ``ops/decode_attention.decode_attention``.  q: ``[B, 1, H, Dh]``;
-    mask: ``[B, S]`` attendable logical slots.  Bit-identical to the
-    dense path by construction; the Pallas replacement slots in here."""
+def num_kv_heads(entry: Dict) -> int:
+    """Kv-head count, read off the pool's physical layout."""
+    return entry["k"].shape[1 if "k_scale" in entry else 2]
+
+
+def paged_decode_attention(q, entry: Dict, mask, scale, impl: str = "xla"):
+    """Single-token decode attention over a paged cache — the paged
+    variant of ``ops/decode_attention.decode_attention``.  q:
+    ``[B, 1, H, Dh]``; mask: ``[B, S]`` attendable logical slots.
+
+    ``impl`` :data:`PALLAS` / :data:`PALLAS_INTERPRET` runs the fused
+    page-gather kernel; anything else gathers the row's blocks to the
+    dense layout and runs the stock masked einsum attention
+    (``transformer._xla_attention``) — bit-identical to the dense path
+    by construction, and the kernel's conformance oracle."""
+    if impl in (PALLAS, PALLAS_INTERPRET):
+        from bcg_tpu.ops.decode_attention import pow2_rows
+
+        B, _, H, Dh = q.shape
+        Hkv = num_kv_heads(entry)
+        group = H // Hkv
+        g2 = pow2_rows(group)
+        qg = q[:, 0].reshape(B, Hkv, group, Dh)
+        if g2 != group:
+            # Same padded-GQA dispatch as the dense int8 kernel: the
+            # cache is what decode streams, so extra q rows cost MXU
+            # work only (ops/decode_attention.decode_attention).
+            qg = jnp.pad(qg, ((0, 0), (0, 0), (0, g2 - group), (0, 0)))
+        out = _paged_pallas_attention(
+            qg, entry, mask[:, None, :], scale,
+            interpret=(impl == PALLAS_INTERPRET),
+        )
+        if g2 != group:
+            out = out[:, :, :group]
+        return out.reshape(B, H, Dh)[:, None]
     from bcg_tpu.models.transformer import _xla_attention
     from bcg_tpu.ops.decode_attention import dequantize_kv
 
@@ -183,3 +241,237 @@ def paged_decode_attention(q, entry: Dict, mask, scale):
         k = dequantize_kv(k, dense["k_scale"]).transpose(0, 2, 1, 3).astype(q.dtype)
         v = dequantize_kv(v, dense["v_scale"]).transpose(0, 2, 1, 3).astype(q.dtype)
     return _xla_attention(q, k, v, mask[:, None, :], scale)
+
+
+def paged_chunk_attention(q, entry: Dict, mask, scale, impl: str = "xla"):
+    """Chunk decode attention over a paged cache — the fast-forward and
+    speculative-verify loops' ``[B, K]`` token windows (paged chunked
+    PREFILL never reaches here: its history attention runs through the
+    transformer's cached-prefix path, ``_block`` with ``hist_len``).
+    q: ``[B, K, H, Dh]``; mask: ``[B, K, S]``.
+
+    ``impl`` :data:`PALLAS` / :data:`PALLAS_INTERPRET` runs the fused
+    kernel with a ``[K*group, Dh]`` query tile per program (the
+    ``chunk_decode_attention`` shape — the prefill flash kernel would
+    pad K chunk rows to a 128-row block); the only other marker the
+    decode loops resolve is ``"xla"``, the gather reference."""
+    B, K, H, Dh = q.shape
+    if impl in (PALLAS, PALLAS_INTERPRET):
+        from bcg_tpu.ops.decode_attention import pow2_rows
+
+        Hkv = num_kv_heads(entry)
+        group = H // Hkv
+        g2 = pow2_rows(group)
+        # Pre-repeat the mask per query row (position-major: row
+        # k*g2+g covers chunk position k) and lay q out
+        # [B, Hkv, K*g2, Dh] to match — the chunk_decode_attention
+        # idiom: no in-kernel repeat, padded rows reuse their chunk's
+        # mask and are sliced away below.
+        mp = jnp.repeat(mask, g2, axis=1)                    # [B, K*g2, S]
+        qg = q.reshape(B, K, Hkv, group, Dh)
+        if g2 != group:
+            qg = jnp.pad(
+                qg, ((0, 0), (0, 0), (0, 0), (0, g2 - group), (0, 0))
+            )
+        qg = qg.transpose(0, 2, 1, 3, 4).reshape(B, Hkv, K * g2, Dh)
+        out = _paged_pallas_attention(
+            qg, entry, mp, scale, interpret=(impl == PALLAS_INTERPRET),
+        )
+        out = out.reshape(B, Hkv, K, g2, Dh)
+        if g2 != group:
+            out = out[:, :, :, :group]
+        return out.transpose(0, 2, 1, 3, 4).reshape(B, K, H, Dh)
+    from bcg_tpu.models.transformer import attention
+    from bcg_tpu.ops.decode_attention import dequantize_kv
+
+    dense = paged_gather_entry(entry)
+    ck, cv = dense["k"], dense["v"]
+    if "k_scale" in dense:
+        ck = dequantize_kv(
+            ck, dense["k_scale"]).transpose(0, 2, 1, 3).astype(q.dtype)
+        cv = dequantize_kv(
+            cv, dense["v_scale"]).transpose(0, 2, 1, 3).astype(q.dtype)
+    # Stock masked attention over the gathered dense view: the K-row
+    # decode windows reaching this branch are never flash-kernel
+    # material, and a quantized gather already dequantized to bf16.
+    return attention(q, ck, cv, mask, scale, "xla")
+
+
+# ------------------------------------------------------------ fused kernel
+
+def configured_pages_per_program(interpret: bool) -> int:
+    """The CONFIGURED page-group size: ``BCG_TPU_PAGED_PAGES_PER_
+    PROGRAM`` when set, else 1 under interpret mode (emulation has no
+    per-program dispatch cost to amortize) and 8 on hardware (measured
+    lesson from the dense kernels: ~2 us fixed cost per program
+    dominates small blocks — 8 x 16-token pages ≈ one 128-token lane
+    window per step).  This is what stats/bench surface; each kernel
+    call additionally clamps it to its table width
+    (:func:`pages_per_program`), and the value is read at TRACE time —
+    already-compiled programs keep the grouping they compiled with."""
+    from bcg_tpu.runtime.envflags import get_int
+
+    ppp = get_int("BCG_TPU_PAGED_PAGES_PER_PROGRAM")
+    return ppp if ppp > 0 else (1 if interpret else 8)
+
+
+def pages_per_program(nblk: int, interpret: bool) -> int:
+    """Pages each kernel program covers for an ``nblk``-wide table: the
+    configured group size clamped to the table width (the wrapper pads
+    the table with null blocks up to a multiple)."""
+    return max(1, min(configured_pages_per_program(interpret), nblk))
+
+
+def _paged_kernel(
+    tbl_ref, q_ref, *refs, scale, num_pg, hkv, ppp, bs, quantized,
+):
+    """One program of the fused paged-attention kernel: grid
+    ``(B, nblk/ppp)``, all kv heads per program.  ``refs`` carries, in
+    order, ``ppp`` K page refs, ``ppp`` V page refs, (quantized only)
+    ``ppp`` + ``ppp`` scale page refs, the mask ref, the output ref and
+    the three online-softmax scratch buffers.  Each page ref's block
+    was DMA'd from the pool slot the row's block table names
+    (``tbl[b, i*ppp + j]`` — the scalar-prefetch index maps in
+    :func:`_paged_pallas_attention`); ``tbl_ref`` itself is only the
+    prefetch operand and is not read here."""
+    del tbl_ref
+    k_refs = refs[:ppp]
+    v_refs = refs[ppp:2 * ppp]
+    if quantized:
+        ks_refs = refs[2 * ppp:3 * ppp]
+        vs_refs = refs[3 * ppp:4 * ppp]
+        mask_ref, o_ref, m_scr, l_scr, acc_scr = refs[4 * ppp:]
+    else:
+        ks_refs = vs_refs = None
+        mask_ref, o_ref, m_scr, l_scr, acc_scr = refs[2 * ppp:]
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    mask = mask_ref[0]                       # [M, ppp*bs]; M = 1 or rows
+    for j in range(ppp):
+        mj = mask[:, j * bs:(j + 1) * bs]    # [M, bs]
+        mjf = mj.astype(jnp.float32)
+        for h in range(hkv):
+            q = q_ref[0, h]                  # [rows, Dh]
+            if quantized:
+                # int8 page [Hkv, bs, Dh]: leading-dim head slice is a
+                # Mosaic-native (bs, Dh) int8 tile; dequant in VMEM.
+                k = k_refs[j][0, h].astype(jnp.float32) * ks_refs[j][0, h][:, None]
+                v = v_refs[j][0, h].astype(jnp.float32) * vs_refs[j][0, h][:, None]
+            else:
+                k = k_refs[j][0, :, h, :]    # bf16 page [bs, Hkv, Dh]
+                v = v_refs[j][0, :, h, :]
+            k = k.astype(q.dtype)
+            v = v.astype(q.dtype)
+            scores = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale                        # [rows, bs]
+            scores = jnp.where(mj, scores, _NEG_INF)
+            m_prev = m_scr[h]                # [rows, 1]
+            m_new = jnp.maximum(
+                m_prev, jnp.max(scores, axis=-1, keepdims=True)
+            )
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(scores - m_new) * mjf
+            m_scr[h] = m_new
+            l_scr[h] = alpha * l_scr[h] + jnp.sum(p, axis=-1, keepdims=True)
+            acc_scr[h] = alpha * acc_scr[h] + jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+    @pl.when(i == num_pg - 1)
+    def _finish():
+        for h in range(hkv):
+            l = l_scr[h]
+            o_ref[0, h] = (
+                acc_scr[h] / jnp.where(l == 0.0, 1.0, l)
+            ).astype(o_ref.dtype)
+
+
+def _paged_pallas_attention(qg, entry: Dict, mp, scale, interpret: bool):
+    """Shared pallas_call for the single-step and chunk paged paths.
+
+    qg ``[B, Hkv, rows, Dh]``; mp ``[B, M, S]`` with M == 1 (broadcast)
+    or rows, ``S = nblk * bs``.  Returns ``[B, Hkv, rows, Dh]``.
+
+    The block table is the scalar-prefetch operand: page ``j`` of grid
+    step ``(b, i)`` DMAs pool block ``tbl[b, i*ppp + j]`` — the Pallas
+    pipeline emitter prefetches the NEXT program's pages while this one
+    computes, which is the double-buffered page streaming the XLA
+    gather path cannot express.  Table CONTENTS are traced values, so
+    varying them between calls never re-traces (only pool/table shapes
+    key compiles — the same contract as the gather path)."""
+    tbl = entry["tbl"]
+    quantized = "k_scale" in entry
+    bs = block_size(entry)
+    B, nblk = tbl.shape
+    _, Hkv, rows, Dh = qg.shape
+    M = mp.shape[1]
+    ppp = pages_per_program(nblk, interpret)
+    pad = (-nblk) % ppp
+    if pad:
+        # Null-block padding: block 0 is all zeros and the padded mask
+        # columns are False, so padded pages contribute nothing.
+        tbl = jnp.pad(tbl, ((0, 0), (0, pad)))
+        mp = jnp.pad(mp, ((0, 0), (0, 0), (0, pad * bs)))
+    num_pg = (nblk + pad) // ppp
+
+    def kv_im(j):
+        return lambda b, i, t: (t[b, i * ppp + j], 0, 0, 0)
+
+    def sc_im(j):
+        return lambda b, i, t: (t[b, i * ppp + j], 0, 0)
+
+    if quantized:
+        kv_shape = (1, Hkv, bs, Dh)                  # int8 [N, Hkv, bs, Dh]
+        sc_shape = (1, Hkv, bs)                      # f32 [N, Hkv, bs]
+        page_specs = (
+            [pl.BlockSpec(kv_shape, kv_im(j)) for j in range(ppp)] * 2
+            + [pl.BlockSpec(sc_shape, sc_im(j)) for j in range(ppp)] * 2
+        )
+        page_args = (
+            [entry["k"]] * ppp + [entry["v"]] * ppp
+            + [entry["k_scale"]] * ppp + [entry["v_scale"]] * ppp
+        )
+    else:
+        kv_shape = (1, bs, Hkv, Dh)                  # bf16 [N, bs, Hkv, Dh]
+        page_specs = [
+            pl.BlockSpec(kv_shape, kv_im(j)) for j in range(ppp)
+        ] * 2
+        page_args = [entry["k"]] * ppp + [entry["v"]] * ppp
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, num_pg),
+        in_specs=[
+            pl.BlockSpec((1, Hkv, rows, Dh), lambda b, i, t: (b, 0, 0, 0)),
+            *page_specs,
+            pl.BlockSpec((1, M, ppp * bs), lambda b, i, t: (b, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, Hkv, rows, Dh), lambda b, i, t: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((Hkv, rows, 1), jnp.float32),
+            pltpu.VMEM((Hkv, rows, 1), jnp.float32),
+            pltpu.VMEM((Hkv, rows, Dh), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _paged_kernel, scale=scale, num_pg=num_pg, hkv=Hkv, ppp=ppp, bs=bs,
+        quantized=quantized,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, rows, Dh), qg.dtype),
+        compiler_params=pallas_compiler_params(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(tbl.astype(jnp.int32), qg, *page_args, mp)

@@ -203,6 +203,17 @@ def paged_pool_tree_sharding(mesh: Mesh, pool_shapes, quantized: bool = False,
     return jax.tree.map(place, pool_shapes)
 
 
+def paged_table_sharding(mesh: Mesh, stacked: bool = False) -> NamedSharding:
+    """Sharding for a paged entry's block-table leaf (``[B, nblk]``
+    int32; a leading ``[num_layers]`` dim under scan stacking): fully
+    REPLICATED.  The table is the Pallas paged kernel's scalar-prefetch
+    operand — every device's kernel instance resolves every row's pool
+    slots from it — and it is tiny (a few KB), so replication is both
+    required and free.  Placing it explicitly keeps the donated cache
+    tree's layout deterministic instead of letting GSPMD choose."""
+    return NamedSharding(mesh, P(*((None,) * (3 if stacked else 2))))
+
+
 def shard_bytes(shape, dtype, sharding=None) -> int:
     """Bytes of ONE device's shard of an array (full bytes when
     ``sharding`` is None).  The single shard-size computation behind

@@ -134,6 +134,20 @@ _register(
     "Paged KV pool size in blocks (0 = use EngineConfig.kv_pool_blocks, "
     "whose 0 = auto-size from the HBM budget / CPU-test allowance).",
 )
+_register(
+    "BCG_TPU_PAGED_KV_IMPL", "str", "",
+    "Paged decode-attention implementation (EngineConfig.paged_kv_impl "
+    "override): 'pallas' = the fused page-gather kernel "
+    "(ops/paged_attention.py; interpret mode off-TPU), 'xla' = the "
+    "block-gather reference (the conformance oracle), 'auto'/unset = "
+    "pallas on TPU, xla elsewhere.",
+)
+_register(
+    "BCG_TPU_PAGED_PAGES_PER_PROGRAM", "int", 0,
+    "KV pages each paged-attention kernel program streams (0 = auto: 8 "
+    "on hardware, 1 in interpret mode); amortizes per-program dispatch "
+    "cost over small blocks.",
+)
 
 # BCG_TPU_TRACE* — span tracer / observability (bcg_tpu/obs).
 _register(

@@ -47,6 +47,20 @@ def publish_serve_stats(snapshot: Dict) -> None:
     LAST_SERVE_STATS = snapshot
 
 
+# Latest paged KV-pool snapshot (engine.kv_pool_stats: block headroom,
+# radix hit rate, the active paged-attention impl) — published after
+# every paged generation call so bench.py can attach it on the ERROR
+# path too, where no engine handle survives.
+LAST_KV_POOL: Optional[Dict] = None
+
+
+def publish_kv_pool(snapshot: Optional[Dict]) -> None:
+    """Record the most recent paged-pool stats (called by the engine at
+    the end of each paged generation call)."""
+    global LAST_KV_POOL
+    LAST_KV_POOL = snapshot
+
+
 def _device_memory():
     """(bytes_in_use, peak_bytes_in_use) as the MAX across all devices,
     or (None, None) where the backend exposes no allocator stats (CPU).

@@ -154,6 +154,8 @@ _CONFIG_OVERRIDE_ENVS = (
     "BCG_TPU_ALLOW_PADDED_GROUP_KERNEL", "BCG_TPU_FINE_SUFFIX",
     "BCG_TPU_W8A16_PREFILL",
     "BCG_TPU_SPEC", "BCG_TPU_SPEC_K", "BCG_TPU_SPEC_NGRAM",
+    "BCG_TPU_PAGED_KV", "BCG_TPU_KV_BLOCK_SIZE", "BCG_TPU_KV_POOL_BLOCKS",
+    "BCG_TPU_PAGED_KV_IMPL", "BCG_TPU_PAGED_PAGES_PER_PROGRAM",
 )
 
 
@@ -186,6 +188,21 @@ def _spec_stats_or_none():
             "rejected": _counters.value("engine.spec.rejected"),
             "acceptance_rate": round(accepted / drafted, 4),
         }
+    except Exception:
+        # Inside the never-rc=1 contract (see _obs_payload).
+        return None
+
+
+def _kv_pool_stats_or_none():
+    """Latest paged KV-pool snapshot (block headroom, radix hit rate,
+    the ACTIVE paged-attention impl + kernel knobs) published by the
+    engine after each paged call; None on dense engines.  Read from
+    runtime.metrics (not the engine object) so the ERROR path — where
+    no engine handle survives — keeps the pool forensics too."""
+    try:
+        from bcg_tpu.runtime import metrics as _metrics
+
+        return _metrics.LAST_KV_POOL
     except Exception:
         # Inside the never-rc=1 contract (see _obs_payload).
         return None
@@ -259,6 +276,11 @@ def _error_result(exc: BaseException, retried: bool) -> dict:
     spec_stats = _spec_stats_or_none()
     if spec_stats:
         out["spec_stats"] = spec_stats
+    # Paged-pool snapshot of the failed attempt (incl. which attention
+    # impl served it) — same mid-crash-forensics idiom as serve_stats.
+    kv_pool = _kv_pool_stats_or_none()
+    if kv_pool:
+        out["kv_pool"] = kv_pool
     # Boot-phase breakdown of the failed attempt (engine boots record
     # into runtime.metrics.LAST_BOOT_PHASES even when construction
     # dies mid-phase): a RESOURCE_EXHAUSTED error line now names the

@@ -39,7 +39,7 @@ from typing import Dict, List, Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-ARMS = ("plain", "ff", "spec", "paged")
+ARMS = ("plain", "ff", "spec", "paged", "paged_pallas")
 _MODEL = "bcg-tpu/tiny-test"
 _SCHEMA = {
     "type": "object",
@@ -92,10 +92,15 @@ def run_scenario(arms=ARMS) -> Dict[str, Dict]:
             max_model_len=512,
             decode_fast_forward=(arm == "ff"),
             spec_decode=(arm == "spec"),
-            # The paged arm lowers the block-gather/scatter programs
+            # The paged arms lower the block-gather/scatter programs
             # under their own entry names (prefill_paged /
-            # paged_decode_loop) so the dense entries never drift.
-            paged_kv=(arm == "paged"),
+            # paged_decode_loop / paged_pallas_decode_loop) so the
+            # dense entries never drift.  The paged_pallas arm runs the
+            # fused kernel in interpret mode (this census is CPU) — its
+            # step counts are gated strictly BELOW the gather arm's
+            # (tests/test_hlo_census.py), the ISSUE-8 acceptance hook.
+            paged_kv=arm.startswith("paged"),
+            paged_kv_impl=("pallas" if arm == "paged_pallas" else "auto"),
         )
         engine = JaxEngine(cfg)
         try:

@@ -280,6 +280,7 @@ def run_paged_scenario(inject: str = "none") -> Dict[str, float]:
     paged = JaxEngine(cfg(paged_kv=True))
     try:
         mismatches = 0
+        round1_batch = round1_dense = None
         for round_no in (1, 2):
             batch = [
                 (s, f"Round {round_no}. Peers said 17. Decide.", sch)
@@ -290,11 +291,27 @@ def run_paged_scenario(inject: str = "none") -> Dict[str, float]:
             r_p = paged.batch_generate_json(batch, temperature=0.0,
                                             max_tokens=48)
             mismatches += sum(1 for a, b in zip(r_d, r_p) if a != b)
+            if round_no == 1:
+                round1_batch, round1_dense = batch, r_d
         pool = paged.kv_pool_stats() or {}
         hit_rate = pool.get("prefix_hit_rate") or 0.0
     finally:
         dense.shutdown()
         paged.shutdown()
+
+    # Impl parity: the fused Pallas kernel (interpret mode on this CPU
+    # host) must reproduce the dense greedy output on the same batch —
+    # the hermetic stand-in for the hardware kernel's token-identity
+    # claim, gated 0 exact like the gather path's parity above.
+    pallas = JaxEngine(cfg(paged_kv=True, paged_kv_impl="pallas"))
+    try:
+        r_k = pallas.batch_generate_json(round1_batch, temperature=0.0,
+                                         max_tokens=48)
+    finally:
+        pallas.shutdown()
+    pallas_mismatches = sum(
+        1 for a, b in zip(round1_dense, r_k) if a != b
+    )
 
     # Admission gain at one synthetic HBM budget.  The dense reserve
     # uses the boot formula's fraction WITHOUT its 256 MB large-model
@@ -327,6 +344,7 @@ def run_paged_scenario(inject: str = "none") -> Dict[str, float]:
         "paged.positions_real_monotone": monotone,
         "paged.prefix_hit_rate": hit_rate,
         "paged.greedy_parity_mismatches": float(mismatches),
+        "paged.pallas_parity_mismatches": float(pallas_mismatches),
         "paged.row_cap_gain": paged_cap / dense_cap,
     }
 

@@ -1,6 +1,6 @@
 """Block-paged KV cache with radix-tree prefix sharing.
 
-Four layers of guarantees:
+Six layers of guarantees:
 
 * **Host bookkeeping** (no engine): radix match/insert over token ids,
   refcount pins blocking eviction mid-call, LRU order at refcount 0,
@@ -9,9 +9,20 @@ Four layers of guarantees:
 * **Transformer parity**: paged write/gather against the dense slab is
   BIT-identical (bf16 and int8 pools) — the property the engine-level
   token-identity claims reduce to.
+* **Fused kernel parity** (interpret mode): the Pallas paged-attention
+  kernel against the XLA gather oracle at the real preset GQA
+  geometries, bf16 + int8 layouts, single-step and K+1 verify-chunk
+  forms, multi-page programs — then the same engine-level suite
+  (greedy parity, spec+int8 compose, eviction pressure, retrace pins)
+  rerun under ``paged_kv_impl="pallas"``.
 * **Engine parity + stability**: greedy outputs token-identical paged
   vs dense (incl. speculative decoding and the int8-KV compose), and
   zero steady-state retraces while block-table CONTENTS vary.
+* **Paged chunked prefill**: long prompts streamed through the pool
+  chunk-by-chunk stay token-identical to the one-pass dense path, the
+  chunk entry points pin at zero steady-state retraces, and admission
+  at a boundary-sized pool leaves room for the entry builds' transient
+  scratch blocks (the pre-reserve math demonstrably exhausts).
 * **The win, gated**: per-game real prefill positions drop
   superlinearly with agent count, radix hit rate across rounds, and a
   strictly higher admission cap than the dense provisioner at the same
@@ -34,7 +45,13 @@ from bcg_tpu.engine.paged_kv import PagedKV, PoolExhausted
 from bcg_tpu.models import init_params, prefill, spec_for_model
 from bcg_tpu.models.transformer import decode_step, init_kv_cache, prefill_paged
 from bcg_tpu.obs import counters as obs_counters, ledger as obs_ledger
-from bcg_tpu.ops.paged_attention import init_block_pool
+from bcg_tpu.ops.paged_attention import (
+    PALLAS_INTERPRET,
+    init_block_pool,
+    paged_chunk_attention,
+    paged_decode_attention,
+    paged_write,
+)
 
 SCHEMA = {
     "type": "object",
@@ -232,6 +249,126 @@ class TestTransformerParity:
             tok = jnp.argmax(lg, -1)
 
 
+class TestPallasKernelParity:
+    """The fused Pallas paged-attention kernel (interpret mode on this
+    CPU host — the same launch config hardware lowers) against the XLA
+    block-gather reference, which is bit-identical to dense by
+    construction and therefore the oracle.  Geometries are the real
+    preset GQA head ratios (``models/configs.py``): group 4 is the
+    8B/llama family, group 7 (Qwen2.5-7B) exercises the padded-GQA
+    dispatch (``pow2_rows``), group 2 is the CPU test preset.  Masks
+    always leave >= 1 attendable slot per query row: a fully-masked row
+    is unreachable from the engine (decode always attends the current
+    position; padded chunk rows are masked consumers whose outputs are
+    never read), and the two impls legitimately differ there (the
+    kernel's ``l == 0`` guard returns 0; finite ``_NEG_INF`` softmax
+    returns the uniform mean)."""
+
+    # (H, Hkv, Dh) — GQA group ratios from the model presets.
+    GEOMETRIES = [
+        pytest.param(32, 8, 128, id="qwen3-8b-group4"),
+        pytest.param(28, 4, 128, id="qwen2.5-7b-group7-nonpow2"),
+        pytest.param(4, 2, 16, id="tiny-test-group2"),
+    ]
+
+    @staticmethod
+    def _entry(H, Hkv, Dh, quantized, key, B=2, bs=8, nblk=4, pool_n=12):
+        spec = dataclasses.replace(
+            spec_for_model("bcg-tpu/tiny-test"),
+            num_heads=H, num_kv_heads=Hkv, head_dim=Dh, num_layers=1,
+        )
+        S = nblk * bs
+        pool = init_block_pool(spec, pool_n, bs, quantized=quantized)[0]
+        ks = jax.random.split(key, 3)
+        # Non-contiguous, per-row disjoint physical blocks (row 1's
+        # table overlaps nothing of row 0's) — the shapes radix sharing
+        # actually produces.
+        tbl = jnp.asarray(np.stack(
+            [np.arange(1, 1 + nblk), np.arange(5, 5 + nblk)]
+        ).astype(np.int32))
+        entry = paged_write(
+            {**pool, "tbl": tbl},
+            jax.random.normal(ks[0], (B, S, Hkv, Dh), jnp.float32),
+            jax.random.normal(ks[1], (B, S, Hkv, Dh), jnp.float32),
+            jnp.int32(0),
+        )
+        return entry, ks[2], S
+
+    @pytest.mark.parametrize("quantized", [False, True])
+    @pytest.mark.parametrize("H,Hkv,Dh", GEOMETRIES)
+    def test_decode_step_matches_gather_oracle(self, H, Hkv, Dh, quantized):
+        entry, key, S = self._entry(
+            H, Hkv, Dh, quantized, jax.random.PRNGKey(H * Dh + quantized)
+        )
+        ks = jax.random.split(key, 2)
+        q = jax.random.normal(ks[0], (2, 1, H, Dh), jnp.float32)
+        lens = jax.random.randint(ks[1], (2,), 1, S + 1)
+        mask = jnp.arange(S)[None, :] < lens[:, None]
+        scale = 1.0 / np.sqrt(Dh)
+        ref = paged_decode_attention(q, entry, mask, scale, impl="xla")
+        out = paged_decode_attention(
+            q, entry, mask, scale, impl=PALLAS_INTERPRET
+        )
+        # int8 pools dequantize to IDENTICAL f32 values on both paths
+        # (tight); bf16 pools differ only in accumulation/rounding order.
+        atol = 1e-5 if quantized else 3e-2
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=atol, rtol=atol
+        )
+
+    @pytest.mark.parametrize("quantized", [False, True])
+    @pytest.mark.parametrize("H,Hkv,Dh", GEOMETRIES)
+    def test_verify_chunk_matches_gather_oracle(self, H, Hkv, Dh, quantized):
+        """The ``[B, K]``-token chunk form — the speculative loop's K+1
+        verify window (spec_k=3 -> K=4) and the fast-forward chunk."""
+        K = 4
+        entry, key, S = self._entry(
+            H, Hkv, Dh, quantized, jax.random.PRNGKey(3 * H + Dh + quantized)
+        )
+        ks = jax.random.split(key, 2)
+        q = jax.random.normal(ks[0], (2, K, H, Dh), jnp.float32)
+        lens = jax.random.randint(ks[1], (2,), 1, S - K + 1)
+        # Chunk position k attends [0, lens + k) — the decode-window
+        # causal mask, never empty (lens >= 1).
+        mask = (
+            jnp.arange(S)[None, None, :]
+            < (lens[:, None] + jnp.arange(K)[None, :])[:, :, None]
+        )
+        scale = 1.0 / np.sqrt(Dh)
+        ref = paged_chunk_attention(q, entry, mask, scale, impl="xla")
+        out = paged_chunk_attention(
+            q, entry, mask, scale, impl=PALLAS_INTERPRET
+        )
+        atol = 1e-5 if quantized else 3e-2
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=atol, rtol=atol
+        )
+
+    def test_multi_page_programs_and_null_padding(self, monkeypatch):
+        """BCG_TPU_PAGED_PAGES_PER_PROGRAM=3 over a 4-block table: the
+        wrapper pads to 6 pages (2 programs x 3 pages) with null-block
+        pages whose mask columns are False — grouping and padding must
+        not change the math."""
+        monkeypatch.setenv("BCG_TPU_PAGED_PAGES_PER_PROGRAM", "3")
+        H, Hkv, Dh = 4, 2, 16
+        entry, key, S = self._entry(
+            H, Hkv, Dh, False, jax.random.PRNGKey(11)
+        )
+        ks = jax.random.split(key, 2)
+        q = jax.random.normal(ks[0], (2, 1, H, Dh), jnp.float32)
+        lens = jax.random.randint(ks[1], (2,), 1, S + 1)
+        mask = jnp.arange(S)[None, :] < lens[:, None]
+        scale = 1.0 / np.sqrt(Dh)
+        out = paged_decode_attention(
+            q, entry, mask, scale, impl=PALLAS_INTERPRET
+        )
+        monkeypatch.delenv("BCG_TPU_PAGED_PAGES_PER_PROGRAM")
+        ref = paged_decode_attention(q, entry, mask, scale, impl="xla")
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), atol=3e-2, rtol=3e-2
+        )
+
+
 class TestEnginePagedParity:
     def test_greedy_token_identical_and_radix_persists(self):
         prompts = [
@@ -310,9 +447,9 @@ class TestEnginePagedParity:
         finally:
             paged.shutdown()
 
-    def test_paged_rejects_sequence_parallel_and_chunked_prefill(self):
-        with pytest.raises(ValueError, match="prefill_chunk"):
-            JaxEngine(_cfg(paged_kv=True, prefill_chunk=128))
+    def test_paged_rejects_sequence_parallel(self):
+        # prefill_chunk composes now (paged chunked prefill, PR 8);
+        # TestPagedChunkedPrefill owns its parity/retrace guarantees.
         # sp > 1 must be a LOUD boot error: pool blocks are shared
         # across rows, so the sequence dim structurally cannot shard —
         # silently serving replicated would defeat the configured
@@ -327,6 +464,257 @@ class TestEnginePagedParity:
             JaxEngine(_cfg(paged_kv=True), mesh=mesh)
 
 
+class TestEnginePallasParity:
+    """The engine-level acceptance suite rerun under the fused kernel
+    (``paged_kv_impl="pallas"`` resolves to interpret mode on this CPU
+    host — the explicit-pallas-off-TPU contract): greedy output stays
+    token-identical to the dense path, composes with speculative
+    decoding + int8 KV, survives eviction pressure, and varying
+    block-table CONTENTS never retrace."""
+
+    PROMPTS = [
+        ("You are honest agent_1 in a consensus game.",
+         "Round 1. decide now.", SCHEMA),
+        ("You are byzantine agent_2 in a consensus game.",
+         "Round 1. decide now.", SCHEMA),
+    ]
+
+    def test_impl_resolution_and_stats_surface(self):
+        eng = JaxEngine(_cfg(paged_kv=True, paged_kv_impl="pallas"))
+        try:
+            assert eng.paged_kv_impl == "pallas"
+            assert eng._paged_loop_impl == PALLAS_INTERPRET
+            stats = eng.kv_pool_stats()
+            assert stats["impl"] == "pallas"
+            assert stats["interpret"] is True
+            assert stats["pages_per_program"] >= 1
+        finally:
+            eng.shutdown()
+        with pytest.raises(ValueError, match="paged_kv_impl"):
+            JaxEngine(_cfg(paged_kv=True, paged_kv_impl="mosaic"))
+
+    def test_greedy_parity_and_zero_retraces_varying_tables(self):
+        dense = JaxEngine(_cfg())
+        r_dense = dense.batch_generate_json(
+            self.PROMPTS, temperature=0.0, max_tokens=40
+        )
+        dense.shutdown()
+        eng = JaxEngine(_cfg(paged_kv=True, paged_kv_impl="pallas"))
+        try:
+            r_pal = eng.batch_generate_json(
+                self.PROMPTS, temperature=0.0, max_tokens=40
+            )
+            assert r_pal == r_dense
+            # Same-shape calls with DIFFERENT table contents (a fresh
+            # system prompt displaces pool blocks) must not retrace —
+            # the table is the kernel's scalar-prefetch OPERAND, never
+            # part of the compile key.
+            before = obs_counters.snapshot()
+            eng.batch_generate_json(
+                [("You are sneaky agent_9 in a consensus game.",
+                  "Round 1. decide now.", SCHEMA),
+                 self.PROMPTS[0]],
+                temperature=0.0, max_tokens=40,
+            )
+            moved = obs_counters.delta(before)
+            retraces = {
+                k: v for k, v in moved.items()
+                if k.startswith(("engine.retrace.", "engine.compile."))
+            }
+            assert retraces == {}, retraces
+        finally:
+            eng.shutdown()
+
+    def test_spec_decode_int8_compose_token_identical(self):
+        """The full acceptance compose under the fused kernel: the
+        speculative loop's K+1 verify chunks + in-kernel int8 dequant,
+        greedy output identical to the dense twin."""
+        extra = dict(spec_decode=True, kv_cache_dtype="int8")
+        with pytest.warns(UserWarning, match="int8 KV cache"):
+            dense = JaxEngine(_cfg(**extra))
+        r_dense = dense.batch_generate_json(
+            self.PROMPTS, temperature=0.0, max_tokens=40
+        )
+        dense.shutdown()
+        with pytest.warns(UserWarning, match="int8 KV cache"):
+            eng = JaxEngine(
+                _cfg(paged_kv=True, paged_kv_impl="pallas", **extra)
+            )
+        try:
+            r_pal = eng.batch_generate_json(
+                self.PROMPTS, temperature=0.0, max_tokens=40
+            )
+            assert r_pal == r_dense
+        finally:
+            eng.shutdown()
+
+    def test_eviction_pressure_parity(self):
+        """The 48-block-pool eviction scenario
+        (tests/test_prefix_cache.py TestPagedEvictionSafety) rerun
+        under the fused kernel: alternating distinct prompts force
+        radix eviction on nearly every call, and outputs stay
+        token-identical to an unpressured dense engine throughout."""
+        dense = JaxEngine(_cfg())
+        eng = JaxEngine(_cfg(paged_kv=True, paged_kv_impl="pallas",
+                             kv_block_size=16, kv_pool_blocks=48))
+        # Three ~21-block prompt chains against 47 usable blocks: no
+        # two chains fit alongside a call's scratch, so each call
+        # evicts the LRU chain (measured: eviction from call 2 on).
+        sys_a = "You are the honest consensus agent with detailed rules. " * 6
+        sys_b = "You are the byzantine saboteur with long instructions. " * 6
+        sys_c = "You are a careful mediator weighing both proposals. " * 6
+        evicted0 = obs_counters.value("kvpool.evicted_blocks")
+        try:
+            for round_no in range(2):
+                for sysp in (sys_a, sys_b, sys_c):
+                    rows = [(sysp, f"Round {round_no}. decide.", SCHEMA)]
+                    r_d = dense.batch_generate_json(
+                        rows, temperature=0.0, max_tokens=24
+                    )
+                    r_p = eng.batch_generate_json(
+                        rows, temperature=0.0, max_tokens=24
+                    )
+                    assert r_p == r_d
+            assert obs_counters.value("kvpool.evicted_blocks") > evicted0
+        finally:
+            dense.shutdown()
+            eng.shutdown()
+
+
+class TestPagedChunkedPrefill:
+    """Paged chunked prefill — the lifted ``paged + prefill_chunk``
+    boot exclusion: long prompts stream through the block pool
+    chunk-by-chunk (``transformer.prefill_paged_chunk_at``) instead of
+    requiring a one-pass activation slab, for batch prefills AND the
+    radix entry builds."""
+
+    LONG_A = ("You are the honest consensus agent. Your detailed "
+              "operating rules follow here. " * 8)[:540]
+    LONG_B = ("You are the byzantine saboteur agent. Your elaborate "
+              "secret instructions follow. " * 8)[:540]
+
+    def test_boot_aligns_chunk_to_block_size(self):
+        eng = JaxEngine(_cfg(paged_kv=True, prefill_chunk=24,
+                             kv_block_size=16))
+        try:
+            # The chunk history gather reads whole table columns, so the
+            # chunk size aligns UP to the pool's block size at boot.
+            assert eng.prefill_chunk == 32
+        finally:
+            eng.shutdown()
+
+    @pytest.mark.parametrize("impl", ["xla", "pallas"])
+    def test_long_prompt_token_identical_to_dense(self, impl):
+        """A prompt several chunks long, prefilled chunk-by-chunk
+        through the pool, greedily decodes the same tokens as the
+        one-pass DENSE engine — under both the gather reference and the
+        fused kernel."""
+        rows = [(self.LONG_A, "Round 1. decide now.", SCHEMA),
+                (self.LONG_B, "Round 1. decide now.", SCHEMA)]
+        dense = JaxEngine(_cfg())
+        r_dense = dense.batch_generate_json(
+            rows, temperature=0.0, max_tokens=40
+        )
+        dense.shutdown()
+        eng = JaxEngine(_cfg(paged_kv=True, paged_kv_impl=impl,
+                             prefill_chunk=128, kv_block_size=16))
+        try:
+            assert all("error" not in r for r in r_dense)
+            r_chunked = eng.batch_generate_json(
+                rows, temperature=0.0, max_tokens=40
+            )
+            assert r_chunked == r_dense
+        finally:
+            eng.shutdown()
+
+    def test_zero_steady_state_retraces_for_chunk_entry_points(self):
+        """Second-round calls at the same shape buckets (different
+        prompt CONTENT, so different table contents and different radix
+        builds) add no compiled chunk-prefill programs and move no
+        compile/retrace counters — chunk width is static, the history
+        window and write position are traced values."""
+        eng = JaxEngine(_cfg(paged_kv=True, prefill_chunk=128,
+                             kv_block_size=16))
+        try:
+            eng.batch_generate_json(
+                [(self.LONG_A, "Round 1. decide now.", SCHEMA),
+                 (self.LONG_B, "Round 1. decide now.", SCHEMA)],
+                temperature=0.0, max_tokens=24,
+            )
+            compiled = eng._prefill_paged_chunk_at._cache_size()
+            assert compiled > 0  # chunked prefill actually engaged
+            before = obs_counters.snapshot()
+            # Same char lengths -> same token-length buckets (byte
+            # tokenizer), fresh content -> cold radix builds + new
+            # table contents through the SAME compiled programs.
+            eng.batch_generate_json(
+                [(self.LONG_B[:-1] + "!", "Round 1. decide now.", SCHEMA),
+                 (self.LONG_A[:-1] + "?", "Round 1. decide now.", SCHEMA)],
+                temperature=0.0, max_tokens=24,
+            )
+            assert eng._prefill_paged_chunk_at._cache_size() == compiled
+            moved = obs_counters.delta(before)
+            retraces = {
+                k: v for k, v in moved.items()
+                if k.startswith(("engine.retrace.", "engine.compile."))
+            }
+            assert retraces == {}, retraces
+        finally:
+            eng.shutdown()
+
+    def test_admission_boundary_never_pool_exhausted(self):
+        """The ISSUE-8 admission fix, demonstrated load-bearing at a
+        boundary-sized pool.  Geometry: max_model_len=700 sits between
+        the 512/1024 suffix-ladder rungs, so a cold ~540-token entry
+        build allocates a 64-block rung — 31 blocks of transient
+        scratch past the worst-case row window (44 blocks).  The
+        PRE-FIX admission math ((pool-1)//blocks_per_row = 2 rows at 89
+        blocks) dispatches both rows in ONE call, whose second entry
+        build then needs 64 blocks while the first row's chain is
+        refcount-pinned -> PoolExhausted mid-prefill.  cap_for's
+        scratch reserve (_paged_build_scratch_blocks) admits 1 row per
+        call instead, and the same two-row request completes by
+        chunking into two sequential calls with eviction between."""
+        from bcg_tpu.engine.jax_engine import JaxEngine as _JE
+
+        rows = [(self.LONG_A, "Round 1. decide.", SCHEMA),
+                (self.LONG_B, "Round 1. decide.", SCHEMA)]
+
+        def boot():
+            return _JE(EngineConfig(
+                backend="jax", model_name="bcg-tpu/tiny-test",
+                max_model_len=700, paged_kv=True, kv_block_size=16,
+                kv_pool_blocks=89, prefill_chunk=128,
+            ))
+
+        eng = boot()
+        try:
+            window = eng.worst_case_decode_window()
+            blocks_per_row = -(-window // 16)
+            assert eng._paged_scratch_blocks == 31
+            assert eng.kv_pool_stats()["scratch_reserve_blocks"] == 31
+            # New math: 1 row; the math this PR replaces said 2.
+            assert eng.cap_for(window) == 1
+            assert (eng._paged.num_blocks - 1) // blocks_per_row == 2
+            r = eng.batch_generate_json(rows, temperature=0.0,
+                                        max_tokens=40)
+            assert all("error" not in x for x in r), r
+        finally:
+            eng.shutdown()
+
+        # Regression arm: restore the pre-fix admission math and watch
+        # the SAME request exhaust the pool mid-prefill.
+        eng = boot()
+        eng._paged_scratch_blocks = 0
+        try:
+            assert eng.cap_for(window) == 2
+            with pytest.raises(PoolExhausted):
+                eng.batch_generate_json(rows, temperature=0.0,
+                                        max_tokens=40)
+        finally:
+            eng.shutdown()
+
+
 class TestAdmission:
     def test_free_block_cap_and_serve_snapshot(self):
         """The serving surface of the win: derive_row_cap answers from
@@ -338,12 +726,16 @@ class TestAdmission:
                                 kv_block_size=16))
         try:
             cap = derive_row_cap(engine)
-            # worst window 2048 tokens -> 128 blocks/row over 512 usable.
-            assert cap == 4
+            # worst window 2048 tokens -> 128 blocks/row over 512 usable
+            # minus the 63-block entry-build scratch reserve (the
+            # bucketed remainder-prefill pad tail admission must leave
+            # room for — see JaxEngine._paged_build_scratch_blocks).
+            assert engine.kv_pool_stats()["scratch_reserve_blocks"] == 63
+            assert cap == 3
             sched = Scheduler(engine, linger_ms=1)
             try:
                 snap = sched.snapshot()
-                assert snap["row_cap"] == 4
+                assert snap["row_cap"] == 3
                 assert snap["kv_pool"]["blocks_total"] == 512
                 assert snap["kv_pool"]["free_block_headroom_bytes"] > 0
             finally:
