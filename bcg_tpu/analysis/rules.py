@@ -707,23 +707,28 @@ _OBS_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_]+){1,3}$")
 # carry the `<subsystem>.` prefix.
 _OBS_FRAGMENT_RE = re.compile(r"^[a-z0-9_.]*$")
 _OBS_PREFIX_RE = re.compile(r"^[a-z][a-z0-9_]*\.")
-_OBS_CALL_ATTRS = {"inc", "counter", "gauge", "set_gauge", "value"}
+_OBS_CALL_ATTRS = {
+    "inc", "counter", "gauge", "set_gauge", "value", "histogram", "observe",
+}
 _OBS_BASE_RE = re.compile(r"(^|\.)(obs_)?_?counters$|(^|\.)REGISTRY$")
+# Name-creating/mutating accessors for the bucket rule: a *read*
+# (``value``) of a flat histogram entry legitimately names
+# ``<hist>.bucket.le_*``; registering a counter/gauge under such a name
+# is the hand-rolled-histogram anti-pattern.
+_OBS_MUTATING_ATTRS = {"inc", "counter", "gauge", "set_gauge"}
+# Bucket-encoding fragments in a counter/gauge name: ``<=`` spelled
+# out, a ``le_<bound>`` label, or a literal ``bucket`` segment.
+_OBS_BUCKET_RE = re.compile(r"<=|(^|[._])le_|(^|[._])bucket([._]|$)")
 
 
-@_rule("BCG-OBS-NAME")
-def rule_obs_name(ctx: ModuleContext) -> Iterable[Finding]:
-    """Counter/gauge names registered through ``bcg_tpu.obs.counters``
-    must be lowercase dotted identifiers matching the documented
-    taxonomy (``<subsystem>.<noun>[.<detail>]``): the Prometheus
-    exposition derives metric names from them mechanically, and a
-    one-off spelling ("Serve.Requests", a bare "requests") fragments
-    the namespace every dashboard and baseline keys on.  Literal names
-    are checked whole; f-string names have their static fragments
-    checked (the leading fragment must carry the subsystem prefix);
-    variable names are trusted."""
+def _iter_obs_name_calls(ctx: ModuleContext, attrs):
+    """(call node, name-argument node) for every registry-accessor call
+    through ``bcg_tpu.obs.counters`` whose accessor is in ``attrs`` —
+    the shared detection base of BCG-OBS-NAME and BCG-OBS-BUCKET.
+    Skips the registry implementation itself (obs/counters.py builds
+    the flat ``.bucket.le_*`` names legitimately)."""
     if ctx.rel_path.endswith("obs/counters.py"):
-        return  # the registry implementation itself
+        return
     imported_direct = any(
         isinstance(node, ast.ImportFrom)
         and node.module == "bcg_tpu.obs.counters"
@@ -733,17 +738,46 @@ def rule_obs_name(ctx: ModuleContext) -> Iterable[Finding]:
         if not isinstance(node, ast.Call) or not node.args:
             continue
         if isinstance(node.func, ast.Attribute):
-            if node.func.attr not in _OBS_CALL_ATTRS:
+            if node.func.attr not in attrs:
                 continue
             base = _call_name(node.func.value)
             if not base or not _OBS_BASE_RE.search(base):
                 continue
         elif isinstance(node.func, ast.Name):
-            if not imported_direct or node.func.id not in _OBS_CALL_ATTRS:
+            if not imported_direct or node.func.id not in attrs:
                 continue
         else:
             continue
-        arg = node.args[0]
+        yield node, node.args[0]
+
+
+def _static_name_fragments(arg) -> Optional[List[str]]:
+    """The statically-known string fragments of a name argument: a
+    literal yields itself whole, an f-string its constant parts, a
+    variable None (trusted)."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return [arg.value]
+    if isinstance(arg, ast.JoinedStr):
+        return [
+            v.value for v in arg.values
+            if isinstance(v, ast.Constant) and isinstance(v.value, str)
+        ]
+    return None
+
+
+@_rule("BCG-OBS-NAME")
+def rule_obs_name(ctx: ModuleContext) -> Iterable[Finding]:
+    """Counter/gauge/histogram names registered through
+    ``bcg_tpu.obs.counters`` must be lowercase dotted identifiers
+    matching the documented taxonomy
+    (``<subsystem>.<noun>[.<detail>]``): the Prometheus exposition
+    derives metric names from them mechanically, and a one-off spelling
+    ("Serve.Requests", a bare "requests") fragments the namespace every
+    dashboard and baseline keys on.  Literal names are checked whole;
+    f-string names have their static fragments checked (the leading
+    fragment must carry the subsystem prefix); variable names are
+    trusted."""
+    for node, arg in _iter_obs_name_calls(ctx, _OBS_CALL_ATTRS):
         bad: Optional[str] = None
         if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
             if not _OBS_NAME_RE.match(arg.value):
@@ -772,6 +806,35 @@ def rule_obs_name(ctx: ModuleContext) -> Iterable[Finding]:
                 f"metric name {bad} violates the counter/gauge taxonomy "
                 "(<subsystem>.<noun>[.<detail>], lowercase dotted, 2-4 "
                 "segments — DESIGN.md Observability)",
+            )
+
+
+# --------------------------------------------- rule: hand-rolled buckets
+@_rule("BCG-OBS-BUCKET")
+def rule_obs_bucket(ctx: ModuleContext) -> Iterable[Finding]:
+    """A counter/gauge registered under a bucket-encoding name
+    (``<=``, a ``le_<bound>`` label, or a ``bucket`` segment) is a
+    hand-rolled histogram: N parallel counters whose bounds live in the
+    name, invisible to the Prometheus histogram exposition and to every
+    quantile consumer.  Use a first-class
+    :class:`bcg_tpu.obs.counters.Histogram` (``histogram(name, bounds)``
+    + ``observe()``) — it flattens to the same registry entries AND
+    exports as a conformant ``_bucket``/``_sum``/``_count`` family.
+    Reads (``value``) of flat histogram entries are legitimate and stay
+    unflagged."""
+    for node, arg in _iter_obs_name_calls(ctx, _OBS_MUTATING_ATTRS):
+        fragments = _static_name_fragments(arg)
+        if fragments is None:
+            continue  # variable name: trusted
+        if any(_OBS_BUCKET_RE.search(frag) for frag in fragments):
+            yield ctx.finding(
+                "BCG-OBS-BUCKET",
+                node,
+                "bucket-encoding counter/gauge name (le_/<=/bucket) — "
+                "a hand-rolled histogram; use obs.counters.histogram("
+                "name, bounds).observe() so quantiles and the "
+                "Prometheus _bucket/_sum/_count family derive "
+                "mechanically",
             )
 
 
@@ -817,6 +880,7 @@ ALL_RULES: Sequence = (
     rule_lock_call,
     rule_time_wall,
     rule_obs_name,
+    rule_obs_bucket,
 )
 
 RULE_IDS: List[str] = [r.rule_id for r in ALL_RULES]

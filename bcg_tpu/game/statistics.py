@@ -24,6 +24,70 @@ DETECTION_KEYWORDS = [
 ]
 
 
+def round_record(r, include_byzantine: bool = True) -> Dict:
+    """One round's summary dict — the SINGLE source of truth shared by
+    :func:`compute_statistics`'s ``rounds_data`` and the live game-event
+    stream (:mod:`bcg_tpu.obs.game_events` ``round_end`` records).  Key
+    names and value semantics are pinned by ``tests/test_statistics.py``
+    (reference parity) — change them nowhere else.
+
+    ``r`` is a :class:`bcg_tpu.game.state.ConsensusRound`."""
+    return {
+        "round": r.round_num,
+        "honest_values": r.honest_values,
+        "byzantine_values": r.byzantine_values if include_byzantine else [],
+        "honest_mean": r.honest_mean,
+        "honest_std": r.honest_std,
+        "convergence_metric": r.convergence_metric,
+        "has_consensus": r.has_consensus,
+        "consensus_value": r.consensus_value,
+        "agreement_count": r.agreement_count,
+    }
+
+
+def round_convergence(
+    r,
+    consensus_threshold: float,
+    honest_ids=(),
+    prev_values: Dict = None,
+    prev_byzantine_proposals=(),
+) -> Dict:
+    """Per-round convergence metrics beyond the reference's record —
+    the game-event stream's ``round_end`` payload (and what the sweep
+    harness aggregates):
+
+    * ``distinct_honest_values`` — honest value diversity (1 at
+      unanimity);
+    * ``value_spread`` — max-min over honest values;
+    * ``margin_vs_threshold`` — honest agreement percentage minus the
+      configured consensus threshold (positive = over the bar);
+    * ``byzantine_influence`` — honest agents whose NEW value equals a
+      value a Byzantine agent proposed in the PREVIOUS round and
+      differs from the agent's own previous value (adoption of
+      adversary-injected values, the PAPERS.md influence metric).
+    """
+    honest = [int(v) for v in r.honest_values]
+    influence = 0
+    if prev_byzantine_proposals:
+        byz_set = {int(v) for v in prev_byzantine_proposals if v is not None}
+        prev = prev_values or {}
+        for aid in honest_ids:
+            new = r.agent_values.get(aid)
+            if new is None or int(new) not in byz_set:
+                continue
+            old = prev.get(aid)
+            if old is None or int(old) != int(new):
+                influence += 1
+    return {
+        "distinct_honest_values": len(set(honest)),
+        "value_spread": (max(honest) - min(honest)) if honest else 0,
+        "margin_vs_threshold": round(
+            r.convergence_metric - consensus_threshold, 3
+        ),
+        "byzantine_influence": influence,
+    }
+
+
 def compute_statistics(game) -> Dict:
     """Compute the full statistics dict for a (possibly finished) game.
 
@@ -153,20 +217,10 @@ def compute_statistics(game) -> Dict:
         byzantine_infiltration = None
         consensus_quality_score = 0.0
 
-    rounds_data = [
-        {
-            "round": r.round_num,
-            "honest_values": r.honest_values,
-            "byzantine_values": r.byzantine_values if has_byz else [],
-            "honest_mean": r.honest_mean,
-            "honest_std": r.honest_std,
-            "convergence_metric": r.convergence_metric,
-            "has_consensus": r.has_consensus,
-            "consensus_value": r.consensus_value,
-            "agreement_count": r.agreement_count,
-        }
-        for r in game.rounds
-    ]
+    # One shape for the saved results AND the live event stream: the
+    # game-event emitter's round_end records are round_record() too.
+    rounds_data = [round_record(r, include_byzantine=has_byz)
+                   for r in game.rounds]
 
     # --- Q3: keyword detection over HONEST reasoning only -------------------
     keyword_counts = {kw: 0 for kw in DETECTION_KEYWORDS}

@@ -5,9 +5,13 @@ device-cost half — HLO census, HBM ledger, telemetry export.
 parent handoff, ring-buffered, exported as Chrome trace-event JSON
 (Perfetto-loadable; ``scripts/trace_report.py`` prints the latency
 table + top counters from an export).  ``bcg_tpu.obs.counters`` — the
-single process-wide counter/gauge registry (compile/retrace accounting,
-serve linger buckets) with ``snapshot()``/``delta()`` for tests and
-bench JSON.  ``bcg_tpu.obs.hlo`` — lowered-HLO kernel census per jit
+single process-wide counter/gauge/histogram registry (compile/retrace
+accounting, the serve latency + SLO-headroom histograms) with
+``snapshot()``/``delta()`` for tests and bench JSON.
+``bcg_tpu.obs.game_events`` — the consensus-game event stream
+(``BCG_TPU_GAME_EVENTS`` JSONL + live ``game.*`` metrics;
+``scripts/consensus_report.py`` aggregates the files into
+convergence tables).  ``bcg_tpu.obs.hlo`` — lowered-HLO kernel census per jit
 entry (``engine.hlo.*`` gauges; ``scripts/hlo_census.py`` +
 ``hlo_baseline.json`` pin kernel counts per decode step).
 ``bcg_tpu.obs.ledger`` — per-device HBM byte accounting of params / KV
@@ -24,4 +28,6 @@ taxonomy and the device-cost subsection.
 
 from bcg_tpu.obs import counters, export, hlo, ledger, tracer  # noqa: F401
 
+# game_events is NOT imported eagerly: it pulls game.statistics, which
+# flag-only consumers never need; the orchestrator imports it directly.
 __all__ = ["counters", "export", "hlo", "ledger", "tracer"]

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import atexit
 import json
+import os
 import re
 import threading
 import time
@@ -66,10 +67,14 @@ def _format_value(value) -> str:
 
 def render_prometheus(typed: Optional[Dict[str, Dict[str, Any]]] = None) -> str:
     """The registry (or an explicit ``snapshot_typed()``-shaped dict) in
-    Prometheus text-exposition format, sorted by metric name."""
+    Prometheus text-exposition format, sorted by metric name.
+
+    Histograms render as the conformant family the spec requires:
+    cumulative ``<name>_bucket{le="..."}`` samples over the declared
+    bounds plus the mandatory ``le="+Inf"`` bucket (== ``_count``),
+    then ``<name>_sum`` and ``<name>_count``."""
     if typed is None:
         typed = obs_counters.snapshot_typed()
-    lines = []
     rows = [
         (prometheus_name(name, counter=True), name, "counter", value)
         for name, value in typed.get("counters", {}).items()
@@ -77,16 +82,63 @@ def render_prometheus(typed: Optional[Dict[str, Dict[str, Any]]] = None) -> str:
         (prometheus_name(name), name, "gauge", value)
         for name, value in typed.get("gauges", {}).items()
     ]
-    for metric, original, kind, value in sorted(rows):
-        lines.append(
-            f"# HELP {metric} {_escape_help(f'bcg_tpu registry {kind} {original!r}')}"
-        )
-        lines.append(f"# TYPE {metric} {kind}")
-        lines.append(f"{metric} {_format_value(value)}")
-    return "\n".join(lines) + ("\n" if lines else "")
+    blocks = []
+    for metric, original, kind, value in rows:
+        blocks.append((metric, [
+            f"# HELP {metric} "
+            f"{_escape_help(f'bcg_tpu registry {kind} {original!r}')}",
+            f"# TYPE {metric} {kind}",
+            f"{metric} {_format_value(value)}",
+        ]))
+    for name, hist in typed.get("histograms", {}).items():
+        metric = prometheus_name(name)
+        lines = [
+            f"# HELP {metric} "
+            f"{_escape_help(f'bcg_tpu registry histogram {name!r}')}",
+            f"# TYPE {metric} histogram",
+        ]
+        for bound, cum in hist.get("buckets", []):
+            lines.append(
+                f'{metric}_bucket{{le="{_format_value(bound)}"}} '
+                f"{_format_value(cum)}"
+            )
+        lines.append(f'{metric}_bucket{{le="+Inf"}} '
+                     f"{_format_value(hist.get('count', 0))}")
+        lines.append(f"{metric}_sum {_format_value(hist.get('sum', 0.0))}")
+        lines.append(f"{metric}_count {_format_value(hist.get('count', 0))}")
+        blocks.append((metric, lines))
+    out = []
+    for _, lines in sorted(blocks, key=lambda b: b[0]):
+        out.extend(lines)
+    return "\n".join(out) + ("\n" if out else "")
 
 
 # ------------------------------------------------------------ JSONL events
+
+# Version of the JSONL record schemas BOTH sinks emit (serve lifecycle
+# events and game events).  Bump on any breaking field change — offline
+# aggregators (scripts/consensus_report.py) key merging decisions on it.
+EVENT_SCHEMA_VERSION = 1
+
+
+def run_manifest(**extra: Any) -> Dict[str, Any]:
+    """The run-manifest header every JSONL sink writes as its FIRST
+    record: run id, schema version, and the registered env-flag
+    overrides in effect — so merging event files across a sweep is
+    mechanical (group by manifest config, no out-of-band bookkeeping).
+    ``extra`` fields (preset, game geometry) ride along verbatim."""
+    import uuid
+
+    manifest = {
+        "schema_version": EVENT_SCHEMA_VERSION,
+        "run_id": uuid.uuid4().hex[:12],
+        "pid": os.getpid(),
+        "flags": envflags.overrides(),
+    }
+    manifest.update(extra)
+    return manifest
+
+
 class EventSink:
     """Append-only JSONL event stream (one JSON object per line),
     written by a dedicated drainer thread.
@@ -101,8 +153,11 @@ class EventSink:
     queued before returning (an atexit hook closes the process sink so
     a normal exit loses nothing)."""
 
-    def __init__(self, path: str, max_queue: int = 65536):
+    def __init__(self, path: str, max_queue: int = 65536,
+                 drop_counter: str = "serve.events_dropped",
+                 manifest: Optional[Dict[str, Any]] = None):
         self.path = path
+        self._drop_counter = drop_counter
         self._cond = threading.Condition()
         self._queue: "deque" = deque(maxlen=max_queue)
         self._closed = False
@@ -111,6 +166,11 @@ class EventSink:
             target=self._drain, name="bcg-event-sink", daemon=True
         )
         self._thread.start()
+        if manifest is not None:
+            # First record in the file: the run manifest (schema
+            # version, run id, flag overrides) — sweep-level merging
+            # keys on it.
+            self.emit("manifest", **manifest)
 
     def emit(self, event: str, **fields: Any) -> None:
         record = {"ts": time.time(), "event": event}
@@ -120,7 +180,7 @@ class EventSink:
                 return
             if len(self._queue) == self._queue.maxlen:
                 # deque(maxlen) evicts the oldest on append — count it.
-                obs_counters.inc("serve.events_dropped")
+                obs_counters.inc(self._drop_counter)
             self._queue.append(record)
             self._cond.notify()
 
@@ -177,7 +237,7 @@ def _ensure_sink() -> Optional[EventSink]:
         if not _sink_configured:
             path = envflags.get_str("BCG_TPU_SERVE_EVENTS")
             if path:
-                _sink = EventSink(path)
+                _sink = EventSink(path, manifest=run_manifest(kind="serve"))
                 # Drain the queue on normal interpreter exit — the
                 # writer is a daemon thread and would otherwise die
                 # with the tail of the run still in memory.

@@ -187,7 +187,21 @@ _register(
     "BCG_TPU_SERVE_EVENTS", "str", None,
     "Append serve-path request lifecycle events (admitted/dispatched/"
     "completed/rejected, with request id and latency breakdown) as "
-    "JSONL to this path.",
+    "JSONL to this path (first line = run manifest).",
+)
+_register(
+    "BCG_TPU_GAME_EVENTS", "str", None,
+    "Append per-round consensus-game events (round start/end, agent "
+    "decisions, topology-masked deliveries, votes, convergence "
+    "metrics) as JSONL to this path (first line = run manifest; "
+    "scripts/consensus_report.py aggregates one or many such files).",
+)
+_register(
+    "BCG_TPU_SERVE_SLO_MS", "int", 0,
+    "Serving latency objective in milliseconds: each completed "
+    "request's submit-to-complete latency is compared against it, "
+    "feeding the serve.slo.violations counter and the "
+    "serve.slo.headroom_ms histogram (0 = no SLO tracking).",
 )
 
 # BCG_TPU_SERVE_* — continuous-batching serving subsystem (bcg_tpu/serve).
@@ -383,6 +397,20 @@ def get_str(name: str, default: Optional[str] = None) -> Optional[str]:
     fallback = flag.default if default is None else default
     raw = os.environ.get(name)
     return fallback if raw is None else raw
+
+
+def overrides() -> Dict[str, str]:
+    """Raw values of every REGISTERED flag present in the environment —
+    the run-manifest form (JSONL sink headers record exactly what was
+    overridden, so sweep-level grouping is mechanical).  Raw strings,
+    not parsed values: a manifest must round-trip what the operator set,
+    and the registry accessors cannot represent "was unset"."""
+    out = {}
+    for name in REGISTRY:
+        raw = os.environ.get(name)
+        if raw is not None:
+            out[name] = raw
+    return dict(sorted(out.items()))
 
 
 # ------------------------------------------------------------------ docs

@@ -61,6 +61,22 @@ def publish_kv_pool(snapshot: Optional[Dict]) -> None:
     LAST_KV_POOL = snapshot
 
 
+# Latest game-telemetry summary (bcg_tpu/obs/game_events: games run/
+# completed/converged, rounds, byzantine adoptions, event-sink drops) —
+# published by the recorder at game_start/round_end/game_end so
+# bench.py can attach the consensus profile on success AND error paths,
+# mirroring LAST_SERVE_STATS.  None until a recorder runs (i.e. always
+# None unless BCG_TPU_GAME_EVENTS is set).
+LAST_GAME_STATS: Optional[Dict] = None
+
+
+def publish_game_stats(snapshot: Optional[Dict]) -> None:
+    """Record the most recent cross-game telemetry summary (called by
+    ``obs.game_events.GameEventRecorder``)."""
+    global LAST_GAME_STATS
+    LAST_GAME_STATS = snapshot
+
+
 def _device_memory():
     """(bytes_in_use, peak_bytes_in_use) as the MAX across all devices,
     or (None, None) where the backend exposes no allocator stats (CPU).

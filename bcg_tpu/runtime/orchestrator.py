@@ -39,6 +39,7 @@ from bcg_tpu.comm import (
 from bcg_tpu.config import BCGConfig
 from bcg_tpu.engine.interface import InferenceEngine, create_engine
 from bcg_tpu.game import ByzantineConsensusGame
+from bcg_tpu.obs import game_events as obs_game_events
 from bcg_tpu.obs import tracer as obs_tracer
 from bcg_tpu.runtime import envflags
 from bcg_tpu.runtime.logging import RunLogger
@@ -169,6 +170,11 @@ class BCGSimulation:
         self.agents: Dict = {}
         self._plotted = False
         self._create_agents()
+        # Game-event telemetry (BCG_TPU_GAME_EVENTS): None on the
+        # default path — every emission site below is one `is not None`
+        # check, so the disabled round loop carries no recorder cost,
+        # no sink thread, and no game.* registry entries.
+        self._recorder = obs_game_events.maybe_recorder(self)
         # SPMD value-exchange path (NetworkConfig.spmd_exchange): lazily
         # built mesh + static topology mask; host-protocol-equivalent
         # message accounting.
@@ -334,24 +340,42 @@ class BCGSimulation:
                 f"  {len(pending)} agents failed all {MAX_RETRIES} attempts - they will abstain"
             )
 
-        # Parse and commit proposals.
+        # Parse and commit proposals.  Decision outcome taxonomy for the
+        # game-event stream: "valid" = batched response accepted (a None
+        # value here is a legitimate Byzantine abstain, not a failure),
+        # "fallback" = the sequential-retry ladder rescued it,
+        # "invalid" = every attempt failed -> forced abstain.
         for aid, _ in agent_prompts:
             agent = self.agents[aid]
             result = agent_results.get(aid)
             if result is None:
                 agent.last_reasoning = f"All {MAX_RETRIES} attempts failed - abstaining"
                 self.logger.log(f"  {aid}: ABSTAINING (all attempts failed)")
+                if self._recorder:
+                    self._recorder.decision(
+                        round_num, aid, agent.is_byzantine, None, "invalid"
+                    )
                 continue
             if result.get("_sequential_success"):
                 new_value = result.get("value")
+                outcome = "fallback"
             else:
                 new_value = agent.parse_decision_response(result, game_state)
+                outcome = "valid"
             if new_value is None:
                 self.logger.log(f"  {aid}: ABSTAINING")
                 self.logger.log(f"    Reasoning: {agent.last_reasoning}")
+                if self._recorder:
+                    self._recorder.decision(
+                        round_num, aid, agent.is_byzantine, None, outcome
+                    )
                 continue
             new_value = int(round(new_value))
             self.game.update_agent_proposal(aid, new_value)
+            if self._recorder:
+                self._recorder.decision(
+                    round_num, aid, agent.is_byzantine, new_value, outcome
+                )
             old = f"{int(agent.my_value)}" if agent.my_value is not None else "(no value yet)"
             self.logger.log(f"  {aid}: {old} -> {new_value}")
             self.logger.log(f"    Reasoning: {agent.last_reasoning}")
@@ -482,6 +506,8 @@ class BCGSimulation:
         self.logger.log("=" * 60)
         self.logger.log(f"Round {round_num}")
         self.logger.log("=" * 60)
+        if self._recorder:
+            self._recorder.round_start(round_num)
 
         phase = Phase.PROPOSE
         game_state = self.game.get_game_state()
@@ -499,6 +525,20 @@ class BCGSimulation:
             else:
                 for aid, agent in self.agents.items():
                     new_value = agent.decide_next_value(game_state)
+                    if self._recorder:
+                        # The sequential path retries internally; a None
+                        # with last_decision_failed is retry exhaustion,
+                        # a None without it is a legitimate abstain.
+                        outcome = (
+                            "invalid"
+                            if new_value is None and agent.last_decision_failed
+                            else "valid"
+                        )
+                        self._recorder.decision(
+                            round_num, aid, agent.is_byzantine,
+                            int(round(new_value)) if new_value is not None else None,
+                            outcome,
+                        )
                     if new_value is None:
                         self.logger.log(f"  {aid}: ABSTAINING")
                         continue
@@ -545,6 +585,10 @@ class BCGSimulation:
                     ]
                     agent.receive_proposals(proposals)
                     agent.my_value = self.game.agents[aid].proposed_value
+                    if self._recorder:
+                        self._recorder.deliveries(
+                            round_num, aid, [p[0] for p in proposals]
+                        )
                     self.logger.log(f"  {aid}: received {len(proposals)} proposals, updated state")
 
         # 3.5 Round summaries + Q3 reasoning capture
@@ -568,6 +612,12 @@ class BCGSimulation:
                     vote = agent.vote_to_terminate(game_state)
                     agent_votes[aid] = vote
 
+        if self._recorder:
+            for aid, vote in agent_votes.items():
+                self._recorder.vote(
+                    round_num, aid, self.agents[aid].is_byzantine, vote
+                )
+
         vote_info = self.game.get_all_termination_votes(agent_votes)
         self.logger.log(
             f"  All agents voting to stop: {vote_info['total_stop_votes']}/{vote_info['total_agents']}"
@@ -578,6 +628,14 @@ class BCGSimulation:
         self.network.advance_round()
         self.network.end_round_gc(round_num)
         self.profiler.count_round(num_decisions=2 * len(self.agents))
+        if self._recorder:
+            # round_end reads the round advance_round just recorded;
+            # game_end here (not only in run()) covers external drivers
+            # (serve.run_serving_simulations, resume) that call
+            # run_round directly — it is idempotent.
+            self._recorder.round_end(round_num, self.game)
+            if self.game.game_over:
+                self._recorder.game_end(self.game)
 
         # Per-round checkpoints (--checkpoint-every-round) ride the
         # save_results sinks; BCG_TPU_SERVE_CHECKPOINT_EVERY=N
@@ -698,6 +756,10 @@ class BCGSimulation:
             agent = self.agents[aid]
             agent.receive_proposals(proposals)
             agent.my_value = self.game.agents[aid].proposed_value
+            if self._recorder:
+                self._recorder.deliveries(
+                    self.game.current_round, aid, [p[0] for p in proposals]
+                )
             self.logger.log(
                 f"  {aid}: received {len(proposals)} proposals (spmd), updated state"
             )

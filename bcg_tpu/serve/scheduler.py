@@ -52,15 +52,26 @@ from bcg_tpu.obs import (
 from bcg_tpu.obs.tracer import SpanAggregator
 from bcg_tpu.runtime import envflags
 
-# Linger-histogram bucket upper bounds in milliseconds (last bucket is
-# open-ended).  Linger = enqueue -> dispatch-start wait per request.
-# The histogram itself lives in the process-wide counter registry
-# (bcg_tpu.obs.counters) under these names; SchedulerStats snapshots
-# its own share via construction-time baselines.
-_LINGER_BUCKETS_MS = (1, 2, 5, 10, 20, 50, 100)
-_LINGER_COUNTERS = tuple(
-    f"serve.linger_le_{b}ms" for b in _LINGER_BUCKETS_MS
-) + (f"serve.linger_gt_{_LINGER_BUCKETS_MS[-1]}ms",)
+# Serving-latency histogram bucket bounds in milliseconds (the +Inf
+# overflow bucket is implicit).  These are first-class
+# :class:`bcg_tpu.obs.counters.Histogram`\\ s in the process-wide
+# registry — Prometheus-expositable (`_bucket`/`_sum`/`_count`), with
+# bucket-derived p50/p95/p99; SchedulerStats snapshots its own share
+# via construction-time `Histogram.raw()` baselines.
+#
+# Bound rationale: queue-wait tracks the linger knob's 0-100 ms regime
+# (sub-bucket resolution around the 10 ms default); e2e spans one
+# device dispatch (~ms on fake engines) up to multi-second TPU decode
+# windows; device-time mirrors e2e minus queueing; SLO headroom shares
+# the e2e scale, with a leading 0 bound that floors every violation
+# (negative headroom) into the ``le="0"`` bucket — so headroom
+# quantiles clamp to 0 rather than interpolating a spurious positive
+# value, and the ``le="0"`` bucket count on the exposition IS the
+# violation count.
+_QUEUE_WAIT_BUCKETS_MS = (1, 2, 5, 10, 20, 50, 100)
+_E2E_BUCKETS_MS = (5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 15000)
+_DEVICE_BUCKETS_MS = (1, 5, 10, 25, 50, 100, 250, 1000, 5000, 15000)
+_SLO_HEADROOM_BUCKETS_MS = (0, 1, 5, 10, 25, 50, 100, 250, 1000, 5000)
 # Speculative-decoding counters the inner engine publishes
 # (engine/speculative.py); snapshotted per scheduler with the same
 # construction-time-baseline idiom as the linger buckets, so
@@ -88,7 +99,8 @@ class Request:
     """One engine call from one participant, completed independently."""
 
     __slots__ = ("sig", "payload", "n_rows", "temps", "budgets", "deadline",
-                 "enqueued_at", "done", "results", "error", "span", "req_id")
+                 "submitted_at", "enqueued_at", "done", "results", "error",
+                 "span", "req_id")
 
     _ids = itertools.count(1)  # process-wide: ids stay unique across schedulers
 
@@ -101,6 +113,7 @@ class Request:
         self.temps = temps
         self.budgets = budgets
         self.deadline = deadline      # absolute time.monotonic(), or None
+        self.submitted_at = 0.0       # submit() entry — the e2e/SLO anchor
         self.enqueued_at = 0.0
         self.done = threading.Event()
         self.results: Optional[List] = None
@@ -123,18 +136,20 @@ class SchedulerStats:
     """Counters + per-stage latency; mutated only under the scheduler
     condition, snapshotted for :mod:`bcg_tpu.runtime.metrics`.
 
-    The linger histogram lives in the PROCESS-WIDE counter registry
-    (:mod:`bcg_tpu.obs.counters`, the ``serve.linger_*`` buckets) —
-    this instance records construction-time baselines and snapshots its
-    own share as deltas, so per-scheduler numbers stay correct when
-    several schedulers run in one process (sequentially; concurrent
-    schedulers share the registry totals).  Stage latency
+    The latency histograms (``serve.queue_wait_ms`` / ``serve.e2e_ms``
+    / ``serve.device_ms`` and, under an SLO, ``serve.slo.headroom_ms``)
+    live in the PROCESS-WIDE counter registry as first-class
+    :class:`~bcg_tpu.obs.counters.Histogram`\\ s — this instance records
+    construction-time ``raw()`` baselines and snapshots its own share
+    as deltas, so per-scheduler numbers stay correct when several
+    schedulers run in one process (sequentially; concurrent schedulers
+    share the registry totals).  Stage latency
     (queue_wait/admission/batch_form/device/scatter) accumulates in a
     :class:`~bcg_tpu.obs.tracer.SpanAggregator` that the tracer spans
     feed — one timing implementation for the trace and the snapshot.
     """
 
-    def __init__(self):
+    def __init__(self, slo_ms: int = 0):
         self.submitted = 0
         self.completed = 0
         self.failed = 0            # engine raised for the request's batch
@@ -147,30 +162,78 @@ class SchedulerStats:
         self.engine_errors = 0
         self.backpressure_blocks = 0
         self.max_queue_rows = 0
+        self.slo_ms = max(0, slo_ms)
+        self.slo_violations = 0
         self.lat = SpanAggregator()
-        self._linger_base = [obs_counters.value(n) for n in _LINGER_COUNTERS]
-        self._spec_base = [obs_counters.value(n) for n in _SPEC_COUNTERS]
+        self._hists = {
+            "queue_wait": obs_counters.histogram(
+                "serve.queue_wait_ms", _QUEUE_WAIT_BUCKETS_MS),
+            "e2e": obs_counters.histogram("serve.e2e_ms", _E2E_BUCKETS_MS),
+            "device": obs_counters.histogram(
+                "serve.device_ms", _DEVICE_BUCKETS_MS),
+        }
+        if self.slo_ms:
+            # Headroom = slo - e2e per completed request; negative
+            # observations (violations) floor into the le=0 bucket, so
+            # derived quantiles read 0 at/past the objective (the true
+            # signed magnitude is in .sum and the violations counter).
+            # The histogram only exists once an SLO is configured — the
+            # default path registers nothing.
+            self._hists["slo_headroom"] = obs_counters.histogram(
+                "serve.slo.headroom_ms", _SLO_HEADROOM_BUCKETS_MS)
+        self._hist_base = {k: h.raw() for k, h in self._hists.items()}
+        self._spec_base = [obs_counters.value(name) for name in _SPEC_COUNTERS]
 
     def record_linger(self, seconds: float) -> None:
         self.lat.add("queue_wait", seconds)
-        ms = seconds * 1e3
-        for i, bound in enumerate(_LINGER_BUCKETS_MS):
-            if ms <= bound:
-                obs_counters.inc(_LINGER_COUNTERS[i])
-                return
-        obs_counters.inc(_LINGER_COUNTERS[-1])
+        self._hists["queue_wait"].observe(seconds * 1e3)
+
+    def record_completion(self, e2e_seconds: float) -> int:
+        """Observe one completed request's submit->complete latency;
+        returns 1 when it violated the configured SLO (0 otherwise —
+        incl. when no SLO is set)."""
+        e2e_ms = e2e_seconds * 1e3
+        self._hists["e2e"].observe(e2e_ms)
+        if not self.slo_ms:
+            return 0
+        headroom = self.slo_ms - e2e_ms
+        self._hists["slo_headroom"].observe(headroom)
+        return 1 if headroom < 0 else 0
+
+    def record_device_time(self, seconds: float) -> None:
+        self._hists["device"].observe(seconds * 1e3)
+
+    def _hist_delta(self, key: str):
+        """(per-bucket counts incl. overflow, sum, count) movement since
+        construction — THIS scheduler's share of the process total."""
+        counts, total, n = self._hists[key].raw()
+        base_counts, base_total, base_n = self._hist_base[key]
+        return (
+            [c - b for c, b in zip(counts, base_counts)],
+            total - base_total, n - base_n,
+        )
+
+    def _hist_snapshot(self, key: str) -> Dict[str, Any]:
+        from bcg_tpu.obs.counters import quantile_from_counts
+
+        counts, total, n = self._hist_delta(key)
+        bounds = self._hists[key].bounds
+        return {
+            "count": n,
+            "sum_ms": round(total, 3),
+            "p50_ms": round(quantile_from_counts(bounds, counts, 0.50), 3),
+            "p95_ms": round(quantile_from_counts(bounds, counts, 0.95), 3),
+            "p99_ms": round(quantile_from_counts(bounds, counts, 0.99), 3),
+        }
 
     def snapshot(self, row_cap: Optional[int] = None,
                  queue_rows: int = 0,
                  kv_pool: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         done = self.completed + self.failed + self.cancelled + self.rejected
-        hist_keys = [f"<={b}ms" for b in _LINGER_BUCKETS_MS] + [
-            f">{_LINGER_BUCKETS_MS[-1]}ms"
+        hist_keys = [f"<={b}ms" for b in _QUEUE_WAIT_BUCKETS_MS] + [
+            f">{_QUEUE_WAIT_BUCKETS_MS[-1]}ms"
         ]
-        hist = [
-            obs_counters.value(name) - base
-            for name, base in zip(_LINGER_COUNTERS, self._linger_base)
-        ]
+        hist, _, _ = self._hist_delta("queue_wait")
         lat_table = self.lat.table()
         queue_wait = lat_table.get("queue_wait")
         return {
@@ -200,6 +263,27 @@ class SchedulerStats:
                 queue_wait["mean_ms"] if queue_wait else None
             ),
             "linger_hist_ms": dict(zip(hist_keys, hist)),
+            # Registry-histogram views (THIS scheduler's share):
+            # bucket-derived p50/p95/p99 per serve.queue_wait_ms /
+            # serve.e2e_ms / serve.device_ms.
+            "hist_ms": {
+                key: self._hist_snapshot(key)
+                for key in ("queue_wait", "e2e", "device")
+            },
+            # SLO view (BCG_TPU_SERVE_SLO_MS): violations = completed
+            # requests whose submit->complete latency exceeded the
+            # objective; headroom_ms quantiles come from the
+            # serve.slo.headroom_ms histogram (violations floor to 0 —
+            # a p95 of 0 reads "at or past the objective").  None when
+            # no SLO is set.
+            "slo": (
+                {
+                    "slo_ms": self.slo_ms,
+                    "violations": self.slo_violations,
+                    "headroom_ms": self._hist_snapshot("slo_headroom"),
+                }
+                if self.slo_ms else None
+            ),
             # Per-stage latency breakdown (count/total/mean/p50/p95 ms):
             # queue_wait = enqueue->dispatch, admission = backpressure
             # wait in submit, batch_form = merge assembly, device = the
@@ -282,6 +366,7 @@ class Scheduler:
         max_queue_rows: Optional[int] = None,
         deadline_ms: Optional[int] = None,
         strict_admission: Optional[bool] = None,
+        slo_ms: Optional[int] = None,
     ):
         self._engine = engine
         if linger_ms is None:
@@ -292,6 +377,8 @@ class Scheduler:
             max_queue_rows = envflags.get_int("BCG_TPU_SERVE_MAX_QUEUE_ROWS")
         if deadline_ms is None:
             deadline_ms = envflags.get_int("BCG_TPU_SERVE_DEADLINE_MS")
+        if slo_ms is None:
+            slo_ms = envflags.get_int("BCG_TPU_SERVE_SLO_MS")
         self._linger_s = max(0, linger_ms) / 1e3
         if bucket_rows and bucket_rows > 0:
             self._row_cap: Optional[int] = int(bucket_rows)
@@ -302,7 +389,7 @@ class Scheduler:
         self._strict = explicit_cap if strict_admission is None else strict_admission
         self._max_queue_rows = max(1, max_queue_rows)
         self._deadline_s = max(0, deadline_ms) / 1e3
-        self.stats = SchedulerStats()
+        self.stats = SchedulerStats(slo_ms=slo_ms)
 
         self._cond = threading.Condition()
         self._queue: List[Request] = []
@@ -333,6 +420,7 @@ class Scheduler:
         now = time.monotonic()
         deadline = now + self._deadline_s if self._deadline_s > 0 else None
         req = Request(sig, payload, temps, budgets, deadline)
+        req.submitted_at = now
         # Cross-thread parent handoff: the dispatch thread parents its
         # queue_wait/batch_form/device spans to the submitter's
         # innermost open span (the serve.request span when called via
@@ -590,22 +678,34 @@ class Scheduler:
                             merged, temperature=temperature,
                             max_tokens=max_tokens, top_p=sig[1],
                         )
-            device_ms = round((time.monotonic() - device_t0) * 1e3, 3)
+            device_s = time.monotonic() - device_t0
+            device_ms = round(device_s * 1e3, 3)
+            self.stats.record_device_time(device_s)
+            slo_violations = 0
             with obs_tracer.span("serve.scatter", parent=anchor,
                                  aggregate=self.stats.lat,
                                  args={"requests": len(batch)}):
                 pos = 0
+                done_t = time.monotonic()
                 for r in batch:
                     r.complete(out[pos: pos + r.n_rows])
                     pos += r.n_rows
+                    violated = self.stats.record_completion(
+                        done_t - r.submitted_at
+                    )
+                    slo_violations += violated
                     self._emit(r, "completed", device_ms=device_ms,
-                               batch_rows=len(merged))
+                               batch_rows=len(merged),
+                               e2e_ms=round((done_t - r.submitted_at) * 1e3, 3))
             with self._cond:
                 self.stats.completed += len(batch)
                 self.stats.dispatches += 1
                 self.stats.dispatched_rows += len(merged)
+                self.stats.slo_violations += slo_violations
             obs_counters.inc("serve.dispatches")
             obs_counters.inc("serve.dispatched_rows", len(merged))
+            if slo_violations:
+                obs_counters.inc("serve.slo.violations", slo_violations)
         except BaseException as e:
             for r in batch:
                 r.fail(e)

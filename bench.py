@@ -156,6 +156,7 @@ _CONFIG_OVERRIDE_ENVS = (
     "BCG_TPU_SPEC", "BCG_TPU_SPEC_K", "BCG_TPU_SPEC_NGRAM",
     "BCG_TPU_PAGED_KV", "BCG_TPU_KV_BLOCK_SIZE", "BCG_TPU_KV_POOL_BLOCKS",
     "BCG_TPU_PAGED_KV_IMPL", "BCG_TPU_PAGED_PAGES_PER_PROGRAM",
+    "BCG_TPU_GAME_EVENTS", "BCG_TPU_SERVE_SLO_MS",
 )
 
 
@@ -203,6 +204,21 @@ def _kv_pool_stats_or_none():
         from bcg_tpu.runtime import metrics as _metrics
 
         return _metrics.LAST_KV_POOL
+    except Exception:
+        # Inside the never-rc=1 contract (see _obs_payload).
+        return None
+
+
+def _game_stats_or_none():
+    """Cumulative game-telemetry summary (games converged, rounds,
+    byzantine adoptions, event-sink drops) when BCG_TPU_GAME_EVENTS
+    recorded anything; None otherwise.  Read from runtime.metrics (not
+    a recorder object) so the ERROR path — where no simulation handle
+    survives — keeps the consensus profile too."""
+    try:
+        from bcg_tpu.runtime import metrics as _metrics
+
+        return _metrics.LAST_GAME_STATS
     except Exception:
         # Inside the never-rc=1 contract (see _obs_payload).
         return None
@@ -281,6 +297,12 @@ def _error_result(exc: BaseException, retried: bool) -> dict:
     kv_pool = _kv_pool_stats_or_none()
     if kv_pool:
         out["kv_pool"] = kv_pool
+    # Consensus-game telemetry of the failed attempt (games converged
+    # before the crash, byzantine adoptions, event-sink drops) — same
+    # mid-crash-forensics idiom as serve_stats/kv_pool.
+    game_stats = _game_stats_or_none()
+    if game_stats:
+        out["game_stats"] = game_stats
     # Boot-phase breakdown of the failed attempt (engine boots record
     # into runtime.metrics.LAST_BOOT_PHASES even when construction
     # dies mid-phase): a RESOURCE_EXHAUSTED error line now names the
@@ -689,6 +711,9 @@ def _run_attempt(cfg, model: str, backend: str, concurrency: int,
                 engine.kv_pool_stats()
                 if hasattr(engine, "kv_pool_stats") else None
             ),
+            # BCG_TPU_GAME_EVENTS: cumulative consensus-game telemetry
+            # (converged/rounds/byzantine adoptions/event drops).
+            "game_stats": _game_stats_or_none(),
             "window_decode_steps": window_steps,
             "window_failed_row_fraction": round(failed_fraction, 4),
             "baseline_denominator_dec_per_sec": (

@@ -1,0 +1,276 @@
+#!/usr/bin/env python
+"""Convergence report from game-event JSONL files (BCG_TPU_GAME_EVENTS).
+
+``python scripts/consensus_report.py EVENTS.jsonl [MORE.jsonl ...] [--rounds]``
+
+Aggregates one or many game-event streams (each written by
+``bcg_tpu.obs.game_events``, first line = run manifest) into the sweep
+tables the paper's evaluation methodology needs: convergence rate,
+rounds-to-consensus, and Byzantine influence, grouped by configuration.
+Merging many files is mechanical BECAUSE of the manifest header — the
+group key is (agents split, topology, model, flag overrides), all read
+from ``manifest`` + ``game_start`` records, never from filenames.
+
+Self-contained — no bcg_tpu import — so event files copied off a TPU
+host (or collected from a hundred sweep workers) can be aggregated
+anywhere.  Tolerant by design: the emitting sink drops the OLDEST
+records under backpressure, so a game may be missing its ``game_start``
+(grouped under the file manifest with unknown geometry) or its
+``game_end`` (counted as incomplete and excluded from the convergence
+rate, never guessed).  Unknown schema versions are reported, not
+silently merged.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+# The schema this report understands (mirrors
+# bcg_tpu.obs.export.EVENT_SCHEMA_VERSION — by value, not import).
+KNOWN_SCHEMA_VERSIONS = (1,)
+
+# Flags that vary per worker without changing game semantics — excluded
+# from the group key so one sweep's workers merge into one row.
+_NON_CONFIG_FLAGS = (
+    "BCG_TPU_GAME_EVENTS",
+    "BCG_TPU_SERVE_EVENTS",
+    "BCG_TPU_METRICS_PORT",
+    "BCG_TPU_TRACE_OUT",
+)
+
+
+class GameAgg:
+    """Accumulator for one game's records."""
+
+    __slots__ = ("config_key", "started", "ended", "converged",
+                 "rounds_to_consensus", "influence", "round_ms",
+                 "decisions", "fallbacks", "invalids")
+
+    def __init__(self, config_key: str):
+        self.config_key = config_key
+        self.started = False
+        self.ended = False
+        self.converged = False
+        self.rounds_to_consensus: Optional[int] = None
+        self.influence = 0
+        self.round_ms: List[float] = []
+        self.decisions = 0
+        self.fallbacks = 0
+        self.invalids = 0
+
+
+def _config_key(manifest: Dict, start: Optional[Dict]) -> str:
+    """Human-readable group key from manifest + game_start fields."""
+    parts = []
+    if start:
+        parts.append(
+            f"{start.get('num_honest', '?')}h+"
+            f"{start.get('num_byzantine', '?')}b"
+        )
+        if start.get("topology"):
+            parts.append(str(start["topology"]))
+        if start.get("model"):
+            parts.append(str(start["model"]))
+    elif manifest.get("preset"):
+        parts.append(str(manifest["preset"]))
+    flags = manifest.get("flags") or {}
+    for name in sorted(flags):
+        if name in _NON_CONFIG_FLAGS:
+            continue
+        parts.append(f"{name}={flags[name]}")
+    return " ".join(parts) if parts else "(unknown config)"
+
+
+def parse_file(path: str, problems: List[str]) -> List[GameAgg]:
+    """All games found in one event file (games still open at EOF stay
+    ``ended=False``)."""
+    manifest: Dict = {}
+    games: Dict[str, GameAgg] = {}
+    starts: Dict[str, Dict] = {}
+    bad_lines = 0
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                bad_lines += 1
+                continue
+            event = rec.get("event")
+            if event == "manifest":
+                manifest = rec
+                version = rec.get("schema_version")
+                if version not in KNOWN_SCHEMA_VERSIONS:
+                    problems.append(
+                        f"{path}: unknown schema_version {version!r} "
+                        f"(this report understands {KNOWN_SCHEMA_VERSIONS})"
+                    )
+                continue
+            gid = rec.get("game")
+            if gid is None:
+                continue
+            if event == "game_start":
+                starts[gid] = rec
+                agg = games.get(gid) or GameAgg(_config_key(manifest, rec))
+                agg.config_key = _config_key(manifest, rec)
+                agg.started = True
+                games[gid] = agg
+                continue
+            agg = games.get(gid)
+            if agg is None:
+                # game_start lost to sink backpressure: group under the
+                # file manifest alone.
+                agg = games[gid] = GameAgg(_config_key(manifest, None))
+            if event == "round_end":
+                agg.influence += int(rec.get("byzantine_influence", 0))
+                if rec.get("duration_ms") is not None:
+                    agg.round_ms.append(float(rec["duration_ms"]))
+                if (rec.get("has_consensus")
+                        and agg.rounds_to_consensus is None):
+                    agg.rounds_to_consensus = int(rec.get("round", 0))
+            elif event == "decision":
+                agg.decisions += 1
+                outcome = rec.get("outcome")
+                if outcome == "fallback":
+                    agg.fallbacks += 1
+                elif outcome == "invalid":
+                    agg.invalids += 1
+            elif event == "game_end":
+                agg.ended = True
+                agg.converged = bool(rec.get("converged"))
+                # game_end's cumulative count is authoritative when
+                # round_end records were dropped.
+                agg.influence = max(
+                    agg.influence, int(rec.get("byzantine_influence", 0))
+                )
+    if bad_lines:
+        problems.append(f"{path}: skipped {bad_lines} unparseable line(s)")
+    return list(games.values())
+
+
+def _median(ordered: List[float]) -> float:
+    if not ordered:
+        return 0.0
+    n = len(ordered)
+    mid = n // 2
+    if n % 2:
+        return float(ordered[mid])
+    return (ordered[mid - 1] + ordered[mid]) / 2.0
+
+
+def render_report(games: List[GameAgg], problems: List[str]) -> str:
+    by_config: Dict[str, List[GameAgg]] = defaultdict(list)
+    for g in games:
+        by_config[g.config_key].append(g)
+
+    lines: List[str] = []
+    header = (
+        f"{'games':>5}  {'done':>4}  {'conv':>4}  {'rate':>6}  "
+        f"{'rounds(med/mean)':>16}  {'byz_infl':>8}  "
+        f"{'fallback':>8}  {'invalid':>7}  config"
+    )
+    lines.append("== consensus outcomes by config ==")
+    lines.append(header)
+    for key in sorted(by_config):
+        group = by_config[key]
+        done = [g for g in group if g.ended]
+        conv = [g for g in done if g.converged]
+        rate = (100.0 * len(conv) / len(done)) if done else 0.0
+        to_consensus = sorted(
+            g.rounds_to_consensus for g in conv
+            if g.rounds_to_consensus is not None
+        )
+        med = _median(to_consensus)
+        mean = (sum(to_consensus) / len(to_consensus)) if to_consensus else 0.0
+        infl = sum(g.influence for g in done)
+        decisions = sum(g.decisions for g in group)
+        fallbacks = sum(g.fallbacks for g in group)
+        invalids = sum(g.invalids for g in group)
+        fb_pct = (100.0 * fallbacks / decisions) if decisions else 0.0
+        inv_pct = (100.0 * invalids / decisions) if decisions else 0.0
+        lines.append(
+            f"{len(group):>5}  {len(done):>4}  {len(conv):>4}  "
+            f"{rate:>5.1f}%  {med:>7.1f}/{mean:<8.1f}  {infl:>8}  "
+            f"{fb_pct:>7.1f}%  {inv_pct:>6.1f}%  {key}"
+        )
+
+    round_ms = sorted(ms for g in games for ms in g.round_ms)
+    if round_ms:
+        n = len(round_ms)
+        p50 = round_ms[min(n - 1, int(round(0.50 * (n - 1))))]
+        p95 = round_ms[min(n - 1, int(round(0.95 * (n - 1))))]
+        lines.append("")
+        lines.append(
+            f"== round duration: {n} rounds, p50 {p50:.1f} ms, "
+            f"p95 {p95:.1f} ms =="
+        )
+    incomplete = sum(1 for g in games if not g.ended)
+    if incomplete:
+        lines.append("")
+        lines.append(
+            f"({incomplete} game(s) without a game_end record — excluded "
+            "from convergence rate)"
+        )
+    for problem in problems:
+        lines.append(f"WARNING: {problem}")
+    return "\n".join(lines)
+
+
+def render_rounds(games: List[GameAgg]) -> str:
+    """--rounds: distribution of rounds-to-consensus over converged
+    games (sweep plots read this table)."""
+    counts: Dict[int, int] = defaultdict(int)
+    for g in games:
+        if g.ended and g.converged and g.rounds_to_consensus is not None:
+            counts[g.rounds_to_consensus] += 1
+    if not counts:
+        return "== rounds-to-consensus: no converged games =="
+    lines = ["== rounds-to-consensus distribution =="]
+    width = max(counts.values())
+    for rounds in sorted(counts):
+        n = counts[rounds]
+        bar = "#" * max(1, round(40 * n / width))
+        lines.append(f"{rounds:>4} rounds  {n:>5}  {bar}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Convergence-rate / rounds-to-consensus / Byzantine-"
+        "influence tables from BCG_TPU_GAME_EVENTS JSONL files."
+    )
+    parser.add_argument("events", nargs="+",
+                        help="one or more game-event JSONL paths")
+    parser.add_argument("--rounds", action="store_true",
+                        help="also print the rounds-to-consensus "
+                        "distribution over converged games")
+    args = parser.parse_args(argv)
+    problems: List[str] = []
+    games: List[GameAgg] = []
+    for path in args.events:
+        try:
+            games.extend(parse_file(path, problems))
+        except OSError as exc:
+            print(f"consensus_report: cannot read {path}: {exc}",
+                  file=sys.stderr)
+            return 1
+    if not games:
+        print("consensus_report: no game records found", file=sys.stderr)
+        for problem in problems:
+            print(f"WARNING: {problem}", file=sys.stderr)
+        return 1
+    print(render_report(games, problems))
+    if args.rounds:
+        print()
+        print(render_rounds(games))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -51,8 +51,8 @@ from typing import Dict, List, Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-SCENARIOS = ("serve", "engine", "paged", "hlo")
-REGRESSIONS = ("none", "spec-off", "fail-rows")
+SCENARIOS = ("serve", "engine", "paged", "consensus", "hlo")
+REGRESSIONS = ("none", "spec-off", "fail-rows", "events-off")
 
 DECISION = {
     "type": "object",
@@ -349,6 +349,146 @@ def run_paged_scenario(inject: str = "none") -> Dict[str, float]:
     }
 
 
+# Game-event types every completed game must carry (the manifest is
+# per-file, checked separately).
+_REQUIRED_GAME_EVENTS = (
+    "game_start", "round_start", "decision", "deliveries", "vote",
+    "round_end", "game_end",
+)
+
+
+def run_consensus_scenario(inject: str = "none") -> Dict[str, float]:
+    """Hermetic FakeEngine consensus games with game-event telemetry on
+    (BCG_TPU_GAME_EVENTS to a temp file): three seeded games — two
+    fully-connected, one ring (topology-masked deliveries) — gating
+
+    * ``convergence_rate`` / ``rounds_to_consensus_mean`` — the paper's
+      outcome metrics, deterministic under the FakeEngine consensus
+      policy's seeded dynamics;
+    * ``event_schema_completeness`` — fraction of required event types
+      present per game (manifest checked per file): a silently dropped
+      emission site shows up as < 1 here, not as a mysteriously thin
+      sweep report later;
+    * ``events_dropped`` — the bounded sink must not shed records at
+      this scale;
+    * ``histogram_quantile_sanity`` — the game.round_ms registry
+      histogram's bucket-derived quantiles are ordered (p50<=p95<=p99),
+      non-negative, and within the declared bounds.
+
+    ``events-off`` injection unsets the flag — the gate must then name
+    the schema-completeness and convergence metrics rather than pass
+    vacuously."""
+    import dataclasses
+    import tempfile
+
+    from bcg_tpu.config import (
+        BCGConfig, EngineConfig, GameConfig, MetricsConfig, NetworkConfig,
+    )
+    from bcg_tpu.obs import counters as obs_counters, game_events
+    from bcg_tpu.runtime.orchestrator import BCGSimulation
+
+    events_path = os.path.join(
+        tempfile.mkdtemp(prefix="bcg-perf-gate-"), "game_events.jsonl"
+    )
+    # Save/restore the RAW value (None vs "") — registry accessors
+    # cannot round-trip "was unset".
+    prior = os.environ.get("BCG_TPU_GAME_EVENTS")  # lint: ignore[BCG-ENV-RAW]
+    if inject == "events-off":
+        os.environ.pop("BCG_TPU_GAME_EVENTS", None)
+    else:
+        os.environ["BCG_TPU_GAME_EVENTS"] = events_path
+    game_events.reset_sink()
+    drops_before = obs_counters.value("game.events_dropped")
+    hist_before = obs_counters.value("game.round_ms.count")
+    try:
+        games = [
+            dict(seed=7, topology="fully_connected"),
+            dict(seed=8, topology="fully_connected"),
+            dict(seed=3, topology="ring"),
+        ]
+        for spec in games:
+            cfg = dataclasses.replace(
+                BCGConfig(),
+                game=GameConfig(num_honest=4, num_byzantine=1,
+                                max_rounds=6, seed=spec["seed"]),
+                network=NetworkConfig(topology_type=spec["topology"]),
+                engine=EngineConfig(backend="fake"),
+                metrics=MetricsConfig(save_results=False),
+                verbose=False,
+            )
+            sim = BCGSimulation(config=cfg)
+            try:
+                sim.run()
+            finally:
+                sim.close()
+        game_events.reset_sink()  # drain + close so the file is complete
+    finally:
+        if prior is None:
+            os.environ.pop("BCG_TPU_GAME_EVENTS", None)
+        else:
+            os.environ["BCG_TPU_GAME_EVENTS"] = prior
+        game_events.reset_sink()
+
+    # Outcome + schema metrics come from the FILE (what a sweep would
+    # actually consume), not in-process state.
+    per_game: Dict[str, Dict] = {}
+    have_manifest = False
+    if os.path.exists(events_path):
+        with open(events_path) as f:
+            for line in f:
+                rec = json.loads(line)
+                if rec.get("event") == "manifest":
+                    have_manifest = rec.get("schema_version") is not None
+                    continue
+                gid = rec.get("game")
+                if gid is None:
+                    continue
+                g = per_game.setdefault(
+                    gid, {"events": set(), "converged": False, "rounds": 0}
+                )
+                g["events"].add(rec["event"])
+                if rec["event"] == "game_end":
+                    g["converged"] = bool(rec.get("converged"))
+                    g["rounds"] = int(rec.get("rounds", 0))
+    n_games = len(per_game)
+    converged = [g for g in per_game.values() if g["converged"]]
+    completeness = (
+        sum(
+            sum(1 for e in _REQUIRED_GAME_EVENTS if e in g["events"])
+            / len(_REQUIRED_GAME_EVENTS)
+            for g in per_game.values()
+        ) / n_games
+        if n_games else 0.0
+    ) * (1.0 if have_manifest or not n_games else 0.0)
+    rounds_mean = (
+        sum(g["rounds"] for g in converged) / len(converged)
+        if converged else 0.0
+    )
+
+    try:
+        hist = obs_counters.histogram("game.round_ms")  # read access
+    except KeyError:
+        hist = None  # recorder never ran (events-off injection)
+    if hist is not None and hist.count > hist_before:
+        q = hist.quantiles()
+        sane = float(
+            0.0 <= q["p50"] <= q["p95"] <= q["p99"] <= hist.bounds[-1]
+        )
+    else:
+        sane = 0.0
+    return {
+        "consensus.convergence_rate": (
+            len(converged) / n_games if n_games else 0.0
+        ),
+        "consensus.rounds_to_consensus_mean": rounds_mean,
+        "consensus.event_schema_completeness": completeness,
+        "consensus.events_dropped": float(
+            obs_counters.value("game.events_dropped") - drops_before
+        ),
+        "consensus.histogram_quantile_sanity": sane,
+    }
+
+
 def run_hlo_scenario(inject: str = "none") -> Dict[str, float]:
     """Kernel-census drift findings (scripts/hlo_census.py) as a gated
     metric — 0 findings = the lowered programs still match
@@ -371,6 +511,7 @@ _RUNNERS = {
     "serve": run_serve_scenario,
     "engine": run_engine_scenario,
     "paged": run_paged_scenario,
+    "consensus": run_consensus_scenario,
     "hlo": run_hlo_scenario,
 }
 

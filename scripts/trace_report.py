@@ -107,12 +107,16 @@ def render_report(trace: dict, top: int = 20) -> str:
             f"(dropped {dropped} unbalanced event(s) at the ring edge)"
         )
     counters = (trace.get("otherData") or {}).get("counters") or {}
-    # engine.hlo.* and hbm.* gauges get their own sections below —
-    # ranked by raw value (op counts, FLOPs, byte totals) they would
-    # crowd every actual event counter out of the top-N list.
+    # engine.hlo.* and hbm.* gauges get their own sections below, and so
+    # do histogram families (the flat .bucket.le_* / .sum / .count
+    # entries) — ranked by raw value (op counts, FLOPs, byte totals,
+    # cumulative bucket counts) they would crowd every actual event
+    # counter out of the top-N list.
+    hist_names = histogram_families(counters)
     ranked = sorted(
         ((k, v) for k, v in counters.items()
-         if not k.startswith(("engine.hlo.", "hbm."))),
+         if not k.startswith(("engine.hlo.", "hbm."))
+         and _histogram_owner(k, hist_names) is None),
         key=lambda kv: (-kv[1], kv[0]),
     )[:max(0, top)]
     if ranked:
@@ -129,6 +133,10 @@ def render_report(trace: dict, top: int = 20) -> str:
     if prefill_line:
         lines.append("")
         lines.append(prefill_line)
+    hist = histogram_table(counters, hist_names)
+    if hist:
+        lines.append("")
+        lines.append(hist)
     hbm = hbm_ledger_section(counters)
     if hbm:
         lines.append("")
@@ -137,6 +145,89 @@ def render_report(trace: dict, top: int = 20) -> str:
     if census:
         lines.append("")
         lines.append(census)
+    return "\n".join(lines)
+
+
+def histogram_families(counters: Dict[str, float]) -> List[str]:
+    """Histogram base names reconstructed from the registry's flat form
+    (``<name>.bucket.le_<bound>`` siblings of ``<name>.sum`` /
+    ``<name>.count``), longest-first so nested prefixes resolve to the
+    most specific owner."""
+    names = {
+        k.split(".bucket.le_", 1)[0]
+        for k in counters if ".bucket.le_" in k
+    }
+    return sorted(names, key=len, reverse=True)
+
+
+def _histogram_owner(key: str, families: List[str]) -> str:
+    """The histogram family ``key`` belongs to, or None — used both to
+    keep raw bucket/sum/count entries out of the ranked counter list and
+    to rebuild per-family quantiles."""
+    for name in families:
+        if (key.startswith(name + ".bucket.le_")
+                or key == name + ".sum" or key == name + ".count"):
+            return name
+    return None
+
+
+def _parse_bound(label: str) -> float:
+    """``le_`` label -> float bound (``25`` -> 25.0, ``2_5`` -> 2.5 —
+    the registry's bound_label encoding, reimplemented here to keep the
+    report bcg_tpu-import-free)."""
+    return float(label.replace("_", "."))
+
+
+def _quantile_from_cumulative(
+    buckets: List[Tuple[float, float]], total: float, q: float
+) -> float:
+    """Prometheus histogram_quantile over cumulative (bound, count)
+    pairs: linear interpolation inside the target bucket, clamped to the
+    highest finite bound for overflow-bucket ranks."""
+    if total <= 0:
+        return 0.0
+    target = q * total
+    prev_bound, prev_cum = 0.0, 0.0
+    for bound, cum in buckets:
+        if cum >= target and cum > prev_cum:
+            frac = (target - prev_cum) / (cum - prev_cum)
+            return prev_bound + (bound - prev_bound) * max(0.0, min(1.0, frac))
+        prev_bound, prev_cum = bound, cum
+    return buckets[-1][0] if buckets else 0.0
+
+
+def histogram_table(counters: Dict[str, float],
+                    families: List[str]) -> str:
+    """Per-histogram quantile table (count / p50 / p95 / p99, bucket-
+    interpolated) rebuilt from the flat registry entries, or '' when the
+    export carries no histograms."""
+    if not families:
+        return ""
+    rows = []
+    for name in sorted(families):
+        prefix = name + ".bucket.le_"
+        buckets = sorted(
+            (_parse_bound(k[len(prefix):]), v)
+            for k, v in counters.items() if k.startswith(prefix)
+        )
+        total = counters.get(name + ".count", buckets[-1][1] if buckets else 0)
+        rows.append((
+            name, int(total),
+            _quantile_from_cumulative(buckets, total, 0.50),
+            _quantile_from_cumulative(buckets, total, 0.95),
+            _quantile_from_cumulative(buckets, total, 0.99),
+        ))
+    name_w = max(len("histogram"), max(len(r[0]) for r in rows))
+    lines = ["== histogram quantiles (bucket-interpolated) =="]
+    lines.append(
+        f"{'histogram':<{name_w}}  {'count':>7}  {'p50':>9}  "
+        f"{'p95':>9}  {'p99':>9}"
+    )
+    for name, count, p50, p95, p99 in rows:
+        lines.append(
+            f"{name:<{name_w}}  {count:>7}  {p50:>9.3f}  "
+            f"{p95:>9.3f}  {p99:>9.3f}"
+        )
     return "\n".join(lines)
 
 

@@ -1,5 +1,5 @@
 """Seeded BCG-OBS-NAME violations: metric names off the taxonomy
-(3 findings)."""
+(4 findings)."""
 from bcg_tpu.obs import counters as obs_counters
 
 
@@ -8,3 +8,5 @@ def record(entry):
     obs_counters.set_gauge("requests", 1)         # finding 2: one segment
     obs_counters.inc(f"{entry}.retrace")          # finding 3: no static
     #                                               subsystem prefix
+    obs_counters.histogram("RoundMs", (1, 5))     # finding 4: histogram
+    #                                               names are checked too

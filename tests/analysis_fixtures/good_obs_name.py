@@ -9,3 +9,5 @@ def record(entry, name, account):
     obs_counters.inc(f"engine.retrace.{entry}")             # prefixed f-string
     obs_counters.set_gauge(f"hbm.{account}_bytes", 0)       # fragment chars ok
     obs_counters.value(name)                                # variable: trusted
+    obs_counters.histogram("game.round_ms", (1, 5)).observe(2)  # histogram
+    obs_counters.observe("game.round_ms", 3)                # module observe

@@ -49,6 +49,7 @@ RULE_FIXTURES = {
     "BCG-LOCK-CALL": ("bad_lock_call.py", "good_lock_call.py"),
     "BCG-TIME-WALL": ("bad_time_wall.py", "good_time_wall.py"),
     "BCG-OBS-NAME": ("bad_obs_name.py", "good_obs_name.py"),
+    "BCG-OBS-BUCKET": ("bad_obs_bucket.py", "good_obs_bucket.py"),
 }
 
 
@@ -95,7 +96,8 @@ class TestRuleFixtures:
             "BCG-JIT-DONATE": 1,
             "BCG-LOCK-CALL": 3,
             "BCG-TIME-WALL": 3,
-            "BCG-OBS-NAME": 3,
+            "BCG-OBS-NAME": 4,
+            "BCG-OBS-BUCKET": 3,
         }
         for rule_id, want in expected.items():
             bad, _ = RULE_FIXTURES[rule_id]

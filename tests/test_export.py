@@ -88,6 +88,72 @@ class TestPrometheusFormat:
         assert "bcg_export_test_gauge 9" in text
 
 
+class TestHistogramExposition:
+    TYPED = {
+        "counters": {},
+        "gauges": {},
+        "histograms": {
+            "serve.e2e_ms": {
+                "buckets": [[5.0, 2], [10.0, 3], [25.0, 3]],
+                "sum": 31.5,
+                "count": 4,
+            },
+        },
+    }
+
+    def test_conformant_family(self):
+        """The spec's histogram family: TYPE histogram, cumulative
+        ``_bucket{le=...}`` over the declared bounds, the mandatory
+        ``+Inf`` bucket equal to ``_count``, then ``_sum``/``_count``."""
+        text = export.render_prometheus(self.TYPED)
+        assert "# TYPE bcg_serve_e2e_ms histogram" in text
+        assert 'bcg_serve_e2e_ms_bucket{le="5"} 2' in text
+        assert 'bcg_serve_e2e_ms_bucket{le="10"} 3' in text
+        assert 'bcg_serve_e2e_ms_bucket{le="25"} 3' in text
+        assert 'bcg_serve_e2e_ms_bucket{le="+Inf"} 4' in text
+        assert "bcg_serve_e2e_ms_sum 31.5" in text
+        assert "bcg_serve_e2e_ms_count 4" in text
+        # Buckets stay together and ordered (one family block).
+        bucket_lines = [
+            l for l in text.splitlines() if "_bucket{" in l
+        ]
+        assert [l.split('le="')[1].split('"')[0] for l in bucket_lines] == \
+            ["5", "10", "25", "+Inf"]
+
+    def test_live_registry_histogram_roundtrip(self):
+        h = obs_counters.histogram("export.test_hist_ms", (1, 10, 100))
+        h.observe(0.5)
+        h.observe(7)
+        h.observe(5000)  # overflow bucket
+        text = export.render_prometheus()
+        assert "# TYPE bcg_export_test_hist_ms histogram" in text
+        assert 'bcg_export_test_hist_ms_bucket{le="1"} 1' in text
+        assert 'bcg_export_test_hist_ms_bucket{le="10"} 2' in text
+        assert 'bcg_export_test_hist_ms_bucket{le="100"} 2' in text
+        assert 'bcg_export_test_hist_ms_bucket{le="+Inf"} 3' in text
+        assert "bcg_export_test_hist_ms_count 3" in text
+
+    def test_scrape_serves_histogram_triplets(self):
+        """Ephemeral-port scrape: a registry histogram arrives at the
+        scraper as the full ``_bucket``/``_sum``/``_count`` family."""
+        h = obs_counters.histogram("export.scrape_hist_ms", (2, 20))
+        h.observe(1)
+        h.observe(50)
+        server, port = export.start_http_server(0)
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10
+            ) as resp:
+                body = resp.read().decode()
+        finally:
+            server.shutdown()
+            server.server_close()
+        assert 'bcg_export_scrape_hist_ms_bucket{le="2"} 1' in body
+        assert 'bcg_export_scrape_hist_ms_bucket{le="+Inf"} 2' in body
+        assert "bcg_export_scrape_hist_ms_sum 51" in body
+        assert "bcg_export_scrape_hist_ms_count 2" in body
+
+
 class TestHttpEndpoint:
     def test_scrape_during_fake_serving_run(self):
         """Acceptance criterion: the endpoint serves engine.hlo.*,
@@ -181,6 +247,41 @@ class TestEventSink:
         assert done["rows"] == 1 and "device_ms" in done
         assert "queue_wait_ms" in by_kind["dispatched"][0]
         assert by_kind["rejected"][0]["rows"] == 5
+
+    def test_manifest_is_first_record(self, tmp_path):
+        path = tmp_path / "manifested.jsonl"
+        sink = export.EventSink(
+            str(path), manifest=export.run_manifest(kind="serve")
+        )
+        sink.emit("admitted", req_id=1)
+        sink.close()
+        records = [json.loads(l) for l in path.read_text().splitlines()]
+        assert records[0]["event"] == "manifest"
+        assert records[0]["kind"] == "serve"
+        assert records[0]["schema_version"] == export.EVENT_SCHEMA_VERSION
+        assert len(records[0]["run_id"]) == 12
+        assert isinstance(records[0]["flags"], dict)
+        assert records[1]["event"] == "admitted"
+
+    def test_overflow_drops_oldest_and_counts(self, tmp_path):
+        """Bounded-queue overflow accounting: while the writer thread
+        is locked out (the test holds the sink condition — an RLock, so
+        same-thread emits still enter), emits past ``max_queue`` evict
+        the OLDEST records and each eviction lands in the sink's drop
+        counter.  What survives on disk is exactly the newest
+        ``max_queue`` records."""
+        drops_before = obs_counters.value("game.events_dropped")
+        path = tmp_path / "overflow.jsonl"
+        sink = export.EventSink(str(path), max_queue=4,
+                                drop_counter="game.events_dropped")
+        with sink._cond:  # writer thread cannot drain while held
+            for i in range(10):
+                sink.emit("e", i=i)
+        sink.close()
+        dropped = obs_counters.value("game.events_dropped") - drops_before
+        assert dropped == 6
+        records = [json.loads(l) for l in path.read_text().splitlines()]
+        assert [r["i"] for r in records] == [6, 7, 8, 9]
 
     def test_disabled_sink_is_noop(self, monkeypatch):
         monkeypatch.delenv("BCG_TPU_SERVE_EVENTS", raising=False)

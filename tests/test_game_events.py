@@ -1,0 +1,340 @@
+"""Consensus-game telemetry (bcg_tpu/obs/game_events.py) +
+scripts/consensus_report.py.
+
+The ISSUE-9 acceptance surface, asserted hermetically over FakeEngine
+games:
+
+* JSONL schema + manifest roundtrip — every emitted record type, its
+  required fields, and the one-source-of-truth guarantee that the
+  ``round_end`` stream carries exactly ``compute_statistics``'s
+  ``rounds_data`` shape;
+* a topology-masked game's ``deliveries`` records expose the ring mask;
+* live ``game.*`` counters + the ``game.round_ms`` histogram are
+  scrapeable on the Prometheus endpoint mid-process (ephemeral port via
+  ``BCG_TPU_METRICS_PORT``), with zero steady-state retraces;
+* the disabled-by-default path adds no counters, no sink thread, and no
+  recorder;
+* ``consensus_report.py`` aggregates two merged event files into a
+  non-empty convergence table with no bcg_tpu import.
+"""
+
+import dataclasses
+import json
+import socket
+import subprocess
+import sys
+import threading
+import urllib.request
+
+import pytest
+
+from bcg_tpu.config import (
+    BCGConfig,
+    EngineConfig,
+    GameConfig,
+    MetricsConfig,
+    NetworkConfig,
+)
+from bcg_tpu.game.statistics import compute_statistics
+from bcg_tpu.obs import counters as obs_counters, export, game_events
+from bcg_tpu.runtime import metrics as runtime_metrics
+from bcg_tpu.runtime.orchestrator import BCGSimulation
+
+REPO = __file__.rsplit("/tests/", 1)[0]
+REPORT = f"{REPO}/scripts/consensus_report.py"
+
+REQUIRED_EVENTS = {
+    "game_start", "round_start", "decision", "deliveries", "vote",
+    "round_end", "game_end",
+}
+
+ROUND_RECORD_KEYS = {
+    "round", "honest_values", "byzantine_values", "honest_mean",
+    "honest_std", "convergence_metric", "has_consensus",
+    "consensus_value", "agreement_count",
+}
+CONVERGENCE_KEYS = {
+    "distinct_honest_values", "value_spread", "margin_vs_threshold",
+    "byzantine_influence",
+}
+
+
+def _game_config(seed=7, topology="fully_connected", num_honest=4,
+                 num_byzantine=1, max_rounds=6):
+    return dataclasses.replace(
+        BCGConfig(),
+        game=GameConfig(num_honest=num_honest, num_byzantine=num_byzantine,
+                        max_rounds=max_rounds, seed=seed),
+        network=NetworkConfig(topology_type=topology),
+        engine=EngineConfig(backend="fake"),
+        metrics=MetricsConfig(save_results=False),
+        verbose=False,
+    )
+
+
+def _run_game(cfg):
+    sim = BCGSimulation(config=cfg)
+    try:
+        sim.run()
+    finally:
+        sim.close()
+    return sim
+
+
+@pytest.fixture
+def events_enabled(tmp_path, monkeypatch):
+    """BCG_TPU_GAME_EVENTS pointed at a temp file, sink + aggregate
+    isolated from whatever ran earlier in the process."""
+    path = tmp_path / "game_events.jsonl"
+    monkeypatch.setenv("BCG_TPU_GAME_EVENTS", str(path))
+    game_events.reset_sink()
+    game_events._reset_aggregate()
+    yield path
+    game_events.reset_sink()
+    game_events._reset_aggregate()
+
+
+def _read_events(path):
+    return [json.loads(l) for l in path.read_text().splitlines()]
+
+
+class TestSchemaRoundtrip:
+    def test_manifest_and_required_event_types(self, events_enabled):
+        _run_game(_game_config())
+        game_events.reset_sink()  # drain to disk
+        records = _read_events(events_enabled)
+        assert records[0]["event"] == "manifest"
+        assert records[0]["schema_version"] == export.EVENT_SCHEMA_VERSION
+        assert records[0]["kind"] == "game"
+        assert "BCG_TPU_GAME_EVENTS" in records[0]["flags"]
+        kinds = {r["event"] for r in records[1:]}
+        assert kinds >= REQUIRED_EVENTS
+        # Every post-manifest record carries the common envelope.
+        for r in records[1:]:
+            assert "ts" in r and "game" in r and "round" in r, r
+
+    def test_decision_vote_delivery_fields(self, events_enabled):
+        _run_game(_game_config())
+        game_events.reset_sink()
+        records = _read_events(events_enabled)
+        decisions = [r for r in records if r["event"] == "decision"]
+        votes = [r for r in records if r["event"] == "vote"]
+        deliveries = [r for r in records if r["event"] == "deliveries"]
+        assert decisions and votes and deliveries
+        roles = set()
+        for d in decisions:
+            assert d["role"] in ("honest", "byzantine")
+            assert d["outcome"] in ("valid", "fallback", "invalid")
+            assert d["value"] is None or isinstance(d["value"], int)
+            roles.add(d["role"])
+        assert roles == {"honest", "byzantine"}
+        for v in votes:
+            assert v["vote"] in ("stop", "continue", "abstain")
+        for m in deliveries:
+            assert m["count"] == len(m["senders"])
+
+    def test_round_end_matches_compute_statistics(self, events_enabled):
+        """One source of truth: the streamed round_end records carry
+        exactly the rounds_data dicts compute_statistics derives from
+        the same game (plus the convergence block + duration)."""
+        sim = _run_game(_game_config())
+        game_events.reset_sink()
+        records = _read_events(events_enabled)
+        round_ends = [r for r in records if r["event"] == "round_end"]
+        rounds_data = compute_statistics(sim.game)["rounds_data"]
+        assert len(round_ends) == len(rounds_data) == len(sim.game.rounds)
+        for streamed, computed in zip(round_ends, rounds_data):
+            assert ROUND_RECORD_KEYS <= set(streamed)
+            assert CONVERGENCE_KEYS <= set(streamed)
+            for key in ROUND_RECORD_KEYS:
+                assert streamed[key] == computed[key], key
+            assert streamed["duration_ms"] >= 0
+
+    def test_game_end_totals(self, events_enabled):
+        sim = _run_game(_game_config())
+        game_events.reset_sink()
+        records = _read_events(events_enabled)
+        ends = [r for r in records if r["event"] == "game_end"]
+        assert len(ends) == 1
+        end = ends[0]
+        assert end["converged"] == bool(sim.game.consensus_reached)
+        assert end["rounds"] == len(sim.game.rounds)
+        assert end["byzantine_influence"] == sum(
+            r["byzantine_influence"] for r in records
+            if r["event"] == "round_end"
+        )
+
+    def test_summary_published_for_bench(self, events_enabled):
+        _run_game(_game_config())
+        summary = game_events.summary()
+        assert summary == runtime_metrics.LAST_GAME_STATS
+        assert summary["games"] == summary["games_completed"] == 1
+        assert summary["rounds"] >= 1
+        assert summary["events_dropped"] >= 0
+
+
+class TestTopologyMask:
+    def test_ring_deliveries_are_masked(self, events_enabled):
+        """On a ring every agent's round inbox is exactly its 2
+        neighbors — the deliveries stream must show the mask, not the
+        fully-connected n-1."""
+        n = 6
+        _run_game(_game_config(seed=3, topology="ring",
+                               num_honest=n - 1, num_byzantine=1))
+        game_events.reset_sink()
+        records = _read_events(events_enabled)
+        deliveries = [r for r in records if r["event"] == "deliveries"]
+        assert deliveries
+        for m in deliveries:
+            assert m["count"] == 2, m
+            assert m["agent"] not in m["senders"]
+        start = [r for r in records if r["event"] == "game_start"][0]
+        assert start["topology"] == "ring"
+
+
+class TestLiveMetrics:
+    def test_scrape_game_metrics_mid_process(self, tmp_path, monkeypatch):
+        """Acceptance criterion: with BCG_TPU_GAME_EVENTS +
+        BCG_TPU_METRICS_PORT set, a hermetic two-game FakeEngine run is
+        scrapeable — ``game.*`` counters AND a conformant
+        ``game.round_ms`` histogram family — with zero steady-state
+        retraces."""
+        path = tmp_path / "ev.jsonl"
+        monkeypatch.setenv("BCG_TPU_GAME_EVENTS", str(path))
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+        probe.close()
+        monkeypatch.setenv("BCG_TPU_METRICS_PORT", str(port))
+        export.stop_http_server()
+        game_events.reset_sink()
+        game_events._reset_aggregate()
+        before = obs_counters.snapshot()
+        try:
+            for seed in (7, 8):
+                _run_game(_game_config(seed=seed))
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10
+            ) as resp:
+                body = resp.read().decode()
+        finally:
+            export.stop_http_server()
+            game_events.reset_sink()
+            game_events._reset_aggregate()
+        # Counters are process-cumulative: presence on the scrape here,
+        # exact movement via the registry delta below.
+        assert "bcg_game_games_total" in body
+        assert "bcg_game_rounds_total" in body
+        assert "bcg_game_decisions_total" in body
+        assert "# TYPE bcg_game_round_ms histogram" in body
+        assert 'bcg_game_round_ms_bucket{le="+Inf"}' in body
+        assert "bcg_game_round_ms_sum" in body
+        assert "bcg_game_round_ms_count" in body
+        moved = obs_counters.delta(before)
+        assert moved.get("game.games") == 2
+        assert moved.get("game.games.converged", 0) >= 1
+        assert not any(k.startswith("engine.retrace.") for k in moved), moved
+
+
+class TestDisabledByDefault:
+    def test_no_recorder_no_counters_no_threads(self, monkeypatch):
+        monkeypatch.delenv("BCG_TPU_GAME_EVENTS", raising=False)
+        game_events.reset_sink()
+        threads_before = {
+            t.name for t in threading.enumerate() if t.is_alive()
+        }
+        before = obs_counters.snapshot()
+        sim = BCGSimulation(config=_game_config())
+        try:
+            assert sim._recorder is None
+            sim.run()
+        finally:
+            sim.close()
+        moved = obs_counters.delta(before)
+        assert not any(k.startswith("game.") for k in moved), moved
+        new_threads = {
+            t.name for t in threading.enumerate() if t.is_alive()
+        } - threads_before
+        assert not any("event-sink" in n for n in new_threads), new_threads
+
+
+class TestConsensusReport:
+    def test_two_merged_games_aggregate(self, tmp_path, monkeypatch):
+        """Smoke over two event files from different configs: the
+        report groups them into separate convergence-table rows, each
+        non-empty, with no bcg_tpu import in the script."""
+        paths = []
+        for seed, topo in ((7, "fully_connected"), (3, "ring")):
+            path = tmp_path / f"ev_{topo}.jsonl"
+            monkeypatch.setenv("BCG_TPU_GAME_EVENTS", str(path))
+            game_events.reset_sink()
+            _run_game(_game_config(seed=seed, topology=topo))
+            game_events.reset_sink()
+            paths.append(str(path))
+        proc = subprocess.run(
+            [sys.executable, REPORT, *paths, "--rounds"],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 0, proc.stderr
+        out = proc.stdout
+        assert "consensus outcomes by config" in out
+        table_rows = [
+            l for l in out.splitlines()
+            if l.strip() and l.lstrip()[0].isdigit()
+        ]
+        assert any("fully_connected" in l for l in table_rows)
+        assert any("ring" in l for l in table_rows)
+        assert "100.0%" in out            # both seeded games converge
+        assert "rounds-to-consensus distribution" in out
+        assert "round duration" in out
+        src = open(REPORT).read()
+        assert "import bcg_tpu" not in src and "from bcg_tpu" not in src
+
+    def test_report_errors_on_empty_input(self, tmp_path):
+        empty = tmp_path / "empty.jsonl"
+        empty.write_text("")
+        proc = subprocess.run(
+            [sys.executable, REPORT, str(empty)],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 1
+        assert "no game records" in proc.stderr
+
+    def test_report_tolerates_missing_game_end(self, tmp_path):
+        """A game whose tail was lost to sink backpressure is counted
+        incomplete and excluded from the convergence rate, not
+        guessed."""
+        path = tmp_path / "truncated.jsonl"
+        lines = [
+            {"event": "manifest", "schema_version": 1, "run_id": "x",
+             "flags": {}},
+            {"event": "game_start", "game": "g1", "round": None,
+             "num_honest": 3, "num_byzantine": 0,
+             "topology": "fully_connected"},
+            {"event": "round_end", "game": "g1", "round": 1,
+             "has_consensus": False, "byzantine_influence": 0,
+             "duration_ms": 2.0},
+        ]
+        path.write_text("\n".join(json.dumps(l) for l in lines) + "\n")
+        proc = subprocess.run(
+            [sys.executable, REPORT, str(path)],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "without a game_end" in proc.stdout
+
+    def test_report_warns_on_unknown_schema(self, tmp_path):
+        path = tmp_path / "future.jsonl"
+        lines = [
+            {"event": "manifest", "schema_version": 99, "run_id": "x",
+             "flags": {}},
+            {"event": "game_end", "game": "g1", "round": 1,
+             "converged": True, "rounds": 1, "byzantine_influence": 0},
+        ]
+        path.write_text("\n".join(json.dumps(l) for l in lines) + "\n")
+        proc = subprocess.run(
+            [sys.executable, REPORT, str(path)],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "unknown schema_version" in proc.stdout

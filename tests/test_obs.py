@@ -199,6 +199,93 @@ class TestCounters:
         assert "test_obs.never_touched" not in obs_counters.snapshot()
 
 
+class TestHistogram:
+    def test_observe_buckets_and_flat_snapshot(self):
+        h = obs_counters.histogram("test_obs.lat_ms", (1, 5, 25))
+        for v in (0.5, 3, 3, 30, 1000):
+            h.observe(v)
+        flat = h.flat()
+        # Cumulative buckets; the overflow (+Inf) bucket is .count.
+        assert flat["test_obs.lat_ms.bucket.le_1"] == 1
+        assert flat["test_obs.lat_ms.bucket.le_5"] == 3
+        assert flat["test_obs.lat_ms.bucket.le_25"] == 3
+        assert flat["test_obs.lat_ms.count"] == 5
+        assert flat["test_obs.lat_ms.sum"] == 1036.5
+        assert h.flat().items() <= obs_counters.snapshot().items()
+
+    def test_bucket_derived_quantiles_are_ordered_and_bounded(self):
+        h = obs_counters.histogram("test_obs.q_ms", (10, 100, 1000))
+        for v in (5, 20, 50, 200, 5000):
+            h.observe(v)
+        q = h.quantiles()
+        assert set(q) == {"p50", "p95", "p99"}
+        assert 0 <= q["p50"] <= q["p95"] <= q["p99"] <= 1000
+        # Overflow-bucket ranks clamp to the highest FINITE bound.
+        assert q["p99"] == 1000
+
+    def test_quantile_interpolates_within_bucket(self):
+        h = obs_counters.histogram("test_obs.interp_ms", (0, 10))
+        for _ in range(4):
+            h.observe(5)
+        # All mass in (0, 10]: the median interpolates to mid-bucket.
+        assert h.quantile(0.5) == 5.0
+
+    def test_bounds_validation(self):
+        with pytest.raises(ValueError):
+            obs_counters.histogram("test_obs.bad_desc", (5, 1))
+        with pytest.raises(ValueError):
+            obs_counters.histogram("test_obs.bad_inf", (1, float("inf")))
+        with pytest.raises(ValueError):
+            obs_counters.histogram("test_obs.bad_neg", (-1, 5))
+        with pytest.raises(ValueError):
+            obs_counters.histogram("test_obs.bad_empty", ())
+
+    def test_conflicting_bounds_rejected_same_bounds_ok(self):
+        obs_counters.histogram("test_obs.stable_ms", (1, 2))
+        assert obs_counters.histogram("test_obs.stable_ms").bounds == (1.0, 2.0)
+        assert obs_counters.histogram("test_obs.stable_ms", (1, 2)).bounds \
+            == (1.0, 2.0)
+        with pytest.raises(ValueError):
+            obs_counters.histogram("test_obs.stable_ms", (1, 3))
+
+    def test_undeclared_observe_rejected(self):
+        with pytest.raises(KeyError):
+            obs_counters.observe("test_obs.never_declared", 1)
+
+    def test_type_clash_rejected(self):
+        obs_counters.inc("test_obs.hist_clash")
+        with pytest.raises(TypeError):
+            obs_counters.histogram("test_obs.hist_clash", (1,))
+        obs_counters.histogram("test_obs.hist_first", (1,))
+        with pytest.raises(TypeError):
+            obs_counters.counter("test_obs.hist_first")
+        with pytest.raises(TypeError):
+            obs_counters.gauge("test_obs.hist_first")
+
+    def test_delta_carries_counts_not_sum(self):
+        before = obs_counters.snapshot()
+        h = obs_counters.histogram("test_obs.delta_ms", (1, 10))
+        h.observe(0.5)
+        h.observe(100)
+        moved = obs_counters.delta(before)
+        assert moved["test_obs.delta_ms.count"] == 2
+        assert moved["test_obs.delta_ms.bucket.le_1"] == 1
+        assert "test_obs.delta_ms.sum" not in moved
+
+    def test_raw_baseline_idiom(self):
+        """Per-instance share via construction-time raw() baselines —
+        the SchedulerStats idiom."""
+        h = obs_counters.histogram("test_obs.shared_ms", (1, 10))
+        h.observe(0.5)
+        base_counts, base_sum, base_n = h.raw()
+        h.observe(5)
+        counts, total, n = h.raw()
+        own = [c - b for c, b in zip(counts, base_counts)]
+        assert n - base_n == 1
+        assert own == [0, 1, 0]
+        assert total - base_sum == 5
+
+
 class TestServeCounters:
     def test_delta_accounts_scripted_fake_run(self, untraced):
         """Scripted FakeEngine run: exact request/row movement in the
@@ -215,9 +302,11 @@ class TestServeCounters:
         assert moved["serve.requests"] == 3
         assert moved["serve.dispatched_rows"] == 3
         assert 1 <= moved["serve.dispatches"] <= 3
-        linger = sum(v for k, v in moved.items()
-                     if k.startswith("serve.linger_"))
-        assert linger == 3  # one bucketed linger sample per dispatch
+        # One queue-wait observation per dispatched request, now in the
+        # first-class serve.queue_wait_ms histogram (delta carries its
+        # monotonic .count / .bucket.* entries).
+        assert moved["serve.queue_wait_ms.count"] == 3
+        assert moved["serve.e2e_ms.count"] == 3
 
     def test_snapshot_latency_breakdown_and_hist_isolation(self, untraced):
         first = ServingEngine(FakeEngine(seed=0), linger_ms=0)

@@ -41,6 +41,7 @@ def gate():
     measured = {}
     measured.update(mod.run_serve_scenario())
     measured.update(mod.run_engine_scenario())
+    measured.update(mod.run_consensus_scenario())
     return mod, measured
 
 
@@ -49,7 +50,7 @@ class TestGreenAtHead:
         mod, measured = gate
         findings = mod.check_metrics(measured, mod.load_baseline())
         findings += mod.check_stale(
-            measured, mod.load_baseline(), ("serve", "engine")
+            measured, mod.load_baseline(), ("serve", "engine", "consensus")
         )
         assert findings == [], "\n".join(findings)
 
@@ -63,8 +64,23 @@ class TestGreenAtHead:
             "serve.completed_fraction",
             "serve.rows_per_dispatch",
             "serve.spec_acceptance_rate",
+            "consensus.convergence_rate",
+            "consensus.rounds_to_consensus_mean",
+            "consensus.event_schema_completeness",
+            "consensus.events_dropped",
+            "consensus.histogram_quantile_sanity",
         ):
             assert name in measured, sorted(measured)
+
+    def test_consensus_games_converge_with_complete_schemas(self, gate):
+        """Acceptance criterion: the hermetic consensus scenario is
+        green — every seeded game converges, every event type lands in
+        the JSONL, nothing dropped, quantiles sane."""
+        _, measured = gate
+        assert measured["consensus.convergence_rate"] == 1.0
+        assert measured["consensus.event_schema_completeness"] == 1.0
+        assert measured["consensus.events_dropped"] == 0
+        assert measured["consensus.histogram_quantile_sanity"] == 1.0
 
     def test_steady_state_retraces_are_zero(self, gate):
         _, measured = gate
@@ -92,6 +108,20 @@ class TestInjectedRegression:
         measured = mod.run_serve_scenario(inject="fail-rows")
         findings = mod.check_metrics(measured, mod.load_baseline())
         assert any("serve.error_row_fraction" in f for f in findings), findings
+
+    def test_events_off_fails_rather_than_passing_vacuously(self, gate):
+        """With game-event telemetry silently disabled the consensus
+        scenario must FAIL naming its outcome metrics — an empty event
+        file can never read as a green convergence gate."""
+        mod, _ = gate
+        measured = mod.run_consensus_scenario(inject="events-off")
+        findings = mod.check_metrics(measured, mod.load_baseline())
+        assert any(
+            "consensus.event_schema_completeness" in f for f in findings
+        ), findings
+        assert any(
+            "consensus.convergence_rate" in f for f in findings
+        ), findings
 
 
 class TestBaselineLoadBearing:

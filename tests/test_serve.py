@@ -294,6 +294,71 @@ class TestLingerDispatch:
         assert len(outs) == 2
 
 
+class TestSLO:
+    def test_violations_counted_against_slow_dispatch(self):
+        """A scripted 50 ms device dispatch against a 10 ms objective:
+        every completed request is a violation — counted in the
+        snapshot, in the process-wide serve.slo.violations counter, and
+        observed (negative headroom) in the headroom histogram."""
+        from bcg_tpu.obs import counters as obs_counters
+
+        before = obs_counters.value("serve.slo.violations")
+        sched = Scheduler(StubEngine(call_delay=0.05), linger_ms=1,
+                          slo_ms=10)
+        for i in range(2):
+            out = sched.submit_and_wait(
+                ("json",), [("s", f"u{i}", DECIDE)], [0.0], [16])
+            assert len(out) == 1
+        snap = sched.snapshot()
+        sched.close()
+        assert snap["slo"]["slo_ms"] == 10
+        assert snap["slo"]["violations"] == 2
+        assert obs_counters.value("serve.slo.violations") - before == 2
+        headroom = snap["slo"]["headroom_ms"]
+        assert headroom["count"] == 2
+        # Negative headroom floors into the le=0 bucket: quantiles of
+        # an all-violating run read exactly 0, never a spurious
+        # positive value; the true signed magnitude survives in sum_ms.
+        assert headroom["p50_ms"] == 0.0
+        assert headroom["p99_ms"] == 0.0
+        assert headroom["sum_ms"] < 0
+        e2e = snap["hist_ms"]["e2e"]
+        assert e2e["count"] == 2
+        assert e2e["p50_ms"] >= 10.0       # the 50 ms dispatch dominates
+        assert snap["hist_ms"]["device"]["count"] == 2
+
+    def test_within_slo_no_violations(self):
+        from bcg_tpu.obs import counters as obs_counters
+
+        before = obs_counters.value("serve.slo.violations")
+        sched = Scheduler(StubEngine(), linger_ms=1, slo_ms=60_000)
+        sched.submit_and_wait(("json",), [("s", "u", DECIDE)], [0.0], [16])
+        snap = sched.snapshot()
+        sched.close()
+        assert snap["slo"]["violations"] == 0
+        assert snap["slo"]["headroom_ms"]["count"] == 1
+        assert obs_counters.value("serve.slo.violations") == before
+
+    def test_no_slo_by_default(self, monkeypatch):
+        """Without BCG_TPU_SERVE_SLO_MS the snapshot's slo block is None
+        and the scheduler registers no headroom histogram."""
+        monkeypatch.delenv("BCG_TPU_SERVE_SLO_MS", raising=False)
+        sched = Scheduler(StubEngine(), linger_ms=1)
+        sched.submit_and_wait(("json",), [("s", "u", DECIDE)], [0.0], [16])
+        snap = sched.snapshot()
+        sched.close()
+        assert snap["slo"] is None
+        assert "slo_headroom" not in sched.stats._hists
+        # The plain latency histograms still populate.
+        assert snap["hist_ms"]["e2e"]["count"] == 1
+
+    def test_env_flag_configures_objective(self, monkeypatch):
+        monkeypatch.setenv("BCG_TPU_SERVE_SLO_MS", "25")
+        sched = Scheduler(StubEngine(), linger_ms=1)
+        assert sched.stats.slo_ms == 25
+        sched.close()
+
+
 class TestDeadlines:
     def test_queued_request_cancelled_at_deadline(self):
         """A request stuck behind a slow in-flight batch is cancelled at

@@ -1,6 +1,10 @@
-"""Tests for Q1/Q2/Q3 statistics (reference byzantine_consensus.py:544-839)."""
+"""Tests for Q1/Q2/Q3 statistics (reference byzantine_consensus.py:544-839)
+and the shared per-round record (round_record / round_convergence — the
+single source of truth behind BOTH ``rounds_data`` and the live
+game-event stream)."""
 
 from bcg_tpu.game import ByzantineConsensusGame
+from bcg_tpu.game.statistics import round_convergence, round_record
 
 
 def play_to_consensus(game, target, rounds=1, final_votes=True):
@@ -112,6 +116,72 @@ def test_rounds_data_structure():
     rd = s["rounds_data"]
     assert len(rd) == s["total_rounds"]
     assert {"round", "honest_values", "has_consensus", "consensus_value"} <= set(rd[0])
+
+
+def test_round_record_is_the_rounds_data_shape():
+    """round_record() IS the rounds_data element — key set and values
+    pinned (the reference output shape the game-event stream reuses)."""
+    g = ByzantineConsensusGame(num_honest=3, num_byzantine=1, seed=1)
+    target = next(
+        st.initial_value for a, st in g.agents.items() if not st.is_byzantine
+    )
+    play_to_consensus(g, target)
+    s = g.get_statistics()
+    r = g.rounds[0]
+    rec = round_record(r)
+    assert rec == s["rounds_data"][0]
+    assert set(rec) == {
+        "round", "honest_values", "byzantine_values", "honest_mean",
+        "honest_std", "convergence_metric", "has_consensus",
+        "consensus_value", "agreement_count",
+    }
+    # include_byzantine=False empties the byzantine column only.
+    masked = round_record(r, include_byzantine=False)
+    assert masked["byzantine_values"] == []
+    assert {k: v for k, v in masked.items() if k != "byzantine_values"} == \
+        {k: v for k, v in rec.items() if k != "byzantine_values"}
+
+
+def test_round_convergence_metrics():
+    g = ByzantineConsensusGame(num_honest=3, seed=0, value_range=(0, 50))
+    for aid, v in zip(sorted(g.agents), [10, 20, 20]):
+        g.update_agent_proposal(aid, v)
+    g.advance_round({aid: False for aid in g.agents})
+    conv = round_convergence(g.rounds[0], g.consensus_threshold)
+    assert conv["distinct_honest_values"] == 2
+    assert conv["value_spread"] == 10
+    assert conv["margin_vs_threshold"] == round(
+        g.rounds[0].convergence_metric - g.consensus_threshold, 3
+    )
+    assert conv["byzantine_influence"] == 0  # no byzantine proposals given
+
+
+def test_byzantine_influence_counts_adoptions_only():
+    """Influence = honest agents who CHANGED to a value a byzantine
+    proposed last round; keeping one's own matching value is not an
+    adoption."""
+    g = ByzantineConsensusGame(num_honest=3, num_byzantine=1, seed=3)
+    honest = sorted(a for a, st in g.agents.items() if not st.is_byzantine)
+    # h0 adopts 42 (was something else), h1 already held 42, h2 moves
+    # to a non-byzantine value.
+    prev = {honest[0]: 7, honest[1]: 42, honest[2]: 9}
+    g.update_agent_proposal(honest[0], 42)
+    g.update_agent_proposal(honest[1], 42)
+    g.update_agent_proposal(honest[2], 11)
+    byz = next(a for a, st in g.agents.items() if st.is_byzantine)
+    g.update_agent_proposal(byz, 0)
+    g.advance_round({aid: False for aid in g.agents})
+    conv = round_convergence(
+        g.rounds[0], g.consensus_threshold, honest_ids=honest,
+        prev_values=prev, prev_byzantine_proposals=[42],
+    )
+    assert conv["byzantine_influence"] == 1
+    # No byzantine proposals last round -> influence is structurally 0.
+    conv0 = round_convergence(
+        g.rounds[0], g.consensus_threshold, honest_ids=honest,
+        prev_values=prev, prev_byzantine_proposals=[],
+    )
+    assert conv0["byzantine_influence"] == 0
 
 
 def test_consensus_preference_flags():

@@ -137,6 +137,60 @@ def test_report_renders_hlo_census_table(tmp_path):
     assert "hlo kernel census" not in proc2.stdout
 
 
+def test_report_renders_histogram_quantile_table(tmp_path):
+    """Flat registry-histogram entries (.bucket.le_* / .sum / .count)
+    render as a per-family p50/p95/p99 table AND stay out of the ranked
+    top-counter list (the hlo/hbm crowding fix applied to histograms)."""
+    trace = {
+        "traceEvents": [],
+        "otherData": {"counters": {
+            "serve.e2e_ms.bucket.le_5": 2,
+            "serve.e2e_ms.bucket.le_10": 6,
+            "serve.e2e_ms.bucket.le_25": 8,
+            "serve.e2e_ms.sum": 90.0,
+            "serve.e2e_ms.count": 8,
+            "game.round_ms.bucket.le_50": 3,
+            "game.round_ms.bucket.le_2_5": 1,   # non-integer bound label
+            "game.round_ms.sum": 61.0,
+            "game.round_ms.count": 4,
+            "serve.requests": 12,
+        }},
+    }
+    path = tmp_path / "hist_trace.json"
+    path.write_text(json.dumps(trace))
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, str(path)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "histogram quantiles" in proc.stdout
+    rows = {
+        l.split()[0]: l for l in proc.stdout.splitlines()
+        if l.startswith(("serve.e2e_ms", "game.round_ms"))
+    }
+    assert set(rows) == {"serve.e2e_ms", "game.round_ms"}
+    # serve.e2e_ms: count 8; median rank 4 lands in the (5,10] bucket.
+    e2e = rows["serve.e2e_ms"].split()
+    assert e2e[1] == "8"
+    assert 5.0 < float(e2e[2]) <= 10.0
+    # Raw bucket/sum/count entries never reach the ranked counter list.
+    top_section = proc.stdout.split("top counters")[1].split("\n==")[0]
+    assert "serve.requests" in top_section
+    assert ".bucket.le_" not in top_section
+    assert "serve.e2e_ms.count" not in top_section
+    assert "game.round_ms.sum" not in top_section
+    # No histograms -> no table.
+    bare = tmp_path / "bare3.json"
+    bare.write_text(json.dumps(
+        {"traceEvents": [], "otherData": {"counters": {"serve.requests": 1}}}
+    ))
+    proc2 = subprocess.run(
+        [sys.executable, SCRIPT, str(bare)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert "histogram quantiles" not in proc2.stdout
+
+
 def test_report_handles_empty_trace(tmp_path):
     empty = tmp_path / "empty.json"
     empty.write_text(json.dumps({"traceEvents": [], "otherData": {}}))
