@@ -105,8 +105,13 @@ class EngineConfig:
     dtype: str = "bfloat16"
     # "int8" stores the KV cache quantized (per-position-per-head absmax
     # scales); the Pallas decode kernel dequantizes in VMEM, halving the
-    # HBM traffic of the bandwidth-bound decode step.
-    kv_cache_dtype: str = "bfloat16"  # bfloat16 | int8
+    # HBM traffic of the bandwidth-bound decode step.  "int4" packs the
+    # head dim two values per byte with bf16 scales — a CAPACITY knob
+    # (admissible batch roughly doubles vs int8 at a fixed HBM budget);
+    # the paged Pallas kernel unpacks nibbles in VMEM, the dense cache
+    # serves through the dequant fallback.  Env override
+    # BCG_TPU_KV_DTYPE={bf16,int8,int4}.
+    kv_cache_dtype: str = "bfloat16"  # bfloat16 | int8 | int4
     quantization: Optional[str] = None
     # Prefill the static per-role system prompt once per run and reuse its
     # KV across every round's calls (auto-disabled for template families
@@ -166,6 +171,17 @@ class EngineConfig:
     spec_decode: bool = False
     spec_k: int = 4
     spec_ngram: int = 3
+    # Fused guided-sampling kernel (ops/guided_sampler.py): the whole
+    # per-step [B, V] masked-sampler pipeline — DFA allowed-mask,
+    # EOS gate, temperature, top-p (threshold scan, no sort), draw —
+    # as ONE Pallas program per row, shared by the plain/fast-forward/
+    # speculative decode loops.  "pallas" = the kernel (interpret mode
+    # off-TPU — the parity-test path), "xla" = the reference sampler
+    # (the conformance oracle), "auto" = pallas on TPU, xla elsewhere.
+    # Greedy rows are token-identical to the xla path; temp>0 rows
+    # distribution-preserving (seeded statistical tests).  Env override
+    # BCG_TPU_FUSED_SAMPLER.
+    fused_sampler: str = "auto"  # auto | pallas | xla
     # Compact-JSON generation grammar: no inter-token whitespace (fewer
     # decoded tokens, longer forced chains).  Output is still valid JSON;
     # off by default for byte-compatibility with the reference's

@@ -42,6 +42,10 @@ from bcg_tpu.engine.speculative import (
 )
 from bcg_tpu.engine.tokenizer import Tokenizer, tokenizer_for_model
 from bcg_tpu.guided.processor import GuidedBatch, compile_schema
+from bcg_tpu.ops.guided_sampler import (
+    PALLAS as _GS_PALLAS,
+    PALLAS_INTERPRET as _GS_PALLAS_INTERPRET,
+)
 from bcg_tpu.config import env_flag
 from bcg_tpu.obs import (
     counters as obs_counters,
@@ -209,6 +213,26 @@ def _pad_rows(*lists, multiple: int = 1):
     return (real_B, B) + tuple(l + [l[0]] * (B - real_B) for l in lists)
 
 
+def _kernel_fallback_warn(family: str, knob: str, detail: str,
+                          consequence: str) -> None:
+    """ONE warning shape for every kernel-family fallback (the int8 GQA
+    decode kernel, the fused guided sampler, future arms): names the
+    kernel family, the CONFIG KNOB that caused the fallback (an env
+    kill-switch, a geometry guard, a backend condition — cause
+    attribution is the caller's job: when an operator-set env flag and a
+    geometry guard both apply, the stated cause must be the flag the
+    operator actually set), and the operational consequence.  Hand-
+    rolled per-family warning text drifted — each family named its
+    cause differently or not at all."""
+    import warnings
+
+    warnings.warn(
+        f"{family} disabled — falling back to the XLA path ({knob}: "
+        f"{detail}); {consequence}",
+        stacklevel=3,
+    )
+
+
 class JaxEngine(InferenceEngine):
     def __init__(self, config, mesh=None, params=None, spec: Optional[ModelSpec] = None):
         _enable_compilation_cache()
@@ -246,11 +270,27 @@ class JaxEngine(InferenceEngine):
             )
         else:
             self.attention_impl = config.attention_impl
-        if config.kv_cache_dtype not in ("bfloat16", "int8"):
+        # KV-cache dtype: config field, overridden by BCG_TPU_KV_DTYPE
+        # (bench/sweep A/B knob; "bf16" and "bfloat16" are the same
+        # spelling, "int8" keeps its historical meaning as an alias of
+        # itself in the generalized {bf16,int8,int4} switch).
+        from bcg_tpu.runtime.envflags import get_str as _get_str0
+
+        _kv_raw = (
+            (_get_str0("BCG_TPU_KV_DTYPE") or "").strip().lower()
+            or str(config.kv_cache_dtype).lower()
+        )
+        _kv_raw = {"bf16": "bfloat16"}.get(_kv_raw, _kv_raw)
+        if _kv_raw not in ("bfloat16", "int8", "int4"):
             raise ValueError(
-                f"kv_cache_dtype={config.kv_cache_dtype!r}: expected "
-                "'bfloat16' or 'int8'"
+                f"kv_cache_dtype={_kv_raw!r}: expected 'bfloat16'/'bf16', "
+                "'int8' or 'int4'"
             )
+        if _kv_raw == "int4":
+            from bcg_tpu.models.quantize import kv_int4_layout
+
+            kv_int4_layout(self.spec.head_dim)  # even-head-dim boot check
+        self.kv_dtype = _kv_raw
         if config.quantization not in (None, "int8", "int4"):
             raise ValueError(
                 f"quantization={config.quantization!r}: expected None, "
@@ -267,7 +307,16 @@ class JaxEngine(InferenceEngine):
                 "bfloat16; use quantization='int8'/'int4' for lower-"
                 "precision weights"
             )
-        self.kv_quantized = config.kv_cache_dtype == "int8"
+        # False | "int8" | "int4" — truthy for any quantized layout (the
+        # [B, Hkv, S, *] axes and scale leaves are shared), passed
+        # verbatim as the ``quantized=`` argument of every cache
+        # init/sharding helper so the packed int4 shapes materialize
+        # where they must; int8-KERNEL eligibility checks compare
+        # against "int8" explicitly (the dense Pallas decode kernels
+        # stream unpacked int8 only — int4 serves through the dequant
+        # fallback dense, and through the paged kernel's in-VMEM nibble
+        # unpack when paged).
+        self.kv_quantized = False if _kv_raw == "bfloat16" else _kv_raw
         # Decode impl: the bf16 einsum path is a well-fused GEMV and the
         # hardware-validated default; the Pallas cache-streaming kernel
         # exists for the int8 cache's in-VMEM dequant and is int8-ONLY —
@@ -303,31 +352,32 @@ class JaxEngine(InferenceEngine):
             # kernel on without a code change.
             group_ok = True
         int8_kernel_off = kill_switch or not group_ok
-        if self.kv_quantized and on_tpu_aligned and not int8_kernel_off:
+        if self.kv_dtype == "int8" and on_tpu_aligned and not int8_kernel_off:
             self.decode_attention_impl = "pallas"
         else:
             self.decode_attention_impl = (
                 "xla" if self.attention_impl == "pallas" else self.attention_impl
             )
-        if self.kv_quantized and self.decode_attention_impl != "pallas":
-            import warnings
-
+        if self.kv_dtype == "int8" and self.decode_attention_impl != "pallas":
             # Cause attribution: the env kill-switch is checked FIRST —
             # when both it and the group guard apply, the operator set
             # the switch and the stated cause must be the actual cause.
-            warnings.warn(
-                "int8 KV cache without the Pallas decode kernel ("
-                + ("BCG_TPU_DISABLE_INT8_DECODE_KERNEL is set"
-                   if kill_switch
-                   else "GQA group width "
-                   f"{group} is not a power of two (kernel-crashing set)"
-                   if not group_ok
-                   else "non-TPU backend or head_dim not a multiple of 128")
-                + "): the fallback dequantizes the whole cache per step, "
-                "which is SLOWER than bfloat16",
-                stacklevel=2,
+            knob, detail = (
+                ("env kill-switch", "BCG_TPU_DISABLE_INT8_DECODE_KERNEL is set")
+                if kill_switch
+                else ("geometry guard",
+                      f"GQA group width {group} is not a power of two "
+                      "(kernel-crashing set)")
+                if not group_ok
+                else ("backend guard",
+                      "non-TPU backend or head_dim not a multiple of 128")
             )
-        elif self.kv_quantized and self.spec.param_count < LARGE_MODEL_PARAMS:
+            _kernel_fallback_warn(
+                "int8 KV cache Pallas decode kernel", knob, detail,
+                "the fallback dequantizes the whole cache per step, "
+                "which is SLOWER than bfloat16",
+            )
+        elif self.kv_dtype == "int8" and self.spec.param_count < LARGE_MODEL_PARAMS:
             import warnings
 
             # VERDICT round-2 weak #5: the losing configuration must not
@@ -368,10 +418,17 @@ class JaxEngine(InferenceEngine):
             self._kv_align = 1
         # Bytes per (position, layer) cache slot — the unit shared by the
         # perf accounting, the KV budget guard, and the provisioner.
-        self._kv_slot_bytes = self.spec.num_kv_heads * self.spec.head_dim * 2
-        self._kv_slot_bytes *= 1 if self.kv_quantized else 2
-        if self.kv_quantized:
-            self._kv_slot_bytes += self.spec.num_kv_heads * 2 * 4  # f32 scales
+        # bf16: k+v at 2 bytes; int8: k+v at 1 byte + two f32 scales;
+        # int4: k+v PACKED at Dh/2 bytes each + two bf16 scales — which
+        # is exactly half the int8 slot (2(Dh+4) vs Dh+4 per kv head),
+        # the arithmetic behind the >= 1.8x admission-cap gain the perf
+        # gate pins.
+        if self.kv_dtype == "int4":
+            self._kv_slot_bytes = self.spec.num_kv_heads * (self.spec.head_dim + 4)
+        elif self.kv_dtype == "int8":
+            self._kv_slot_bytes = self.spec.num_kv_heads * (2 * self.spec.head_dim + 8)
+        else:
+            self._kv_slot_bytes = self.spec.num_kv_heads * self.spec.head_dim * 4
         self.max_model_len = config.max_model_len
         # Forced-chain fast-forward (guided/processor.py FF_CHUNK): each
         # decode step carries the sampled token plus its DFA-forced
@@ -442,6 +499,76 @@ class JaxEngine(InferenceEngine):
         self._paged_call_private: List[int] = []
         self._paged_dirty = False
         self._paged_toks_memo: Dict[str, np.ndarray] = {}
+        if self.kv_dtype == "int4" and not self.paged_kv:
+            import warnings
+
+            # The losing configuration must not be silent (same
+            # principle as the int8 sub-6B warning): the dense int4
+            # slab has no streaming kernel — every decode step
+            # dequantizes the whole packed cache, which is SLOWER than
+            # bfloat16.  The capacity win int4 exists for needs the
+            # paged pool (in-VMEM nibble unpack in the fused kernel).
+            warnings.warn(
+                "kv_cache_dtype='int4' without paged_kv: the dense "
+                "packed cache serves through the full-dequant-per-step "
+                "fallback, which is SLOWER than bfloat16 — enable "
+                "BCG_TPU_PAGED_KV=1 (the paged Pallas kernel unpacks "
+                "nibbles in VMEM) to get the capacity win without the "
+                "dequant tax",
+                stacklevel=2,
+            )
+
+        # Fused guided-sampling kernel (ops/guided_sampler.py): the
+        # whole [B, V] masked-sampler pipeline — DFA allowed-mask,
+        # EOS gate, temperature, top-p threshold scan, draw — as ONE
+        # Pallas program per row, shared by all three decode-loop
+        # families through _make_masked_sampler exactly like
+        # _resolved_loop_impl shares the attention kernel.  Env wins
+        # over the config field; "auto" = pallas where the kernel's
+        # whole-row-in-VMEM design fits (TPU, vocab under the geometry
+        # guard), xla elsewhere.  An EXPLICIT pallas off-TPU runs the
+        # kernel in interpret mode (the parity-test path); the XLA
+        # sampler (engine/speculative.make_masked_sampler) stays the
+        # conformance oracle.
+        from bcg_tpu.ops import guided_sampler as _gs
+
+        raw_fs = (
+            (_get_str0("BCG_TPU_FUSED_SAMPLER") or "").strip().lower()
+            or str(getattr(config, "fused_sampler", "auto") or "auto").lower()
+        )
+        if raw_fs not in ("auto", "xla", "pallas"):
+            raise ValueError(
+                f"fused_sampler={raw_fs!r}: expected 'auto', 'xla' or "
+                "'pallas'"
+            )
+        _on_tpu = jax.default_backend() == "tpu"
+        _vp, _ = _gs.vocab_rows(self.spec.vocab_size)
+        _vocab_ok = _vp <= _gs.MAX_VOCAB
+        resolved_fs = (
+            ("pallas" if _on_tpu and _vocab_ok else "xla")
+            if raw_fs == "auto" else raw_fs
+        )
+        if resolved_fs == "pallas" and not _vocab_ok:
+            # EXPLICIT pallas only (auto never selects a guarded
+            # geometry, so default boots cannot warn about a choice
+            # nobody made).
+            _kernel_fallback_warn(
+                "fused guided-sampling kernel", "geometry guard",
+                f"padded vocab {_vp} exceeds the whole-row-in-VMEM cap "
+                f"({_gs.MAX_VOCAB})",
+                "the sampler pipeline lowers as separate XLA ops with "
+                "[B, V] intermediates per decode step",
+            )
+            resolved_fs = "xla"
+        self.fused_sampler = resolved_fs  # "xla" | "pallas" (stats/bench)
+        # The marker loop builders key compiles on and pass to
+        # _make_masked_sampler (interpret mode off-TPU = parity tests).
+        self._sampler_loop_impl = (
+            "xla" if resolved_fs == "xla"
+            else _gs.PALLAS if _on_tpu
+            else _gs.PALLAS_INTERPRET
+        )
+        self._sampler_fused_calls = 0
 
         quant_mode = config.quantization  # None | "int8" | "int4"
         quantize = quant_mode is not None
@@ -679,6 +806,12 @@ class JaxEngine(InferenceEngine):
         # prefill/decode along the batch axis; the ring/sp shard_maps
         # already carry dp in their in_specs (ops/ring_attention.py).
         self._dp_devices = mesh.shape.get("dp", 1) if mesh is not None else 1
+        if self.kv_dtype == "int4" and self._sp_devices > 1:
+            raise ValueError(
+                "kv_cache_dtype='int4' does not compose with sequence "
+                f"parallelism (sp={self._sp_devices}): the sp ring decode "
+                "kernels dequantize unpacked int8 scales only"
+            )
         if self._sp_devices > 1:
             from bcg_tpu.models.transformer import prefill_sp
 
@@ -916,6 +1049,13 @@ class JaxEngine(InferenceEngine):
         from bcg_tpu.obs import export as obs_export
 
         obs_export.maybe_start_http_server()
+        # Sampler/KV-dtype self-description for bench JSON — published
+        # at BOOT (not just per call) so a run that dies before its
+        # first decode still reports which configuration it booted
+        # (runtime.metrics idiom, same as LAST_BOOT_PHASES).
+        from bcg_tpu.runtime import metrics as _boot_metrics
+
+        _boot_metrics.publish_sampler(self.sampler_stats())
         if _TIMING and self.boot_phases:
             import sys as _sys
 
@@ -1758,13 +1898,25 @@ class JaxEngine(InferenceEngine):
 
     # ------------------------------------------------------------ decode loop
 
-    @staticmethod
-    def _make_masked_sampler(eos_id: int, top_p: float):
+    def _make_masked_sampler(self, eos_id: int, top_p: float,
+                             impl: Optional[str] = None):
         """The guided sampler shared VERBATIM by the standard,
         fast-forward, AND speculative decode loops (the equivalence
-        guarantees between them depend on a single implementation — it
-        lives in :mod:`bcg_tpu.engine.speculative`, whose verify pass
-        also reuses its filter stage)."""
+        guarantees between them depend on a single implementation — the
+        XLA reference lives in :mod:`bcg_tpu.engine.speculative`, whose
+        verify pass also reuses its filter stage).  ONE resolution for
+        all three families, like :meth:`_resolved_loop_impl` for the
+        attention kernel: ``impl`` None reads the engine's resolved
+        ``_sampler_loop_impl``; the census's TPU cross-lowering twins
+        (:meth:`_maybe_record_sampler_tpu_lowering`) pass it explicitly
+        to build both variants of the same loop."""
+        impl = self._sampler_loop_impl if impl is None else impl
+        if impl in (_GS_PALLAS, _GS_PALLAS_INTERPRET):
+            from bcg_tpu.ops.guided_sampler import make_fused_sampler
+
+            return make_fused_sampler(
+                eos_id, top_p, interpret=(impl == _GS_PALLAS_INTERPRET)
+            )
         return _make_masked_sampler_impl(eos_id, top_p)
 
     def _note_jit_shape(self, entry: str, sig: Tuple) -> None:
@@ -1800,7 +1952,8 @@ class JaxEngine(InferenceEngine):
         # int8 cache dequantizes only its local S/sp slice in there.
         ring = (self.mesh, "sp") if self._sp_devices > 1 else None
         impl = self._resolved_loop_impl()
-        key = (guided_sig, int(max_new), float(top_p), impl)
+        key = (guided_sig, int(max_new), float(top_p), impl,
+               self._sampler_loop_impl)
         if key in self._decode_loops:
             return self._decode_loops[key]
         self._note_jit_shape("decode_loop", key)
@@ -1832,16 +1985,18 @@ class JaxEngine(InferenceEngine):
         )
 
     def _build_decode_loop(self, impl: str, max_new: int, top_p: float,
-                           ring=None):
+                           ring=None, sampler_impl: Optional[str] = None):
         """The standard decode loop as an (unmemoized) jitted callable
-        with an EXPLICIT attention impl — :meth:`_get_decode_loop` is
+        with an EXPLICIT attention impl (and, for the sampler census
+        twins, an explicit SAMPLER impl) — :meth:`_get_decode_loop` is
         the memoized resolver; the census's TPU cross-lowering twins
-        (:meth:`_maybe_record_paged_tpu_lowering`) build gather and
-        fused variants of the same program without touching the
-        executed loops' cache or compile counters."""
+        (:meth:`_maybe_record_paged_tpu_lowering` /
+        :meth:`_maybe_record_sampler_tpu_lowering`) build both variants
+        of the same program without touching the executed loops' cache
+        or compile counters."""
         spec = self.spec
         eos_id = self.tokenizer.eos_id
-        sampler = self._make_masked_sampler(eos_id, top_p)
+        sampler = self._make_masked_sampler(eos_id, top_p, impl=sampler_impl)
 
         def loop(params, cache, first_logits, valid_mask, prompt_lens, L,
                  tables, accepting, min_budget, dfa_ids, init_states,
@@ -1926,6 +2081,29 @@ class JaxEngine(InferenceEngine):
                 entry, self._build_decode_loop(impl, max_new, top_p), args,
             )
 
+    def _maybe_record_sampler_tpu_lowering(self, family: str, builder,
+                                           args: tuple) -> None:
+        """Census-only (BCG_TPU_HLO_CENSUS): pin the TPU CROSS-LOWERING
+        of one DENSE decode-loop family under both sampler impls — the
+        XLA masked sampler and the fused Pallas kernel — from this
+        call's concrete arguments, without executing either (trace +
+        lower only; Mosaic serializes the kernel to ``tpu_custom_call``
+        at lowering time, no hardware needed).  These entry pairs carry
+        the fused-sampler acceptance inequality: per-decode-step op
+        count strictly DOWN under ``fused_sampler=pallas`` for ALL
+        THREE families — the [B, V] mask/filter/draw chain collapses
+        into one step custom call (plus the paged twins' embedding/
+        write-path gathers, identical in both arms) — drift-gated both
+        directions in hlo_baseline.json.  ``builder(sampler_impl)``
+        returns the family's jitted loop; must run BEFORE the real loop
+        call (tracing reads the donated cache buffers, execution
+        consumes them)."""
+        for entry, impl in ((f"tpu_{family}", "xla"),
+                            (f"tpu_fused_{family}", _GS_PALLAS)):
+            if obs_hlo.recorded(entry):
+                continue
+            obs_hlo.record_tpu_lowering(entry, builder(impl), args)
+
     def _get_ff_decode_loop(self, guided_sig: Tuple, max_new: int,
                             top_p: float = 1.0):
         """Fast-forward decode loop: every iteration samples ONE token and
@@ -1939,22 +2117,33 @@ class JaxEngine(InferenceEngine):
         tokens, not total tokens — and a cache only ~1.5x the token
         budget for the KV-bandwidth-bound attention to stream.
         """
-        from bcg_tpu.guided.processor import FF_CHUNK as K
-
         chunk_impl = self._resolved_loop_impl(chunk=True)
         # Sequence-parallel chunk decode: the cache stays sp-sharded
         # inside the ff loop too (sp_chunk_decode_attention); an int8
         # cache dequantizes only its local S/sp slice in there.
         ring = (self.mesh, "sp") if self._sp_devices > 1 else None
-        key = ("ff", guided_sig, int(max_new), float(top_p), chunk_impl)
+        key = ("ff", guided_sig, int(max_new), float(top_p), chunk_impl,
+               self._sampler_loop_impl)
         if key in self._decode_loops:
             return self._decode_loops[key]
         self._note_jit_shape("ff_decode_loop", key)
         self._decode_ring_active = ring is not None
+        compiled = self._build_ff_decode_loop(chunk_impl, max_new, top_p, ring)
+        self._decode_loops[key] = compiled
+        return compiled
+
+    def _build_ff_decode_loop(self, chunk_impl: str, max_new: int,
+                              top_p: float, ring=None,
+                              sampler_impl: Optional[str] = None):
+        """The fast-forward loop as an (unmemoized) jitted callable —
+        split from :meth:`_get_ff_decode_loop` for the same reason the
+        plain loop's builder is: the sampler census twins build both
+        sampler variants of the identical program."""
+        from bcg_tpu.guided.processor import FF_CHUNK as K
 
         spec = self.spec
         eos_id = self.tokenizer.eos_id
-        sampler = self._make_masked_sampler(eos_id, top_p)
+        sampler = self._make_masked_sampler(eos_id, top_p, impl=sampler_impl)
 
         def loop(params, cache, first_logits, valid_mask, prompt_lens, L,
                  tables, accepting, min_budget, dfa_ids, init_states,
@@ -2043,9 +2232,7 @@ class JaxEngine(InferenceEngine):
             # Returned for donation aliasing — see the standard loop.
             return out, (rng, i), cache
 
-        compiled = jax.jit(loop, static_argnames=("L",), donate_argnums=(1,))
-        self._decode_loops[key] = compiled
-        return compiled
+        return jax.jit(loop, static_argnames=("L",), donate_argnums=(1,))
 
     def _get_spec_decode_loop(self, guided_sig: Tuple, max_new: int,
                               top_p: float = 1.0):
@@ -2061,18 +2248,32 @@ class JaxEngine(InferenceEngine):
         chunk_impl = self._resolved_loop_impl(chunk=True)
         ring = (self.mesh, "sp") if self._sp_devices > 1 else None
         key = ("spec", guided_sig, int(max_new), float(top_p),
-               self.spec_k, self.spec_ngram, chunk_impl)
+               self.spec_k, self.spec_ngram, chunk_impl,
+               self._sampler_loop_impl)
         if key in self._decode_loops:
             return self._decode_loops[key]
         self._note_jit_shape("spec_decode_loop", key)
         self._decode_ring_active = ring is not None
-        loop = build_spec_loop(
-            self.spec, chunk_impl, ring, self.tokenizer.eos_id, top_p,
-            int(max_new), self.spec_k, self.spec_ngram,
-        )
-        compiled = jax.jit(loop, static_argnames=("L",), donate_argnums=(1,))
+        compiled = self._build_spec_decode_loop(chunk_impl, max_new, top_p,
+                                                ring)
         self._decode_loops[key] = compiled
         return compiled
+
+    def _build_spec_decode_loop(self, chunk_impl: str, max_new: int,
+                                top_p: float, ring=None,
+                                sampler_impl: Optional[str] = None):
+        """The speculative loop as an (unmemoized) jitted callable — the
+        per-iteration sampler is the engine-resolved (or census-twin)
+        impl; the verify pass's filter stage stays the XLA form inside
+        ``build_spec_loop`` (see its docstring)."""
+        eos_id = self.tokenizer.eos_id
+        loop = build_spec_loop(
+            self.spec, chunk_impl, ring, eos_id, top_p,
+            int(max_new), self.spec_k, self.spec_ngram,
+            sampler=self._make_masked_sampler(eos_id, top_p,
+                                              impl=sampler_impl),
+        )
+        return jax.jit(loop, static_argnames=("L",), donate_argnums=(1,))
 
     def _run_guided(
         self,
@@ -2421,6 +2622,13 @@ class JaxEngine(InferenceEngine):
                 from bcg_tpu.runtime import metrics as _metrics
 
                 _metrics.publish_kv_pool(self.kv_pool_stats())
+            # Sampler self-description (impl, interpret, fused-kernel
+            # invocation count) — published per call like kv_pool so
+            # the bench ERROR path keeps the forensics of completed
+            # calls.
+            from bcg_tpu.runtime import metrics as _metrics2
+
+            _metrics2.publish_sampler(self.sampler_stats())
             obs_ledger.credit("kv_cache", id(self))
             obs_ledger.credit("spec_slots", id(self))
             if self._mem_limit is not None:
@@ -2605,7 +2813,11 @@ class JaxEngine(InferenceEngine):
         # programs (block gather/scatter), so they pin under their own
         # names instead of drifting the dense entries — and the fused
         # Pallas loops under theirs, so the census can assert the
-        # kernel's step counts BELOW the gather baseline.
+        # kernel's step counts BELOW the gather baseline.  A fused-
+        # sampler engine likewise tags its EXECUTED loops "fused_" (on
+        # CPU that is the interpret-mode emulation — the hardware claim
+        # is carried by the tpu_fused_* cross-lowering twins below), so
+        # the dense xla-sampler baseline entries never drift.
         if paged:
             census_prefix = (
                 "paged_" if self._paged_loop_impl == "xla"
@@ -2613,10 +2825,13 @@ class JaxEngine(InferenceEngine):
             )
         else:
             census_prefix = ""
+        if self._sampler_loop_impl != "xla":
+            census_prefix += "fused_"
         if paged:
             self._paged_dirty = True  # pool rides the donated loop call
         with obs_tracer.span("engine.decode",
                              args={"rows": B, "max_new": max_new}):
+            ring = (self.mesh, "sp") if self._sp_devices > 1 else None
             if use_spec:
                 loop = obs_hlo.wrap(
                     census_prefix + "spec_decode_loop",
@@ -2624,30 +2839,46 @@ class JaxEngine(InferenceEngine):
                         sig_prefix + (B, L), max_new, top_p
                     ),
                 )
+                loop_args = (
+                    self.params, cache, first_logits,
+                    self._put_batch(valid_mask),
+                    self._put_batch(prompt_lens), L,
+                    batch.tables, batch.accepting, batch.min_budget,
+                    self._put_batch(batch.dfa_ids),
+                    self._put_batch(batch.init_states),
+                    batch.chain_tok, batch.chain_len,
+                    self._put_batch(hist),
+                    self._put_batch(np.asarray(temps, np.float32)),
+                    self._put_batch(np.asarray(budgets, np.int32)),
+                    sub,
+                )
+                if not paged and obs_hlo.enabled():
+                    # Sampler census twins (xla vs fused sampler, same
+                    # program otherwise), lowering-only from the same
+                    # concrete args; must precede the call — it
+                    # consumes the donated cache.
+                    self._maybe_record_sampler_tpu_lowering(
+                        "spec_decode_loop",
+                        lambda si: self._build_spec_decode_loop(
+                            self._resolved_loop_impl(chunk=True), max_new,
+                            top_p, ring, sampler_impl=si,
+                        ),
+                        loop_args,
+                    )
                 with obs_tracer.span(
                     "engine.spec_verify",
                     args={"rows": B, "k": self.spec_k,
                           "ngram": self.spec_ngram},
                 ):
                     out, (_, steps), (drafted, accepted), _cache_out = loop(
-                        self.params, cache, first_logits,
-                        self._put_batch(valid_mask),
-                        self._put_batch(prompt_lens), L,
-                        batch.tables, batch.accepting, batch.min_budget,
-                        self._put_batch(batch.dfa_ids),
-                        self._put_batch(batch.init_states),
-                        batch.chain_tok, batch.chain_len,
-                        self._put_batch(hist),
-                        self._put_batch(np.asarray(temps, np.float32)),
-                        self._put_batch(np.asarray(budgets, np.int32)),
-                        sub,
+                        *loop_args
                     )
             elif use_ff:
                 loop = obs_hlo.wrap(
                     census_prefix + "ff_decode_loop",
                     self._get_ff_decode_loop(sig_prefix + (B, L), max_new, top_p),
                 )
-                out, (_, steps), _cache_out = loop(
+                loop_args = (
                     self.params, cache, first_logits,
                     self._put_batch(valid_mask),
                     self._put_batch(prompt_lens), L,
@@ -2659,6 +2890,16 @@ class JaxEngine(InferenceEngine):
                     self._put_batch(np.asarray(budgets, np.int32)),
                     sub,
                 )
+                if not paged and obs_hlo.enabled():
+                    self._maybe_record_sampler_tpu_lowering(
+                        "ff_decode_loop",
+                        lambda si: self._build_ff_decode_loop(
+                            self._resolved_loop_impl(chunk=True), max_new,
+                            top_p, ring, sampler_impl=si,
+                        ),
+                        loop_args,
+                    )
+                out, (_, steps), _cache_out = loop(*loop_args)
             else:
                 loop = obs_hlo.wrap(
                     census_prefix + "decode_loop",
@@ -2682,6 +2923,15 @@ class JaxEngine(InferenceEngine):
                     self._maybe_record_paged_tpu_lowering(
                         max_new, top_p, loop_args
                     )
+                elif obs_hlo.enabled():
+                    self._maybe_record_sampler_tpu_lowering(
+                        "decode_loop",
+                        lambda si: self._build_decode_loop(
+                            self._resolved_loop_impl(), max_new, top_p,
+                            ring, sampler_impl=si,
+                        ),
+                        loop_args,
+                    )
                 out, (_, steps), _cache_out = loop(*loop_args)
             if paged:
                 # The loop wrote decode KV into private pool blocks
@@ -2702,6 +2952,13 @@ class JaxEngine(InferenceEngine):
         # one weight pass — the wall-clock unit of the decode phase).
         self.last_decode_steps = int(steps)
         self.total_decode_steps += int(steps)
+        if self._sampler_loop_impl != "xla":
+            # Fused-kernel invocations: one sampler program per loop
+            # iteration.  Keys created only when the kernel actually
+            # ran, so an xla-sampler engine's counter namespace stays
+            # byte-identical to HEAD's.
+            self._sampler_fused_calls += int(steps)
+            obs_counters.inc("engine.sampler.fused_calls", int(steps))
         if use_spec:
             # Draft acceptance over REAL rows only (padding rows repeat
             # row 0 and would inflate the rate).  Counted even when 0 —
@@ -3147,6 +3404,11 @@ class JaxEngine(InferenceEngine):
 
         stats = self._paged.stats()
         stats["impl"] = self.paged_kv_impl
+        # Packed-bytes honesty: block_bytes_dev (and every *_bytes field
+        # derived from it) already reads the POOL'S actual leaves, so an
+        # int4 pool reports half an int8 pool's bytes without special
+        # casing — the dtype rides along so consumers can tell why.
+        stats["kv_dtype"] = self.kv_dtype
         stats["interpret"] = self._paged_loop_impl == PALLAS_INTERPRET
         # The CONFIGURED group size — each kernel call clamps it to its
         # table width at trace time (ops/paged_attention).
@@ -3160,6 +3422,21 @@ class JaxEngine(InferenceEngine):
         # forensics this field exists for.
         stats["scratch_reserve_blocks"] = self._paged_scratch_reserve()
         return stats
+
+    def sampler_stats(self) -> Dict[str, Any]:
+        """Guided-sampler self-description (the bench JSON ``sampler``
+        block): the resolved impl, whether the kernel runs in interpret
+        mode (explicit pallas off-TPU — the parity-test path), the
+        instance's fused-kernel invocation count (one program per decode
+        iteration; 0 on the xla path), and the resolved KV dtype riding
+        along so hardware A/B runs of BOTH ISSUE-10 features are
+        self-describing from one snapshot."""
+        return {
+            "impl": self.fused_sampler,
+            "interpret": self._sampler_loop_impl == _GS_PALLAS_INTERPRET,
+            "fused_calls": self._sampler_fused_calls,
+            "kv_dtype": self.kv_dtype,
+        }
 
     def shutdown(self) -> None:
         self.params = None

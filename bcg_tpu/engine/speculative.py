@@ -318,7 +318,7 @@ def accept_draft(
 # ------------------------------------------------------------- spec loop
 def build_spec_loop(
     model_spec, chunk_impl: str, ring, eos_id: int, top_p: float,
-    max_new: int, k: int, n: int,
+    max_new: int, k: int, n: int, sampler=None,
 ):
     """Build the (unjitted) speculative decode loop body for
     ``JaxEngine._get_spec_decode_loop`` — same calling convention as the
@@ -328,11 +328,20 @@ def build_spec_loop(
     ``(out, (rng, iters), (drafted, accepted), cache)`` — the cache is
     returned ONLY so the donated input can alias the loop carry (see the
     standard loop), per-row drafted/accepted counts feed the
-    ``engine.spec.*`` counters."""
+    ``engine.spec.*`` counters.
+
+    ``sampler`` overrides the per-iteration guided sampler (the
+    engine-resolved fused Pallas kernel, ops/guided_sampler.py —
+    identical closure signature); None = the XLA reference here.  The
+    VERIFY pass's filter stage (``masked_logits`` inside
+    ``accept_draft``) always stays the XLA form: it scores K draft rows
+    per real row, a [B*K, V] shape the per-row kernel was not built
+    for, and it never draws."""
     from bcg_tpu.models.transformer import decode_chunk_spec
 
     masked_logits = make_masked_logits(eos_id, top_p)
-    sampler = make_masked_sampler(eos_id, top_p)
+    if sampler is None:
+        sampler = make_masked_sampler(eos_id, top_p)
     K1 = k + 1
 
     def loop(params, cache, first_logits, valid_mask, prompt_lens, L,

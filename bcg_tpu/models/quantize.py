@@ -153,6 +153,70 @@ def dequantize_int4(w: QuantizedDense) -> jax.Array:
     return scaled.reshape(q.shape).astype(jnp.bfloat16)
 
 
+# ------------------------------------------------------------- int4 KV cache
+# Packed-int4 KV entries reuse the int8 cache's axes ([.., Hkv, S, Dh]
+# storage with [.., Hkv, S] scales) with the head dim PACKED two values
+# per byte and the scales bf16: the nibble split mirrors the weight
+# contract above — dims [0, Dh/2) in the low nibble, [Dh/2, Dh) in the
+# high nibble of byte [.., d] — so the paged Pallas kernel never
+# interleaves nibbles either: it dots each query half against its
+# nibble's dequantized half (contraction over Dh splits cleanly).
+# Scales are bf16 (not the int8 arm's f32) for the same reason gscale
+# is: the scale overhead is what separates a 1.67x capacity win from
+# the 2x the packing actually buys at small head dims, and quantizing
+# against the bf16-ROUNDED scale keeps the half-step error bound exact.
+
+
+def kv_int4_layout(head_dim: int):
+    """(storage head dim, scale dtype) of the packed-int4 KV layout —
+    the ONE definition every allocator (dense slab, paged pool) and the
+    engine's boot check derive from, so the packing contract and the
+    scale-dtype layout marker cannot drift apart across sites."""
+    if head_dim % 2:
+        raise ValueError(
+            f"int4 KV packing needs an even head dim, got {head_dim}"
+        )
+    return head_dim // 2, jnp.bfloat16
+
+
+def quantize_kv_int4(x):
+    """bf16/f32 ``[..., Dh]`` -> (packed int8 ``[..., Dh//2]``, bf16
+    per-(position, head) absmax scale ``[...]``).  Symmetric absmax
+    over the head dim (the LAST axis — packing is last-axis only),
+    range [-8, 7]."""
+    half, scale_dtype = kv_int4_layout(x.shape[-1])
+    x32 = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x32), axis=-1, keepdims=True)
+    scale = jnp.where(absmax == 0.0, 1.0, absmax / 7.0)
+    # Quantize against the bf16-ROUNDED scale (what dequant will read).
+    scale = scale.astype(scale_dtype).astype(jnp.float32)
+    q = jnp.clip(jnp.round(x32 / scale), -8, 7).astype(jnp.int8)
+    packed = jnp.bitwise_or(
+        jnp.bitwise_and(q[..., :half], jnp.int8(0x0F)),
+        jnp.left_shift(q[..., half:], 4),
+    ).astype(jnp.int8)
+    return packed, scale.squeeze(-1).astype(scale_dtype)
+
+
+def unpack_kv_int4(packed: jax.Array) -> jax.Array:
+    """Packed ``[..., Dh//2]`` int8 -> ``[..., Dh]`` int8 in [-8, 7]
+    (low nibbles = first half of the head dim; arithmetic right shift
+    sign-extends, exactly like :func:`unpack_int4`)."""
+    low = jnp.right_shift(jnp.left_shift(packed, 4), 4)
+    high = jnp.right_shift(packed, 4)
+    return jnp.concatenate([low, high], axis=-1)
+
+
+def dequantize_kv_int4(packed: jax.Array, scale: jax.Array):
+    """Materialize f32 KV from a packed entry slice (XLA fallback path
+    and test oracle; the paged Pallas kernel dequantizes per page in
+    VMEM without ever forming the unpacked array).  Last-axis only,
+    like the quantizer."""
+    return unpack_kv_int4(packed).astype(jnp.float32) * jnp.expand_dims(
+        scale.astype(jnp.float32), -1
+    )
+
+
 def is_quantized(w: DenseWeight) -> bool:
     return isinstance(w, dict)
 

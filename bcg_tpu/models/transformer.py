@@ -339,8 +339,47 @@ def attention(q, k, v, mask, scale, impl: str = "xla"):
 
 # ------------------------------------------------------------------ forward
 
+def kv_is_int4(entry: Dict) -> bool:
+    """True for a packed-int4 KV entry.  The marker is the SCALE dtype —
+    int4 scales are bf16 where the int8 arm's are f32 (see
+    quantize.quantize_kv_int4) — so every layout this repo stores KV in
+    (dense slab, paged pool, gathered dense view, per-entry prefix KV)
+    carries its own dtype without the caller needing the model head dim
+    to disambiguate the packed storage shape."""
+    return "k_scale" in entry and entry["k_scale"].dtype == jnp.bfloat16
+
+
+def _kv_quantizer(entry: Dict):
+    """The fresh-KV quantizer a quantized entry needs, so every write
+    path (dense scalar, dense per-row, paged) shares one dispatch that
+    cannot drift from the allocation.  Both quantizers share the
+    ``[B, T, Hkv, Dh] -> (storage values, [B, T, Hkv] scales)``
+    signature; only the storage head dim (packed Dh/2 vs Dh) differs."""
+    if kv_is_int4(entry):
+        from bcg_tpu.models.quantize import quantize_kv_int4
+
+        return quantize_kv_int4
+    from bcg_tpu.ops.decode_attention import quantize_kv
+
+    return quantize_kv
+
+
+def _kv_dequantizer(entry: Dict):
+    """The matching ``(values, scale) -> f32`` dequantizer (the XLA
+    fallback / gather paths; kernels dequantize in VMEM)."""
+    if kv_is_int4(entry):
+        from bcg_tpu.models.quantize import dequantize_kv_int4
+
+        return dequantize_kv_int4
+    from bcg_tpu.ops.decode_attention import dequantize_kv
+
+    return dequantize_kv
+
+
 def _write_cache(entry: Dict, k, v, pos) -> Dict:
-    """Write fresh k/v into the cache entry (quantizing if it is int8).
+    """Write fresh k/v into the cache entry (quantizing if it is int8
+    or packed int4 — the entry's scale dtype selects, see
+    :func:`_kv_quantizer`).
 
     ``pos`` is either a scalar (one shared cache slot for the whole
     batch — prefill chunks, the standard/fast-forward decode loops) or a
@@ -366,9 +405,8 @@ def _write_cache(entry: Dict, k, v, pos) -> Dict:
         return _write_cache_rows(entry, k, v, pos)
     new = dict(entry)
     if "k_scale" in entry:
-        from bcg_tpu.ops.decode_attention import quantize_kv
-
-        kq, ksc = quantize_kv(k)   # kq: [B, T, Hkv, Dh]; ksc: [B, T, Hkv]
+        quantize_kv = _kv_quantizer(entry)
+        kq, ksc = quantize_kv(k)   # kq: [B, T, Hkv, Dh(/2)]; ksc: [B, T, Hkv]
         vq, vsc = quantize_kv(v)
         new["k"] = jax.lax.dynamic_update_slice(
             entry["k"], kq.transpose(0, 2, 1, 3), (0, 0, pos, 0))
@@ -394,9 +432,8 @@ def _write_cache_rows(entry: Dict, k, v, row_pos) -> Dict:
     bidx = jnp.arange(B)[:, None]                       # [B, 1]
     sidx = row_pos[:, None] + jnp.arange(T)[None, :]    # [B, T]
     if "k_scale" in entry:
-        from bcg_tpu.ops.decode_attention import quantize_kv
-
-        kq, ksc = quantize_kv(k)   # kq: [B, T, Hkv, Dh]; ksc: [B, T, Hkv]
+        quantize_kv = _kv_quantizer(entry)
+        kq, ksc = quantize_kv(k)   # kq: [B, T, Hkv, Dh(/2)]; ksc: [B, T, Hkv]
         vq, vsc = quantize_kv(v)
         # Storage [B, Hkv, S, Dh] / scales [B, Hkv, S]: advanced indices
         # on axes (0, 2) move to the front, so the target region is
@@ -429,7 +466,11 @@ def _cache_attention(q, entry: Dict, mask, scale, impl: str):
         return paged_decode_attention(q, entry, mask, scale, impl=impl)
     quantized = "k_scale" in entry
     Dh = q.shape[-1]
-    if impl == "pallas" and jax.default_backend() == "tpu" and Dh % 128 == 0:
+    # The dense Pallas decode kernel streams int8 storage only — the
+    # packed-int4 slab takes the dequant fallback (the engine never
+    # resolves "pallas" for an int4 dense cache; belt and suspenders).
+    if impl == "pallas" and jax.default_backend() == "tpu" \
+            and Dh % 128 == 0 and not kv_is_int4(entry):
         from bcg_tpu.ops.decode_attention import decode_attention
 
         return decode_attention(
@@ -438,11 +479,11 @@ def _cache_attention(q, entry: Dict, mask, scale, impl: str):
         )[:, None]
     k, v = entry["k"], entry["v"]
     if quantized:
-        from bcg_tpu.ops.decode_attention import dequantize_kv
+        dequantize_kv = _kv_dequantizer(entry)
 
-        # Quantized cache layout is [B, Hkv, S, Dh] with scales
-        # [B, Hkv, S]; the (slow-path) full dequant transposes back to
-        # the attention layout [B, S, Hkv, Dh].
+        # Quantized cache layout is [B, Hkv, S, Dh(/2 packed)] with
+        # scales [B, Hkv, S]; the (slow-path) full dequant transposes
+        # back to the attention layout [B, S, Hkv, Dh].
         k = dequantize_kv(k, entry["k_scale"]).transpose(0, 2, 1, 3).astype(q.dtype)
         v = dequantize_kv(v, entry["v_scale"]).transpose(0, 2, 1, 3).astype(q.dtype)
     return _xla_attention(q, k, v, mask[:, None, :], scale)
@@ -472,7 +513,7 @@ def _dequant_slice(entry: Dict, name: str, upto: int, dtype) -> jax.Array:
     scale_name = f"{name}_scale"
     if scale_name not in entry:
         return entry[name][:, :upto].astype(dtype)
-    from bcg_tpu.ops.decode_attention import dequantize_kv
+    dequantize_kv = _kv_dequantizer(entry)
 
     # astype BEFORE the transpose: the transpose is the materialization
     # point, and a bf16 buffer halves its traffic vs transposing in f32.
@@ -557,6 +598,11 @@ def _block(
         # active.
         from bcg_tpu.ops.ring_attention import sp_decode_attention
 
+        assert not kv_is_int4(new_entry), (
+            "int4 KV does not compose with sp-sharded decode (the ring "
+            "kernels dequantize int8 scales) — the engine rejects the "
+            "pairing at boot"
+        )
         mesh, axis_name = ring
         attn_out = sp_decode_attention(
             q[:, 0], new_entry["k"], new_entry["v"], attn_mask, mesh,
@@ -661,27 +707,40 @@ def _logits(params: TransformerParams, spec: ModelSpec, x: jax.Array) -> jax.Arr
 
 def init_kv_cache(
     spec: ModelSpec, batch: int, max_len: int, dtype=jnp.bfloat16,
-    quantized: bool = False, stacked: bool = False,
+    quantized=False, stacked: bool = False,
 ):
     """Per-layer list of {k, v[, k_scale, v_scale]} leaves, or — with
     ``stacked`` — ONE dict whose leaves carry a leading [num_layers] dim
     (the scan-over-layers cache; must match ``stack_layer_params``).
 
-    k/v are [B, S, Hkv, Dh]; with ``quantized`` they are int8 stored
-    [B, Hkv, S, Dh] — int8 tiles as (32, 128) over the last two dims, so
-    an S x Dh kernel block is Mosaic-native (the bf16 axis order would
-    hand it (1, 128)-row int8 blocks) — with f32 per-(position, kv-head)
-    absmax scales stored [B, Hkv, S] (S minor, lane-aligned).  Halves the
-    HBM traffic of the bandwidth-bound decode step; the kernels
-    dequantize in VMEM (see ops/decode_attention.py).
+    k/v are [B, S, Hkv, Dh]; with ``quantized`` (True or ``"int8"``)
+    they are int8 stored [B, Hkv, S, Dh] — int8 tiles as (32, 128) over
+    the last two dims, so an S x Dh kernel block is Mosaic-native (the
+    bf16 axis order would hand it (1, 128)-row int8 blocks) — with f32
+    per-(position, kv-head) absmax scales stored [B, Hkv, S] (S minor,
+    lane-aligned).  Halves the HBM traffic of the bandwidth-bound decode
+    step; the kernels dequantize in VMEM (see ops/decode_attention.py).
+
+    ``quantized="int4"`` packs the head dim two values per byte on the
+    same axes ([B, Hkv, S, Dh/2] storage) with BF16 scales — the scale
+    dtype is the layout marker (:func:`kv_is_int4`) — halving KV bytes
+    again vs int8: the capacity knob that roughly doubles admissible
+    batch at a fixed HBM budget (see models/quantize.py's int4-KV
+    contract).
 
     The list form keeps separate pytree leaves so the
     ``dynamic_update_slice`` in each decode step is a pure per-buffer
     update XLA can alias in-place inside ``lax.while_loop``.  The stacked
     form trades some of that aliasing freedom (scan's ys re-stack the
     entries) for an O(1)-in-depth program — the 8B compile unblocking."""
+    if quantized == "int4":
+        from bcg_tpu.models.quantize import kv_int4_layout
+
+        dh_store, scale_dtype = kv_int4_layout(spec.head_dim)
+    else:
+        dh_store, scale_dtype = spec.head_dim, jnp.float32
     shape = (batch, max_len, spec.num_kv_heads, spec.head_dim)
-    qshape = (batch, spec.num_kv_heads, max_len, spec.head_dim)
+    qshape = (batch, spec.num_kv_heads, max_len, dh_store)
     scale_shape = (batch, spec.num_kv_heads, max_len)
 
     def entry(lead=()):
@@ -689,8 +748,8 @@ def init_kv_cache(
             return {
                 "k": jnp.zeros(lead + qshape, jnp.int8),
                 "v": jnp.zeros(lead + qshape, jnp.int8),
-                "k_scale": jnp.ones(lead + scale_shape, jnp.float32),
-                "v_scale": jnp.ones(lead + scale_shape, jnp.float32),
+                "k_scale": jnp.ones(lead + scale_shape, scale_dtype),
+                "v_scale": jnp.ones(lead + scale_shape, scale_dtype),
             }
         return {
             "k": jnp.zeros(lead + shape, dtype),
@@ -1162,6 +1221,11 @@ def _block_chunk(
         # sharding.  An int8 cache dequantizes its local slice only.
         from bcg_tpu.ops.ring_attention import sp_chunk_decode_attention
 
+        assert not kv_is_int4(new_entry), (
+            "int4 KV does not compose with sp-sharded decode (the ring "
+            "kernels dequantize int8 scales) — the engine rejects the "
+            "pairing at boot"
+        )
         mesh, axis_name = ring
         attn_out = sp_chunk_decode_attention(
             q, new_entry["k"], new_entry["v"], attn_mask, mesh,
@@ -1170,10 +1234,11 @@ def _block_chunk(
             v_scale=new_entry.get("v_scale"),
         )
     elif quantized and impl == "pallas" and jax.default_backend() == "tpu" \
-            and spec.head_dim % 128 == 0:
+            and spec.head_dim % 128 == 0 and not kv_is_int4(new_entry):
         # int8 cache: stream once, dequantize in VMEM (K*group query rows
         # per program — the prefill flash kernel would pad K chunk rows
-        # to a 128-row block).
+        # to a 128-row block).  The packed-int4 slab takes the dequant
+        # fallback below (the engine never resolves "pallas" for it).
         from bcg_tpu.ops.decode_attention import chunk_decode_attention
 
         attn_out = chunk_decode_attention(
@@ -1183,10 +1248,10 @@ def _block_chunk(
     else:
         ck, cv = new_entry["k"], new_entry["v"]
         if quantized:
-            from bcg_tpu.ops.decode_attention import dequantize_kv
+            dequantize_kv = _kv_dequantizer(new_entry)
 
             # Slow fallback (off-TPU / unaligned head dim): full dequant
-            # out of the [B, Hkv, S, Dh] storage layout.
+            # out of the [B, Hkv, S, Dh(/2 packed)] storage layout.
             ck = dequantize_kv(
                 ck, new_entry["k_scale"]).transpose(0, 2, 1, 3).astype(q.dtype)
             cv = dequantize_kv(

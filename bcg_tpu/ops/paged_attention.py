@@ -19,6 +19,10 @@ Layouts mirror the dense cache exactly, with the batch/sequence pair
 * bf16: ``k``/``v`` ``[N, bs, Hkv, Dh]`` (dense: ``[B, S, Hkv, Dh]``)
 * int8: ``k``/``v`` ``[N, Hkv, bs, Dh]`` with f32 scales
   ``[N, Hkv, bs]`` (dense: ``[B, Hkv, S, Dh]`` / ``[B, Hkv, S]``)
+* int4: ``k``/``v`` ``[N, Hkv, bs, Dh/2]`` packed two-per-byte with
+  BF16 scales ``[N, Hkv, bs]`` — the scale dtype is the layout marker
+  (``transformer.kv_is_int4``); the fused kernel unpacks nibbles in
+  VMEM (capacity knob: half the int8 pool's bytes per block)
 
 A paged cache ENTRY is the pool plus the traced block table:
 ``{"k", "v"[, "k_scale", "v_scale"], "tbl": [B, nblk] int32}`` — the
@@ -88,16 +92,27 @@ def block_size(entry: Dict) -> int:
 
 
 def init_block_pool(
-    spec, num_blocks: int, block_size: int, quantized: bool = False,
+    spec, num_blocks: int, block_size: int, quantized=False,
     stacked: bool = False,
 ):
     """Preallocated per-layer block pool (no tables yet): the paged
     counterpart of ``transformer.init_kv_cache``.  Returns a per-layer
     list of entry dicts, or — ``stacked`` — one dict whose leaves carry
     a leading ``[num_layers]`` dim (scan-over-layers form).  Block 0 is
-    the null block by convention (reserved by the allocator)."""
+    the null block by convention (reserved by the allocator).
+
+    ``quantized`` is False, True/``"int8"``, or ``"int4"`` — int4 packs
+    the head dim two nibbles per byte on the int8 axes
+    (``[N, Hkv, bs, Dh/2]``) with BF16 scales, the scale-dtype marker
+    ``transformer.kv_is_int4`` keys every downstream dispatch on."""
+    if quantized == "int4":
+        from bcg_tpu.models.quantize import kv_int4_layout
+
+        dh_store, scale_dtype = kv_int4_layout(spec.head_dim)
+    else:
+        dh_store, scale_dtype = spec.head_dim, jnp.float32
     shape = (num_blocks, block_size, spec.num_kv_heads, spec.head_dim)
-    qshape = (num_blocks, spec.num_kv_heads, block_size, spec.head_dim)
+    qshape = (num_blocks, spec.num_kv_heads, block_size, dh_store)
     scale_shape = (num_blocks, spec.num_kv_heads, block_size)
 
     def entry(lead=()):
@@ -105,8 +120,8 @@ def init_block_pool(
             return {
                 "k": jnp.zeros(lead + qshape, jnp.int8),
                 "v": jnp.zeros(lead + qshape, jnp.int8),
-                "k_scale": jnp.ones(lead + scale_shape, jnp.float32),
-                "v_scale": jnp.ones(lead + scale_shape, jnp.float32),
+                "k_scale": jnp.ones(lead + scale_shape, scale_dtype),
+                "v_scale": jnp.ones(lead + scale_shape, scale_dtype),
             }
         return {
             "k": jnp.zeros(lead + shape, jnp.bfloat16),
@@ -142,9 +157,10 @@ def paged_write(entry: Dict, k, v, pos) -> Dict:
     off = p % bs                                           # [B, T]
     new = dict(entry)
     if "k_scale" in entry:
-        from bcg_tpu.ops.decode_attention import quantize_kv
+        from bcg_tpu.models.transformer import _kv_quantizer
 
-        kq, ksc = quantize_kv(k)   # kq: [B, T, Hkv, Dh]; ksc: [B, T, Hkv]
+        quantize_kv = _kv_quantizer(entry)
+        kq, ksc = quantize_kv(k)   # kq: [B, T, Hkv, Dh(/2)]; ksc: [B, T, Hkv]
         vq, vsc = quantize_kv(v)
         # Pool [N, Hkv, bs, Dh] / scales [N, Hkv, bs]: advanced indices
         # on axes (0, 2) move to the front, so the target region is
@@ -232,12 +248,12 @@ def paged_decode_attention(q, entry: Dict, mask, scale, impl: str = "xla"):
         if g2 != group:
             out = out[:, :, :group]
         return out.reshape(B, H, Dh)[:, None]
-    from bcg_tpu.models.transformer import _xla_attention
-    from bcg_tpu.ops.decode_attention import dequantize_kv
+    from bcg_tpu.models.transformer import _kv_dequantizer, _xla_attention
 
     dense = paged_gather_entry(entry)
     k, v = dense["k"], dense["v"]
     if "k_scale" in dense:
+        dequantize_kv = _kv_dequantizer(dense)
         k = dequantize_kv(k, dense["k_scale"]).transpose(0, 2, 1, 3).astype(q.dtype)
         v = dequantize_kv(v, dense["v_scale"]).transpose(0, 2, 1, 3).astype(q.dtype)
     return _xla_attention(q, k, v, mask[:, None, :], scale)
@@ -281,12 +297,12 @@ def paged_chunk_attention(q, entry: Dict, mask, scale, impl: str = "xla"):
         if g2 != group:
             out = out[:, :, :, :group]
         return out.transpose(0, 2, 1, 3, 4).reshape(B, K, H, Dh)
-    from bcg_tpu.models.transformer import attention
-    from bcg_tpu.ops.decode_attention import dequantize_kv
+    from bcg_tpu.models.transformer import _kv_dequantizer, attention
 
     dense = paged_gather_entry(entry)
     ck, cv = dense["k"], dense["v"]
     if "k_scale" in dense:
+        dequantize_kv = _kv_dequantizer(dense)
         ck = dequantize_kv(
             ck, dense["k_scale"]).transpose(0, 2, 1, 3).astype(q.dtype)
         cv = dequantize_kv(
@@ -323,7 +339,7 @@ def pages_per_program(nblk: int, interpret: bool) -> int:
 
 
 def _paged_kernel(
-    tbl_ref, q_ref, *refs, scale, num_pg, hkv, ppp, bs, quantized,
+    tbl_ref, q_ref, *refs, scale, num_pg, hkv, ppp, bs, quantized, int4,
 ):
     """One program of the fused paged-attention kernel: grid
     ``(B, nblk/ppp)``, all kv heads per program.  ``refs`` carries, in
@@ -358,7 +374,25 @@ def _paged_kernel(
         mjf = mj.astype(jnp.float32)
         for h in range(hkv):
             q = q_ref[0, h]                  # [rows, Dh]
-            if quantized:
+            if int4:
+                # Packed-int4 page [Hkv, bs, Dh/2]: unpack both nibbles
+                # in VMEM (int32 shifts — int8 shift lowering is spotty
+                # across Mosaic versions, the ops/w4_matmul.py lesson)
+                # and rebuild the head dim low-half-first, exactly the
+                # quantize_kv_int4 packing contract.  bf16 scales.
+                kp = k_refs[j][0, h].astype(jnp.int32)      # [bs, Dh/2]
+                vp = v_refs[j][0, h].astype(jnp.int32)
+                k_lo = jnp.right_shift(jnp.left_shift(kp, 28), 28)
+                v_lo = jnp.right_shift(jnp.left_shift(vp, 28), 28)
+                k_un = jnp.concatenate(
+                    [k_lo, jnp.right_shift(kp, 4)], axis=-1
+                ).astype(jnp.float32)                       # [bs, Dh]
+                v_un = jnp.concatenate(
+                    [v_lo, jnp.right_shift(vp, 4)], axis=-1
+                ).astype(jnp.float32)
+                k = k_un * ks_refs[j][0, h].astype(jnp.float32)[:, None]
+                v = v_un * vs_refs[j][0, h].astype(jnp.float32)[:, None]
+            elif quantized:
                 # int8 page [Hkv, bs, Dh]: leading-dim head slice is a
                 # Mosaic-native (bs, Dh) int8 tile; dequant in VMEM.
                 k = k_refs[j][0, h].astype(jnp.float32) * ks_refs[j][0, h][:, None]
@@ -410,6 +444,10 @@ def _paged_pallas_attention(qg, entry: Dict, mp, scale, interpret: bool):
     key compiles — the same contract as the gather path)."""
     tbl = entry["tbl"]
     quantized = "k_scale" in entry
+    from bcg_tpu.models.transformer import kv_is_int4
+
+    int4 = kv_is_int4(entry)
+    dh_store = entry["k"].shape[-1]         # Dh, or Dh/2 packed int4
     bs = block_size(entry)
     B, nblk = tbl.shape
     _, Hkv, rows, Dh = qg.shape
@@ -430,8 +468,8 @@ def _paged_pallas_attention(qg, entry: Dict, mp, scale, interpret: bool):
         return lambda b, i, t: (t[b, i * ppp + j], 0, 0)
 
     if quantized:
-        kv_shape = (1, Hkv, bs, Dh)                  # int8 [N, Hkv, bs, Dh]
-        sc_shape = (1, Hkv, bs)                      # f32 [N, Hkv, bs]
+        kv_shape = (1, Hkv, bs, dh_store)            # int8/int4 [N, Hkv, bs, *]
+        sc_shape = (1, Hkv, bs)                      # f32/bf16 [N, Hkv, bs]
         page_specs = (
             [pl.BlockSpec(kv_shape, kv_im(j)) for j in range(ppp)] * 2
             + [pl.BlockSpec(sc_shape, sc_im(j)) for j in range(ppp)] * 2
@@ -464,7 +502,7 @@ def _paged_pallas_attention(qg, entry: Dict, mp, scale, interpret: bool):
     )
     kernel = functools.partial(
         _paged_kernel, scale=scale, num_pg=num_pg, hkv=Hkv, ppp=ppp, bs=bs,
-        quantized=quantized,
+        quantized=quantized, int4=int4,
     )
     return pl.pallas_call(
         kernel,

@@ -116,6 +116,23 @@ _register(
     "to the last N emitted tokens.",
 )
 
+_register(
+    "BCG_TPU_FUSED_SAMPLER", "str", "",
+    "Fused guided-sampling Pallas kernel (EngineConfig.fused_sampler "
+    "override): 'pallas' = the whole per-step [B, V] masked-sampler "
+    "pipeline as one kernel program per row (ops/guided_sampler.py; "
+    "interpret mode off-TPU), 'xla' = the reference sampler (the "
+    "conformance oracle), 'auto'/unset = pallas on TPU, xla elsewhere.",
+)
+_register(
+    "BCG_TPU_KV_DTYPE", "str", "",
+    "KV-cache dtype override (EngineConfig.kv_cache_dtype): 'bf16'/"
+    "'bfloat16', 'int8' (historical spelling kept as an alias of "
+    "itself), or 'int4' (packed two-per-byte + bf16 scales — the "
+    "capacity knob that roughly doubles admissible batch vs int8 at a "
+    "fixed HBM budget); unset = the config field.",
+)
+
 # BCG_TPU_PAGED_KV* — block-paged KV cache (engine/paged_kv.py).
 _register(
     "BCG_TPU_PAGED_KV", "bool", False,

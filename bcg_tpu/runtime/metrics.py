@@ -61,6 +61,21 @@ def publish_kv_pool(snapshot: Optional[Dict]) -> None:
     LAST_KV_POOL = snapshot
 
 
+# Latest guided-sampler self-description (engine.sampler_stats: resolved
+# impl, interpret mode, fused-kernel invocation count, resolved KV
+# dtype) — published at engine BOOT and after every generation call so
+# bench.py's success AND error paths can say which sampler/KV
+# configuration actually served (or failed to).
+LAST_SAMPLER: Optional[Dict] = None
+
+
+def publish_sampler(snapshot: Optional[Dict]) -> None:
+    """Record the most recent sampler stats (called by the engine at
+    boot and at the end of each generation call)."""
+    global LAST_SAMPLER
+    LAST_SAMPLER = snapshot
+
+
 # Latest game-telemetry summary (bcg_tpu/obs/game_events: games run/
 # completed/converged, rounds, byzantine adoptions, event-sink drops) —
 # published by the recorder at game_start/round_end/game_end so

@@ -154,6 +154,7 @@ _CONFIG_OVERRIDE_ENVS = (
     "BCG_TPU_ALLOW_PADDED_GROUP_KERNEL", "BCG_TPU_FINE_SUFFIX",
     "BCG_TPU_W8A16_PREFILL",
     "BCG_TPU_SPEC", "BCG_TPU_SPEC_K", "BCG_TPU_SPEC_NGRAM",
+    "BCG_TPU_FUSED_SAMPLER", "BCG_TPU_KV_DTYPE",
     "BCG_TPU_PAGED_KV", "BCG_TPU_KV_BLOCK_SIZE", "BCG_TPU_KV_POOL_BLOCKS",
     "BCG_TPU_PAGED_KV_IMPL", "BCG_TPU_PAGED_PAGES_PER_PROGRAM",
     "BCG_TPU_GAME_EVENTS", "BCG_TPU_SERVE_SLO_MS",
@@ -204,6 +205,24 @@ def _kv_pool_stats_or_none():
         from bcg_tpu.runtime import metrics as _metrics
 
         return _metrics.LAST_KV_POOL
+    except Exception:
+        # Inside the never-rc=1 contract (see _obs_payload).
+        return None
+
+
+def _sampler_stats_or_none():
+    """Latest guided-sampler self-description (resolved impl, interpret
+    mode, fused-kernel invocation count, resolved KV dtype) published
+    by the engine at boot and per call; None before any engine booted.
+    Read from runtime.metrics (not the engine object) so the ERROR
+    path — where no engine handle survives — still says which
+    sampler/KV configuration the failed run actually served, making
+    hardware A/B runs of both ISSUE-10 features self-describing in
+    results/."""
+    try:
+        from bcg_tpu.runtime import metrics as _metrics
+
+        return _metrics.LAST_SAMPLER
     except Exception:
         # Inside the never-rc=1 contract (see _obs_payload).
         return None
@@ -297,6 +316,12 @@ def _error_result(exc: BaseException, retried: bool) -> dict:
     kv_pool = _kv_pool_stats_or_none()
     if kv_pool:
         out["kv_pool"] = kv_pool
+    # Sampler/KV-dtype self-description of the failed attempt (published
+    # at engine BOOT, so even a first-compile death reports which
+    # configuration it was) — same idiom.
+    sampler = _sampler_stats_or_none()
+    if sampler:
+        out["sampler"] = sampler
     # Consensus-game telemetry of the failed attempt (games converged
     # before the crash, byzantine adoptions, event-sink drops) — same
     # mid-crash-forensics idiom as serve_stats/kv_pool.
@@ -711,6 +736,11 @@ def _run_attempt(cfg, model: str, backend: str, concurrency: int,
                 engine.kv_pool_stats()
                 if hasattr(engine, "kv_pool_stats") else None
             ),
+            # BCG_TPU_FUSED_SAMPLER / BCG_TPU_KV_DTYPE: sampler impl +
+            # interpret mode + fused-kernel invocation count + the
+            # RESOLVED kv dtype (env override wins over the config
+            # field echoed above).
+            "sampler": _sampler_stats_or_none(),
             # BCG_TPU_GAME_EVENTS: cumulative consensus-game telemetry
             # (converged/rounds/byzantine adoptions/event drops).
             "game_stats": _game_stats_or_none(),

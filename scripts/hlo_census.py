@@ -39,7 +39,7 @@ from typing import Dict, List, Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-ARMS = ("plain", "ff", "spec", "paged", "paged_pallas")
+ARMS = ("plain", "ff", "spec", "paged", "paged_pallas", "fused")
 _MODEL = "bcg-tpu/tiny-test"
 _SCHEMA = {
     "type": "object",
@@ -101,6 +101,15 @@ def run_scenario(arms=ARMS) -> Dict[str, Dict]:
             # (tests/test_hlo_census.py), the ISSUE-8 acceptance hook.
             paged_kv=arm.startswith("paged"),
             paged_kv_impl=("pallas" if arm == "paged_pallas" else "auto"),
+            # The fused arm EXECUTES the fused-sampler plain loop (the
+            # kernel's interpret-mode emulation on this CPU census —
+            # its entry pins under fused_decode_loop with a NOT-kernels
+            # reason, like paged_pallas).  The hardware inequality —
+            # step ops strictly DOWN under the fused sampler for all
+            # three loop families — is carried by the tpu_*/tpu_fused_*
+            # cross-lowering twin entries the dense arms record
+            # (engine._maybe_record_sampler_tpu_lowering).
+            fused_sampler=("pallas" if arm == "fused" else "auto"),
         )
         engine = JaxEngine(cfg)
         try:

@@ -51,7 +51,8 @@ from typing import Dict, List, Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-SCENARIOS = ("serve", "engine", "paged", "consensus", "hlo")
+SCENARIOS = ("serve", "engine", "paged", "sampler", "int4", "consensus",
+             "hlo")
 REGRESSIONS = ("none", "spec-off", "fail-rows", "events-off")
 
 DECISION = {
@@ -349,6 +350,129 @@ def run_paged_scenario(inject: str = "none") -> Dict[str, float]:
     }
 
 
+def run_sampler_scenario(inject: str = "none") -> Dict[str, float]:
+    """Fused guided-sampling kernel (ops/guided_sampler.py, interpret
+    mode on this CPU host — the same program hardware lowers) against
+    the XLA masked-sampler reference, across ALL THREE decode-loop
+    families on the greedy decision benchmark:
+
+    * ``parity_mismatches`` — fused vs xla outputs per family (must be
+      0 EXACT: greedy rows are token-identical by construction; the
+      acceptance criterion's hermetic stand-in for the hardware
+      kernel's claim).
+    * ``fused_kernel_invocations`` — the fused engines' total kernel
+      invocation count (one program per decode iteration); floored > 0
+      so the parity gate can never pass vacuously with the kernel
+      silently disengaged.
+    """
+    _force_cpu()
+    from bcg_tpu.config import EngineConfig
+    from bcg_tpu.engine.jax_engine import JaxEngine
+
+    prompts = [
+        ("honest agent system prompt", "Round 3: propose a value", DECISION),
+        ("byzantine agent system prompt", "Round 3: vote now", VOTE),
+    ]
+
+    def cfg(**kw):
+        return EngineConfig(
+            backend="jax", model_name="bcg-tpu/tiny-test",
+            max_model_len=2048, **kw,
+        )
+
+    mismatches = 0
+    fused_calls = 0
+    for family_kw in ({}, {"decode_fast_forward": True},
+                      {"spec_decode": True}):
+        ref = JaxEngine(cfg(**family_kw))
+        fused = JaxEngine(cfg(fused_sampler="pallas", **family_kw))
+        try:
+            r_ref = ref.batch_generate_json(prompts, temperature=0.0,
+                                            max_tokens=64)
+            r_fus = fused.batch_generate_json(prompts, temperature=0.0,
+                                              max_tokens=64)
+            mismatches += sum(1 for a, b in zip(r_ref, r_fus) if a != b)
+            fused_calls += fused.sampler_stats()["fused_calls"]
+        finally:
+            ref.shutdown()
+            fused.shutdown()
+    if inject == "fail-rows":
+        mismatches += 1  # self-test hook: provoke the parity gate
+    return {
+        "sampler.parity_mismatches": float(mismatches),
+        "sampler.fused_kernel_invocations": float(fused_calls),
+    }
+
+
+def run_int4_scenario(inject: str = "none") -> Dict[str, float]:
+    """Packed-int4 KV cache gates, all hermetic:
+
+    * ``row_cap_gain`` — ``cap_for``-derived dense admission cap of an
+      int4 engine over its int8 twin at the SAME synthetic HBM budget
+      (min-banded >= 1.8: the packed slot is exactly half the int8
+      slot — 2(Dh+4) vs Dh+4 bytes per kv head — so the cap doubles up
+      to integer flooring).
+    * ``pool_blocks_gain`` — paged-pool auto-sizing at the same
+      synthetic budget (the serve-admission form of the same claim:
+      admission caps come out measurably higher).
+    * ``paged_parity_mismatches`` — int4 paged (fused kernel, interpret
+      mode) vs int4 dense greedy outputs (0 exact: identical
+      quantization, block paging is bit-preserving).
+    * ``error_rows`` — every int4 decision/vote row parses as valid
+      guided JSON (the decision benchmark staying within the
+      established quantization tolerance; token-level drift vs bf16 is
+      tier-1's tolerance test, not a gate band).
+    """
+    _force_cpu()
+    from bcg_tpu.config import EngineConfig
+    from bcg_tpu.engine.jax_engine import JaxEngine
+
+    def cfg(**kw):
+        return EngineConfig(
+            backend="jax", model_name="bcg-tpu/tiny-test",
+            max_model_len=2048, **kw,
+        )
+
+    limit = 32 << 20
+    caps = {}
+    blocks = {}
+    for dtype in ("int8", "int4"):
+        eng = JaxEngine(cfg(kv_cache_dtype=dtype))
+        eng._mem_limit = limit
+        free = (eng.config.hbm_utilization * limit
+                - eng._param_bytes_per_device)
+        eng._prefix_budget = max(0, int(free * 0.25))
+        caps[dtype] = eng.cap_for(256) or 1
+        blocks[dtype] = eng._auto_pool_blocks(eng.config.kv_block_size)
+        eng.shutdown()
+
+    prompts = [
+        ("honest agent system prompt", "Round 3: propose a value", DECISION),
+        ("byzantine agent system prompt", "Round 3: vote now", VOTE),
+    ]
+    dense = JaxEngine(cfg(kv_cache_dtype="int4"))
+    paged = JaxEngine(cfg(kv_cache_dtype="int4", paged_kv=True,
+                          paged_kv_impl="pallas"))
+    try:
+        r_d = dense.batch_generate_json(prompts, temperature=0.0,
+                                        max_tokens=64)
+        r_p = paged.batch_generate_json(prompts, temperature=0.0,
+                                        max_tokens=64)
+    finally:
+        dense.shutdown()
+        paged.shutdown()
+    mismatches = sum(1 for a, b in zip(r_d, r_p) if a != b)
+    bad = sum(1 for r in r_d + r_p if not isinstance(r, dict) or "error" in r)
+    if inject == "fail-rows":
+        mismatches += 1  # self-test hook
+    return {
+        "int4.row_cap_gain": caps["int4"] / caps["int8"],
+        "int4.pool_blocks_gain": blocks["int4"] / blocks["int8"],
+        "int4.paged_parity_mismatches": float(mismatches),
+        "int4.error_rows": float(bad),
+    }
+
+
 # Game-event types every completed game must carry (the manifest is
 # per-file, checked separately).
 _REQUIRED_GAME_EVENTS = (
@@ -511,6 +635,8 @@ _RUNNERS = {
     "serve": run_serve_scenario,
     "engine": run_engine_scenario,
     "paged": run_paged_scenario,
+    "sampler": run_sampler_scenario,
+    "int4": run_int4_scenario,
     "consensus": run_consensus_scenario,
     "hlo": run_hlo_scenario,
 }

@@ -145,6 +145,10 @@ def render_report(trace: dict, top: int = 20) -> str:
     if census:
         lines.append("")
         lines.append(census)
+    fused = fused_sampler_section(counters)
+    if fused:
+        lines.append("")
+        lines.append(fused)
     return "\n".join(lines)
 
 
@@ -275,6 +279,42 @@ def hlo_census_table(counters: Dict[str, float]) -> str:
             vals.append("-" if v is None else f"{v:.0f}")
         lines.append(
             f"{entry:<{name_w}}  " + "  ".join(f"{v:>14}" for v in vals)
+        )
+    return "\n".join(lines)
+
+
+def fused_sampler_section(counters: Dict[str, float]) -> str:
+    """Per-decode-loop-family fused-sampler comparison rebuilt from the
+    TPU cross-lowering twin gauges (``engine.hlo.tpu_<family>.*`` vs
+    ``engine.hlo.tpu_fused_<family>.*``): one step-custom-call line per
+    family showing the per-decode-step op count moving DOWN under the
+    fused kernel — the at-a-glance form of the census acceptance
+    inequality; '' when the export carries no twin pair."""
+    prefix = "engine.hlo.tpu_fused_"
+    families = sorted({
+        name[len(prefix):].split(".")[0]
+        for name in counters if name.startswith(prefix)
+    })
+    rows = []
+    for fam in families:
+        xla_ops = counters.get(f"engine.hlo.tpu_{fam}.step_ops")
+        fused_ops = counters.get(f"{prefix}{fam}.step_ops")
+        if xla_ops is None or fused_ops is None:
+            continue
+        cc = counters.get(f"{prefix}{fam}.step_custom_calls", 0)
+        rows.append((fam, xla_ops, fused_ops, cc))
+    if not rows:
+        return ""
+    name_w = max(len("decode-loop family"), max(len(r[0]) for r in rows))
+    lines = ["== fused guided sampler (TPU cross-lowering twins) =="]
+    lines.append(
+        f"{'decode-loop family':<{name_w}}  {'step_ops xla':>12}  "
+        f"{'step_ops fused':>14}  {'step custom-calls':>17}"
+    )
+    for fam, xla_ops, fused_ops, cc in rows:
+        lines.append(
+            f"{fam:<{name_w}}  {xla_ops:>12.0f}  {fused_ops:>14.0f}  "
+            f"{cc:>17.0f}"
         )
     return "\n".join(lines)
 
