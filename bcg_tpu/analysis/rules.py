@@ -707,6 +707,14 @@ _OBS_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_]+){1,3}$")
 # carry the `<subsystem>.` prefix.
 _OBS_FRAGMENT_RE = re.compile(r"^[a-z0-9_.]*$")
 _OBS_PREFIX_RE = re.compile(r"^[a-z][a-z0-9_]*\.")
+# The legal subsystems (DESIGN.md "Observability" taxonomy).  A name
+# under a subsystem not in this set is a namespace fork — dashboards,
+# baselines, and the fleet shard merge all key on these prefixes, so a
+# new subsystem is a deliberate registry decision, not a call-site
+# spelling.  Extend HERE (and the DESIGN.md table) when one is added.
+_OBS_SUBSYSTEMS = frozenset(
+    {"engine", "serve", "game", "hbm", "kvpool", "fleet"}
+)
 _OBS_CALL_ATTRS = {
     "inc", "counter", "gauge", "set_gauge", "value", "histogram", "observe",
 }
@@ -773,32 +781,42 @@ def rule_obs_name(ctx: ModuleContext) -> Iterable[Finding]:
     (``<subsystem>.<noun>[.<detail>]``): the Prometheus exposition
     derives metric names from them mechanically, and a one-off spelling
     ("Serve.Requests", a bare "requests") fragments the namespace every
-    dashboard and baseline keys on.  Literal names are checked whole;
-    f-string names have their static fragments checked (the leading
-    fragment must carry the subsystem prefix); variable names are
-    trusted."""
+    dashboard and baseline keys on.  The leading segment must also be a
+    REGISTERED subsystem (``_OBS_SUBSYSTEMS`` — engine/serve/game/hbm/
+    kvpool/fleet): an unknown subsystem is a namespace fork the fleet
+    shard merge and every dashboard would silently split on.  Literal
+    names are checked whole; f-string names have their static fragments
+    checked (the leading fragment must carry the subsystem prefix);
+    variable names are trusted."""
     for node, arg in _iter_obs_name_calls(ctx, _OBS_CALL_ATTRS):
         bad: Optional[str] = None
+        unknown: Optional[str] = None
         if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
             if not _OBS_NAME_RE.match(arg.value):
                 bad = repr(arg.value)
+            elif arg.value.split(".", 1)[0] not in _OBS_SUBSYSTEMS:
+                unknown = arg.value.split(".", 1)[0]
         elif isinstance(arg, ast.JoinedStr):
             consts = [
                 v.value for v in arg.values
                 if isinstance(v, ast.Constant) and isinstance(v.value, str)
             ]
-            if any(not _OBS_FRAGMENT_RE.match(c) for c in consts):
-                bad = "f-string with non-taxonomy characters"
-            elif not (
-                arg.values
+            leading = (
+                arg.values[0].value
+                if arg.values
                 and isinstance(arg.values[0], ast.Constant)
                 and isinstance(arg.values[0].value, str)
-                and _OBS_PREFIX_RE.match(arg.values[0].value)
-            ):
+                else None
+            )
+            if any(not _OBS_FRAGMENT_RE.match(c) for c in consts):
+                bad = "f-string with non-taxonomy characters"
+            elif leading is None or not _OBS_PREFIX_RE.match(leading):
                 # Leading dynamic part (f"{x}.retrace"): the subsystem
                 # itself is unknowable statically — require a literal
                 # '<subsystem>.' prefix.
                 bad = "f-string without a literal '<subsystem>.' prefix"
+            elif leading.split(".", 1)[0] not in _OBS_SUBSYSTEMS:
+                unknown = leading.split(".", 1)[0]
         if bad:
             yield ctx.finding(
                 "BCG-OBS-NAME",
@@ -806,6 +824,15 @@ def rule_obs_name(ctx: ModuleContext) -> Iterable[Finding]:
                 f"metric name {bad} violates the counter/gauge taxonomy "
                 "(<subsystem>.<noun>[.<detail>], lowercase dotted, 2-4 "
                 "segments — DESIGN.md Observability)",
+            )
+        elif unknown is not None:
+            yield ctx.finding(
+                "BCG-OBS-NAME",
+                node,
+                f"metric subsystem {unknown!r} is not in the registered "
+                f"taxonomy ({', '.join(sorted(_OBS_SUBSYSTEMS))}) — a new "
+                "subsystem is a deliberate registry decision: add it to "
+                "_OBS_SUBSYSTEMS and the DESIGN.md Observability table",
             )
 
 

@@ -1043,12 +1043,15 @@ class JaxEngine(InferenceEngine):
                         impl=self.attention_impl),
                 donate_argnames=("cache",),
             )
-        # Telemetry endpoint (BCG_TPU_METRICS_PORT): idempotent, off by
+        # Telemetry endpoint (BCG_TPU_METRICS_PORT) + fleet metric-shard
+        # flusher (BCG_TPU_METRICS_SHARD_DIR): idempotent, off by
         # default — a scraped deployment gets engine.hlo.* / hbm.* /
-        # serve.* without further wiring.
-        from bcg_tpu.obs import export as obs_export
+        # serve.* without further wiring, and a multi-process run gets
+        # its per-rank shard stream from engine boot onward.
+        from bcg_tpu.obs import export as obs_export, fleet as obs_fleet
 
         obs_export.maybe_start_http_server()
+        obs_fleet.maybe_start_shard_writer()
         # Sampler/KV-dtype self-description for bench JSON — published
         # at BOOT (not just per call) so a run that dies before its
         # first decode still reports which configuration it booted

@@ -37,7 +37,7 @@ import time
 from collections import deque
 from typing import Any, Dict, Optional, Tuple
 
-from bcg_tpu.obs import counters as obs_counters
+from bcg_tpu.obs import counters as obs_counters, fleet as obs_fleet
 from bcg_tpu.runtime import envflags
 
 _NAME_PREFIX = "bcg_"
@@ -65,16 +65,26 @@ def _format_value(value) -> str:
     return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
 
 
-def render_prometheus(typed: Optional[Dict[str, Dict[str, Any]]] = None) -> str:
+def render_prometheus(typed: Optional[Dict[str, Dict[str, Any]]] = None,
+                      labels: Optional[str] = None) -> str:
     """The registry (or an explicit ``snapshot_typed()``-shaped dict) in
     Prometheus text-exposition format, sorted by metric name.
 
     Histograms render as the conformant family the spec requires:
     cumulative ``<name>_bucket{le="..."}`` samples over the declared
     bounds plus the mandatory ``le="+Inf"`` bucket (== ``_count``),
-    then ``<name>_sum`` and ``<name>_count``."""
+    then ``<name>_sum`` and ``<name>_count``.
+
+    ``labels`` is a pre-escaped label body (``process="3",host="a"``)
+    applied to every sample; None resolves the fleet identity labels
+    (:func:`bcg_tpu.obs.fleet.prom_label_body`) — the empty string when
+    fleet stamping is off, keeping the exposition byte-identical to the
+    unstamped form."""
     if typed is None:
         typed = obs_counters.snapshot_typed()
+    if labels is None:
+        labels = obs_fleet.prom_label_body()
+    wrap = f"{{{labels}}}" if labels else ""
     rows = [
         (prometheus_name(name, counter=True), name, "counter", value)
         for name, value in typed.get("counters", {}).items()
@@ -82,13 +92,16 @@ def render_prometheus(typed: Optional[Dict[str, Dict[str, Any]]] = None) -> str:
         (prometheus_name(name), name, "gauge", value)
         for name, value in typed.get("gauges", {}).items()
     ]
+    # Histogram buckets merge the identity labels with their ``le``
+    # label; every other sample takes the plain label set.
+    le_prefix = f"{labels}," if labels else ""
     blocks = []
     for metric, original, kind, value in rows:
         blocks.append((metric, [
             f"# HELP {metric} "
             f"{_escape_help(f'bcg_tpu registry {kind} {original!r}')}",
             f"# TYPE {metric} {kind}",
-            f"{metric} {_format_value(value)}",
+            f"{metric}{wrap} {_format_value(value)}",
         ]))
     for name, hist in typed.get("histograms", {}).items():
         metric = prometheus_name(name)
@@ -99,13 +112,15 @@ def render_prometheus(typed: Optional[Dict[str, Dict[str, Any]]] = None) -> str:
         ]
         for bound, cum in hist.get("buckets", []):
             lines.append(
-                f'{metric}_bucket{{le="{_format_value(bound)}"}} '
+                f'{metric}_bucket{{{le_prefix}le="{_format_value(bound)}"}} '
                 f"{_format_value(cum)}"
             )
-        lines.append(f'{metric}_bucket{{le="+Inf"}} '
+        lines.append(f'{metric}_bucket{{{le_prefix}le="+Inf"}} '
                      f"{_format_value(hist.get('count', 0))}")
-        lines.append(f"{metric}_sum {_format_value(hist.get('sum', 0.0))}")
-        lines.append(f"{metric}_count {_format_value(hist.get('count', 0))}")
+        lines.append(f"{metric}_sum{wrap} "
+                     f"{_format_value(hist.get('sum', 0.0))}")
+        lines.append(f"{metric}_count{wrap} "
+                     f"{_format_value(hist.get('count', 0))}")
         blocks.append((metric, lines))
     out = []
     for _, lines in sorted(blocks, key=lambda b: b[0]):
@@ -123,16 +138,27 @@ EVENT_SCHEMA_VERSION = 1
 
 def run_manifest(**extra: Any) -> Dict[str, Any]:
     """The run-manifest header every JSONL sink writes as its FIRST
-    record: run id, schema version, and the registered env-flag
-    overrides in effect — so merging event files across a sweep is
-    mechanical (group by manifest config, no out-of-band bookkeeping).
-    ``extra`` fields (preset, game geometry) ride along verbatim."""
-    import uuid
+    record: run id, schema version, fleet identity, and the registered
+    env-flag overrides in effect — so merging event files across a
+    sweep (or across the ranks of one multi-process run) is mechanical
+    (group by manifest run id + config, no out-of-band bookkeeping).
+    ``extra`` fields (preset, game geometry) ride along verbatim.
 
+    The run id comes from the fleet identity: ``BCG_TPU_RUN_ID`` when a
+    launcher set one (all ranks — and both sinks of one process —
+    share it), else a stable per-process 12-hex id.  ``metrics_port``
+    surfaces the rank's ACTUAL ``/metrics`` port (the configured base
+    offset by process_index) so a scraper can find every rank of a
+    local cluster from the event files alone."""
+    ident = obs_fleet.identity()
     manifest = {
         "schema_version": EVENT_SCHEMA_VERSION,
-        "run_id": uuid.uuid4().hex[:12],
+        "run_id": ident["run_id"],
         "pid": os.getpid(),
+        "host": ident["host"],
+        "process_index": ident["process_index"],
+        "process_count": ident["process_count"],
+        "metrics_port": current_http_port(),
         "flags": envflags.overrides(),
     }
     manifest.update(extra)
@@ -306,16 +332,30 @@ def start_http_server(port: int) -> Tuple[Any, int]:
     return server, server.server_address[1]
 
 
+def current_http_port() -> Optional[int]:
+    """The bound ``/metrics`` port, or None while the endpoint is off —
+    the run-manifest field (surfaced so every rank of a local cluster
+    is discoverable from its event files)."""
+    return _server_port
+
+
 def maybe_start_http_server() -> Optional[int]:
     """Start the endpoint once per process when ``BCG_TPU_METRICS_PORT``
     is set (> 0); returns the bound port, or None when disabled.  Called
-    from engine/scheduler boot — cheap no-op on every later call."""
+    from engine/scheduler boot — cheap no-op on every later call.
+
+    The configured port is a BASE: each rank binds base +
+    process_index, so every rank of a local multi-process cluster is
+    scrapeable instead of rank 0 binding and the rest warn-and-skipping
+    on the collision (single-process: process_index 0, port unchanged).
+    """
     global _server, _server_port
     if _server is not None:
         return _server_port
     port = envflags.get_int("BCG_TPU_METRICS_PORT")
     if port <= 0:
         return None
+    port += obs_fleet.process_index()
     with _server_lock:
         if _server is None:
             try:

@@ -59,7 +59,11 @@ import time
 from typing import Any, Dict, List, Optional, Sequence
 
 from bcg_tpu.game.statistics import round_convergence, round_record
-from bcg_tpu.obs import counters as obs_counters, export as obs_export
+from bcg_tpu.obs import (
+    counters as obs_counters,
+    export as obs_export,
+    fleet as obs_fleet,
+)
 from bcg_tpu.runtime import envflags
 
 # Round wall-time bucket bounds (ms): FakeEngine rounds run ~1-50 ms;
@@ -167,12 +171,15 @@ class GameEventRecorder:
         self._byz_ids = tuple(
             aid for aid, st in sim.game.agents.items() if st.is_byzantine
         )
-        self._sink = _ensure_sink(preset=cfg.engine.model_name)
         # Game-only runs (FakeEngine, no serve layer) never pass the
-        # engine/scheduler boot sites that start the metrics endpoint —
-        # kick the idempotent starter here so game.* metrics are
-        # scrapeable mid-run under BCG_TPU_METRICS_PORT.
+        # engine/scheduler boot sites that start the metrics endpoint or
+        # the fleet metric-shard flusher — kick both idempotent starters
+        # here, BEFORE the sink exists, so the run manifest can carry
+        # the rank's actual bound metrics_port and game.* metrics are
+        # scrapeable/shardable mid-run.
         obs_export.maybe_start_http_server()
+        obs_fleet.maybe_start_shard_writer()
+        self._sink = _ensure_sink(preset=cfg.engine.model_name)
         self._round_t0: Optional[float] = None
         # Previous round's per-agent values + byzantine proposals — the
         # byzantine_influence inputs (adoption is measured against what

@@ -339,6 +339,12 @@ class Tracer:
                 "span_summary": self.summarize(),
             },
         }
+        # Fleet identity (run id, rank, host) so traces from many ranks
+        # of one run stay attributable after they are copied off-host.
+        from bcg_tpu.obs import fleet as _fleet
+
+        if _fleet.enabled():
+            data["otherData"]["fleet"] = _fleet.identity()
         if path:
             with open(path, "w") as f:
                 json.dump(data, f)

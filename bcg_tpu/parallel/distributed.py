@@ -57,6 +57,15 @@ def initialize(
     # once backends exist, so this function deliberately queries nothing.
     jax.distributed.initialize(**kwargs)
     _initialized = True
+    # Hand the observability plane its process identity LAZILY: the
+    # provider closure queries the backend only when fleet telemetry
+    # first needs the rank, so initialize() itself still touches
+    # nothing (callers may have more backend config to apply).
+    from bcg_tpu.obs import fleet
+
+    fleet.set_process_provider(
+        lambda: (jax.process_index(), jax.process_count())
+    )
     atexit.register(shutdown)
 
 

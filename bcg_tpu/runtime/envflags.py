@@ -213,6 +213,40 @@ _register(
     "metrics) as JSONL to this path (first line = run manifest; "
     "scripts/consensus_report.py aggregates one or many such files).",
 )
+# BCG_TPU_FLEET* / RUN_ID / METRICS_SHARD* — distributed observability
+# plane (bcg_tpu/obs/fleet.py, scripts/fleet_report.py).
+_register(
+    "BCG_TPU_FLEET", "bool", False,
+    "Force fleet identity stamping on (Prometheus process=/host= "
+    "labels, fleet.* gauges) even in a single-process run; stamping "
+    "also engages automatically under a multi-process JAX group or a "
+    "shard dir.  Off (the default, single-process): the exposition is "
+    "byte-identical to the unstamped form.",
+)
+_register(
+    "BCG_TPU_RUN_ID", "str", None,
+    "Run id shared by every rank of one fleet run (shard file names, "
+    "JSONL run manifests, fleet_report merge key); unset = a stable "
+    "per-process 12-hex id.",
+)
+_register(
+    "BCG_TPU_METRICS_SHARD_DIR", "str", None,
+    "Directory the per-process metric-shard flusher appends "
+    "shard-<run_id>-<process>.jsonl typed counter/gauge/histogram "
+    "snapshots into (scripts/fleet_report.py merges them: counters "
+    "sum, histograms bucket-wise, gauges per-rank).",
+)
+_register(
+    "BCG_TPU_METRICS_SHARD_MS", "int", 1000,
+    "Metric-shard flush (and heartbeat) period in milliseconds.",
+)
+_register(
+    "BCG_TPU_FLEET_STRAGGLER_FACTOR", "int", 3,
+    "Straggler lag factor: a rank is flagged when its watermark is "
+    "under median/factor or its heartbeat is older than factor x the "
+    "flush period (fleet.stragglers gauge + fleet_report --watch); "
+    "0 disables detection.",
+)
 _register(
     "BCG_TPU_SERVE_SLO_MS", "int", 0,
     "Serving latency objective in milliseconds: each completed "

@@ -39,6 +39,7 @@ from bcg_tpu.comm import (
 from bcg_tpu.config import BCGConfig
 from bcg_tpu.engine.interface import InferenceEngine, create_engine
 from bcg_tpu.game import ByzantineConsensusGame
+from bcg_tpu.obs import fleet as obs_fleet
 from bcg_tpu.obs import game_events as obs_game_events
 from bcg_tpu.obs import tracer as obs_tracer
 from bcg_tpu.runtime import envflags
@@ -628,6 +629,9 @@ class BCGSimulation:
         self.network.advance_round()
         self.network.end_round_gc(round_num)
         self.profiler.count_round(num_decisions=2 * len(self.agents))
+        # Fleet liveness: each completed round advances this rank's
+        # progress watermark (no-op when fleet stamping is off).
+        obs_fleet.note_round()
         if self._recorder:
             # round_end reads the round advance_round just recorded;
             # game_end here (not only in run()) covers external drivers

@@ -46,6 +46,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from bcg_tpu.obs import (
     counters as obs_counters,
     export as obs_export,
+    fleet as obs_fleet,
     ledger as obs_ledger,
     tracer as obs_tracer,
 )
@@ -404,9 +405,11 @@ class Scheduler:
             target=self._loop, name="bcg-serve-scheduler", daemon=True
         )
         self._thread.start()
-        # Telemetry endpoint (BCG_TPU_METRICS_PORT): idempotent no-op
-        # when disabled; a FakeEngine serving run is scrapeable too.
+        # Telemetry endpoint (BCG_TPU_METRICS_PORT) + fleet metric-shard
+        # flusher (BCG_TPU_METRICS_SHARD_DIR): idempotent no-ops when
+        # disabled; a FakeEngine serving run is scrapeable/shardable too.
         obs_export.maybe_start_http_server()
+        obs_fleet.maybe_start_shard_writer()
 
     # ------------------------------------------------------------ submission
 
@@ -565,6 +568,14 @@ class Scheduler:
                         batch_requests=len(batch),
                     )
             self._dispatch(batch)
+            # Fleet liveness: every dispatch advances this rank's
+            # progress watermark (no-op when fleet stamping is off).
+            # Peer ranks' lagging dispatch watermarks surface as the
+            # fleet.stragglers gauge via the shard flusher thread's
+            # periodic check_stragglers pass — detection only has
+            # inputs when shards are on, and running the peer-shard
+            # scan there keeps its I/O off this dispatch thread.
+            obs_fleet.note_dispatch()
             self._publish_stats()
 
     def _cancel_expired_locked(self, now: float) -> None:

@@ -158,6 +158,11 @@ _CONFIG_OVERRIDE_ENVS = (
     "BCG_TPU_PAGED_KV", "BCG_TPU_KV_BLOCK_SIZE", "BCG_TPU_KV_POOL_BLOCKS",
     "BCG_TPU_PAGED_KV_IMPL", "BCG_TPU_PAGED_PAGES_PER_PROGRAM",
     "BCG_TPU_GAME_EVENTS", "BCG_TPU_SERVE_SLO_MS",
+    "BCG_TPU_FLEET", "BCG_TPU_METRICS_SHARD_DIR",
+    "BCG_TPU_FLEET_STRAGGLER_FACTOR",
+    # BCG_TPU_RUN_ID / BCG_TPU_METRICS_SHARD_MS stay out: a run label
+    # and a flush period are provenance/measurement knobs, not a change
+    # to the served configuration.
 )
 
 
@@ -238,6 +243,22 @@ def _game_stats_or_none():
         from bcg_tpu.runtime import metrics as _metrics
 
         return _metrics.LAST_GAME_STATS
+    except Exception:
+        # Inside the never-rc=1 contract (see _obs_payload).
+        return None
+
+
+def _fleet_stats_or_none():
+    """Fleet identity block (run id, rank, host, shard path, heartbeat
+    age, straggler count) when fleet stamping is on (BCG_TPU_FLEET /
+    shard dir / multi-process group); None single-process.  Attached on
+    success AND error paths — a rank that dies mid-sweep must leave a
+    bench line that says WHICH rank it was and whether its peers had
+    already flagged it lagging."""
+    try:
+        from bcg_tpu.obs import fleet as _fleet
+
+        return _fleet.summary()
     except Exception:
         # Inside the never-rc=1 contract (see _obs_payload).
         return None
@@ -328,6 +349,12 @@ def _error_result(exc: BaseException, retried: bool) -> dict:
     game_stats = _game_stats_or_none()
     if game_stats:
         out["game_stats"] = game_stats
+    # Fleet identity of the failed attempt (which rank, which shard
+    # file, heartbeat age at death) — the line a multi-host sweep's
+    # post-mortem greps for.
+    fleet_stats = _fleet_stats_or_none()
+    if fleet_stats:
+        out["fleet"] = fleet_stats
     # Boot-phase breakdown of the failed attempt (engine boots record
     # into runtime.metrics.LAST_BOOT_PHASES even when construction
     # dies mid-phase): a RESOURCE_EXHAUSTED error line now names the
@@ -744,6 +771,10 @@ def _run_attempt(cfg, model: str, backend: str, concurrency: int,
             # BCG_TPU_GAME_EVENTS: cumulative consensus-game telemetry
             # (converged/rounds/byzantine adoptions/event drops).
             "game_stats": _game_stats_or_none(),
+            # Fleet identity (run id, rank, host, shard path, heartbeat
+            # age, straggler count) when fleet stamping is on; None
+            # single-process.
+            "fleet": _fleet_stats_or_none(),
             "window_decode_steps": window_steps,
             "window_failed_row_fraction": round(failed_fraction, 4),
             "baseline_denominator_dec_per_sec": (

@@ -9,7 +9,12 @@ tables the paper's evaluation methodology needs: convergence rate,
 rounds-to-consensus, and Byzantine influence, grouped by configuration.
 Merging many files is mechanical BECAUSE of the manifest header — the
 group key is (agents split, topology, model, flag overrides), all read
-from ``manifest`` + ``game_start`` records, never from filenames.
+from ``manifest`` + ``game_start`` records, never from filenames.  The
+stamped fleet identity (run_id + process@host) is accounted inside
+each row: N rank files of one multi-process run report as ONE run with
+N ranks, while N independently-seeded single-process runs of the same
+config still aggregate into one row with a meaningful convergence
+rate.
 
 Self-contained — no bcg_tpu import — so event files copied off a TPU
 host (or collected from a hundred sweep workers) can be aggregated
@@ -34,24 +39,38 @@ from typing import Dict, List, Optional, Tuple
 KNOWN_SCHEMA_VERSIONS = (1,)
 
 # Flags that vary per worker without changing game semantics — excluded
-# from the group key so one sweep's workers merge into one row.
+# from the group key so one sweep's workers merge into one row (the
+# fleet plane's per-worker knobs included: a run id is the GROUP key
+# itself, never a config axis).
 _NON_CONFIG_FLAGS = (
     "BCG_TPU_GAME_EVENTS",
     "BCG_TPU_SERVE_EVENTS",
     "BCG_TPU_METRICS_PORT",
     "BCG_TPU_TRACE_OUT",
+    "BCG_TPU_RUN_ID",
+    "BCG_TPU_FLEET",
+    "BCG_TPU_METRICS_SHARD_DIR",
+    "BCG_TPU_METRICS_SHARD_MS",
+    "BCG_TPU_FLEET_STRAGGLER_FACTOR",
 )
 
 
 class GameAgg:
     """Accumulator for one game's records."""
 
-    __slots__ = ("config_key", "started", "ended", "converged",
-                 "rounds_to_consensus", "influence", "round_ms",
-                 "decisions", "fallbacks", "invalids")
+    __slots__ = ("config_key", "run_id", "rank", "started", "ended",
+                 "converged", "rounds_to_consensus", "influence",
+                 "round_ms", "decisions", "fallbacks", "invalids")
 
-    def __init__(self, config_key: str):
+    def __init__(self, config_key: str, run_id: str = "-",
+                 rank: str = "-"):
         self.config_key = config_key
+        # Run identity from the stamped manifest: every rank of one
+        # multi-process run shares run_id (BCG_TPU_RUN_ID), so its
+        # files merge into ONE run row instead of reading as N
+        # independent runs; rank = "process@host" provenance.
+        self.run_id = run_id
+        self.rank = rank
         self.started = False
         self.ended = False
         self.converged = False
@@ -85,6 +104,18 @@ def _config_key(manifest: Dict, start: Optional[Dict]) -> str:
     return " ".join(parts) if parts else "(unknown config)"
 
 
+def _run_identity(manifest: Dict) -> Tuple[str, str]:
+    """(run_id, rank) from a stamped manifest — ranks of one run share
+    run_id, so their files group into one run; older unstamped files
+    fall back to "-" and group as before."""
+    run = str(manifest.get("run_id") or "-")
+    proc = manifest.get("process_index")
+    host = manifest.get("host")
+    if proc is None and host is None:
+        return run, "-"
+    return run, f"{proc if proc is not None else '?'}@{host or '?'}"
+
+
 def parse_file(path: str, problems: List[str]) -> List[GameAgg]:
     """All games found in one event file (games still open at EOF stay
     ``ended=False``)."""
@@ -115,9 +146,12 @@ def parse_file(path: str, problems: List[str]) -> List[GameAgg]:
             gid = rec.get("game")
             if gid is None:
                 continue
+            run, rank = _run_identity(manifest)
             if event == "game_start":
                 starts[gid] = rec
-                agg = games.get(gid) or GameAgg(_config_key(manifest, rec))
+                agg = games.get(gid) or GameAgg(
+                    _config_key(manifest, rec), run, rank
+                )
                 agg.config_key = _config_key(manifest, rec)
                 agg.started = True
                 games[gid] = agg
@@ -126,7 +160,9 @@ def parse_file(path: str, problems: List[str]) -> List[GameAgg]:
             if agg is None:
                 # game_start lost to sink backpressure: group under the
                 # file manifest alone.
-                agg = games[gid] = GameAgg(_config_key(manifest, None))
+                agg = games[gid] = GameAgg(
+                    _config_key(manifest, None), run, rank
+                )
             if event == "round_end":
                 agg.influence += int(rec.get("byzantine_influence", 0))
                 if rec.get("duration_ms") is not None:
@@ -165,13 +201,22 @@ def _median(ordered: List[float]) -> float:
 
 
 def render_report(games: List[GameAgg], problems: List[str]) -> str:
+    # Rows stay CONFIG-keyed (a sweep of N independent seeded runs of
+    # one config must aggregate into one row with a meaningful
+    # convergence rate — the PAPERS.md methodology), but the stamped
+    # manifest identity is now accounted INSIDE the row: `runs` counts
+    # distinct run_ids and `ranks` distinct (run_id, process@host)
+    # contributors, so a 2-rank fleet run reads as ONE run with 2
+    # ranks, not as two independent runs.  Unstamped files fall back to
+    # run "-"/rank "-" and group exactly as before.
     by_config: Dict[str, List[GameAgg]] = defaultdict(list)
     for g in games:
         by_config[g.config_key].append(g)
 
     lines: List[str] = []
     header = (
-        f"{'games':>5}  {'done':>4}  {'conv':>4}  {'rate':>6}  "
+        f"{'runs':>4}  {'ranks':>5}  {'games':>5}  {'done':>4}  "
+        f"{'conv':>4}  {'rate':>6}  "
         f"{'rounds(med/mean)':>16}  {'byz_infl':>8}  "
         f"{'fallback':>8}  {'invalid':>7}  config"
     )
@@ -179,6 +224,8 @@ def render_report(games: List[GameAgg], problems: List[str]) -> str:
     lines.append(header)
     for key in sorted(by_config):
         group = by_config[key]
+        runs = {g.run_id for g in group}
+        ranks = {(g.run_id, g.rank) for g in group if g.rank != "-"}
         done = [g for g in group if g.ended]
         conv = [g for g in done if g.converged]
         rate = (100.0 * len(conv) / len(done)) if done else 0.0
@@ -195,6 +242,7 @@ def render_report(games: List[GameAgg], problems: List[str]) -> str:
         fb_pct = (100.0 * fallbacks / decisions) if decisions else 0.0
         inv_pct = (100.0 * invalids / decisions) if decisions else 0.0
         lines.append(
+            f"{len(runs):>4}  {len(ranks) or len(runs):>5}  "
             f"{len(group):>5}  {len(done):>4}  {len(conv):>4}  "
             f"{rate:>5.1f}%  {med:>7.1f}/{mean:<8.1f}  {infl:>8}  "
             f"{fb_pct:>7.1f}%  {inv_pct:>6.1f}%  {key}"

@@ -1,0 +1,426 @@
+#!/usr/bin/env python
+"""Fleet report from per-process metric shards (BCG_TPU_METRICS_SHARD_DIR).
+
+``python scripts/fleet_report.py SHARD_DIR_OR_FILES... [--watch]``
+
+Each process of a fleet run appends cumulative typed registry snapshots
+(counters/gauges/histograms + identity + heartbeat) to
+``shard-<run_id>-<process>.jsonl`` (``bcg_tpu/obs/fleet.py``).  This
+script merges the NEWEST record per shard into fleet tables, grouped by
+run id:
+
+* **counters** — summed across ranks, with a per-host breakdown and
+  cross-rank skew columns (the p95 rank's value vs the median rank's —
+  a hot or cold rank shows as skew, not as a mysteriously-off mean);
+* **histograms** — merged bucket-wise (fixed declared bounds make two
+  histograms addable), with fleet-level p50/p95/p99 derived from the
+  merged buckets exactly like the in-process registry derives them;
+* **gauges** — point-in-time per-rank values (a gauge has no meaningful
+  cross-rank sum), listed rank by rank;
+* **liveness** (``--watch``) — per-rank watermark + heartbeat age, and
+  straggler flags: a rank lagging the fleet median watermark by the
+  ``--straggler-factor`` (or whose heartbeat is older than factor x its
+  flush period) is named, and the exit code is 3 — so a sweep driver
+  can poll this in a loop and alarm.
+
+Self-contained — no bcg_tpu import — so shards copied off a hundred
+sweep workers aggregate anywhere (the trace_report/consensus_report
+contract).  The straggler rule and the bucket-quantile interpolation
+mirror ``bcg_tpu/obs`` by value; ``tests/test_fleet.py`` holds the
+mirrors to the same verdicts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+from collections import defaultdict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+# The shard schema this report understands (mirrors
+# bcg_tpu.obs.fleet.SHARD_SCHEMA_VERSION — by value, not import).
+KNOWN_SHARD_SCHEMA_VERSIONS = (1,)
+
+
+# ------------------------------------------------------------------ loading
+def read_last_record(path: str) -> Optional[Dict[str, Any]]:
+    """Newest parseable JSONL record of one shard file (shards are
+    cumulative snapshots — the last line is the rank's current state; a
+    line truncated mid-write falls back to the one before it)."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - 262144))
+            tail = f.read().decode("utf-8", errors="replace")
+    except OSError:
+        return None
+    for line in reversed(tail.strip().splitlines()):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    return None
+
+
+def shard_files(paths: Sequence[str], problems: List[str]) -> List[str]:
+    """Expand directories to their shard-*.jsonl members."""
+    out: List[str] = []
+    for path in paths:
+        if os.path.isdir(path):
+            members = sorted(
+                os.path.join(path, name)
+                for name in os.listdir(path)
+                if name.startswith("shard-") and name.endswith(".jsonl")
+            )
+            if not members:
+                problems.append(f"{path}: no shard-*.jsonl files")
+            out.extend(members)
+        else:
+            out.append(path)
+    return out
+
+
+def load_shards(paths: Sequence[str],
+                problems: List[str]) -> List[Dict[str, Any]]:
+    """Newest record per shard file, schema-checked."""
+    records = []
+    for path in shard_files(paths, problems):
+        rec = read_last_record(path)
+        if rec is None:
+            problems.append(f"{path}: no parseable shard record")
+            continue
+        version = rec.get("schema_version")
+        if version not in KNOWN_SHARD_SCHEMA_VERSIONS:
+            problems.append(
+                f"{path}: unknown shard schema_version {version!r} "
+                f"(this report understands {KNOWN_SHARD_SCHEMA_VERSIONS})"
+            )
+            continue
+        rec["_path"] = path
+        records.append(rec)
+    return records
+
+
+def group_by_run(records: List[Dict[str, Any]]) -> Dict[str, List[Dict]]:
+    runs: Dict[str, List[Dict]] = defaultdict(list)
+    for rec in records:
+        ident = rec.get("identity") or {}
+        runs[str(ident.get("run_id", "(unknown run)"))].append(rec)
+    for group in runs.values():
+        group.sort(
+            key=lambda r: (r.get("identity") or {}).get("process_index", 0)
+        )
+    return dict(sorted(runs.items()))
+
+
+# ------------------------------------------------------------------ merging
+def _rank_label(rec: Dict[str, Any]) -> str:
+    ident = rec.get("identity") or {}
+    return f"{ident.get('process_index', '?')}@{ident.get('host', '?')}"
+
+
+def _p95(values: List[float]) -> float:
+    ordered = sorted(values)
+    if not ordered:
+        return 0.0
+    idx = min(len(ordered) - 1, int(round(0.95 * (len(ordered) - 1))))
+    return ordered[idx]
+
+
+def merge_counters(records: List[Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
+    """Per counter name: fleet total (sum), per-rank and per-host
+    breakdowns, and the cross-rank skew pair (p95 rank vs median rank —
+    absent ranks count 0: a rank that never touched a counter IS part
+    of the fleet distribution)."""
+    names = sorted({
+        name for rec in records for name in (rec.get("counters") or {})
+    })
+    out: Dict[str, Dict[str, Any]] = {}
+    for name in names:
+        per_rank: Dict[str, float] = {}
+        per_host: Dict[str, float] = defaultdict(float)
+        for rec in records:
+            value = float((rec.get("counters") or {}).get(name, 0))
+            per_rank[_rank_label(rec)] = value
+            host = (rec.get("identity") or {}).get("host", "?")
+            per_host[str(host)] += value
+        values = list(per_rank.values())
+        med = float(statistics.median(values)) if values else 0.0
+        p95 = _p95(values)
+        out[name] = {
+            "total": sum(values),
+            "per_rank": per_rank,
+            "per_host": dict(sorted(per_host.items())),
+            "median_rank": med,
+            "p95_rank": p95,
+            "skew": round(p95 / med, 3) if med else None,
+        }
+    return out
+
+
+def merge_gauges(records: List[Dict[str, Any]]) -> Dict[str, Dict[str, float]]:
+    """Per gauge name: the per-rank values (gauges are point-in-time —
+    summing them across ranks would fabricate a meaningless number)."""
+    names = sorted({
+        name for rec in records for name in (rec.get("gauges") or {})
+    })
+    return {
+        name: {
+            _rank_label(rec): float(rec["gauges"][name])
+            for rec in records
+            if name in (rec.get("gauges") or {})
+        }
+        for name in names
+    }
+
+
+def merge_histograms(
+    records: List[Dict[str, Any]], problems: List[str]
+) -> Dict[str, Dict[str, Any]]:
+    """Bucket-wise merge: per histogram name, the ranks' cumulative
+    bucket counts add bound-for-bound (declared bounds must agree —
+    mismatched bounds are reported and the offending rank skipped, not
+    silently blended into a wrong distribution)."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for rec in records:
+        for name, hist in (rec.get("histograms") or {}).items():
+            bounds = tuple(float(b) for b, _ in hist.get("buckets", []))
+            merged = out.get(name)
+            if merged is None:
+                out[name] = {
+                    "bounds": bounds,
+                    "cumulative": [float(c) for _, c in hist["buckets"]],
+                    "sum": float(hist.get("sum", 0.0)),
+                    "count": int(hist.get("count", 0)),
+                }
+                continue
+            if bounds != merged["bounds"]:
+                problems.append(
+                    f"histogram {name!r}: rank {_rank_label(rec)} declares "
+                    f"bounds {bounds}, fleet has {merged['bounds']} — rank "
+                    "skipped"
+                )
+                continue
+            merged["cumulative"] = [
+                a + float(c)
+                for a, (_, c) in zip(merged["cumulative"], hist["buckets"])
+            ]
+            merged["sum"] += float(hist.get("sum", 0.0))
+            merged["count"] += int(hist.get("count", 0))
+    return out
+
+
+def quantile_from_cumulative(bounds: Sequence[float],
+                             cumulative: Sequence[float],
+                             count: int, q: float) -> float:
+    """Prometheus histogram_quantile over cumulative finite-bound
+    counts + total (mirrors bcg_tpu.obs.counters.quantile_from_counts
+    by value: linear interpolation inside the target bucket, the
+    highest finite bound for the overflow bucket)."""
+    if count <= 0:
+        return 0.0
+    target = q * count
+    prev_bound = 0.0
+    prev_cum = 0.0
+    for bound, cum in zip(bounds, cumulative):
+        in_bucket = cum - prev_cum
+        if cum >= target and in_bucket > 0:
+            frac = (target - prev_cum) / in_bucket
+            return prev_bound + (float(bound) - prev_bound) * max(
+                0.0, min(1.0, frac)
+            )
+        prev_bound = float(bound)
+        prev_cum = cum
+    return float(bounds[-1]) if bounds else 0.0
+
+
+def histogram_quantiles(merged: Dict[str, Any],
+                        qs: Sequence[float] = (0.5, 0.95, 0.99)
+                        ) -> Dict[str, float]:
+    return {
+        f"p{int(round(q * 100))}": quantile_from_cumulative(
+            merged["bounds"], merged["cumulative"], merged["count"], q
+        )
+        for q in qs
+    }
+
+
+# ------------------------------------------------------------ liveness/watch
+def detect_stragglers(
+    records: List[Dict[str, Any]],
+    factor: float,
+    now_ms: Optional[float] = None,
+) -> List[Dict[str, Any]]:
+    """Ranks lagging the fleet (mirrors
+    bcg_tpu.obs.fleet.detect_stragglers by value): watermark under
+    median/factor, or heartbeat older than factor x the rank's flush
+    period relative to the freshest rank (offline) / now (live).
+    ``factor <= 0`` disables; fewer than 2 ranks have no median to
+    lag."""
+    if factor <= 0 or len(records) < 2:
+        return []
+    gauges = [r.get("gauges") or {} for r in records]
+    watermarks = [float(g.get("fleet.watermark", 0)) for g in gauges]
+    heartbeats = [
+        float(r.get("heartbeat_ms") or g.get("fleet.heartbeat_ms", 0))
+        for r, g in zip(records, gauges)
+    ]
+    med_watermark = statistics.median(watermarks)
+    ref_ms = now_ms if now_ms is not None else max(heartbeats, default=0.0)
+    out = []
+    for rec, w, hb in zip(records, watermarks, heartbeats):
+        reasons = []
+        if med_watermark > 0 and w * factor < med_watermark:
+            reasons.append("watermark")
+        flush_ms = float(rec.get("flush_ms") or 1000.0)
+        if hb > 0 and (ref_ms - hb) > factor * flush_ms:
+            reasons.append("heartbeat")
+        if reasons:
+            ident = rec.get("identity") or {}
+            out.append({
+                "process_index": ident.get("process_index"),
+                "host": ident.get("host"),
+                "reasons": reasons,
+                "watermark": w,
+                "median_watermark": med_watermark,
+                "heartbeat_age_ms": round(ref_ms - hb, 1) if hb else None,
+            })
+    return out
+
+
+# ---------------------------------------------------------------- rendering
+def render_run(run: str, records: List[Dict[str, Any]],
+               problems: List[str]) -> str:
+    counters = merge_counters(records)
+    gauges = merge_gauges(records)
+    hists = merge_histograms(records, problems)
+    hosts = sorted({
+        str((r.get("identity") or {}).get("host", "?")) for r in records
+    })
+    lines = [
+        f"== run {run}: {len(records)} rank(s) on {len(hosts)} host(s) "
+        f"({', '.join(hosts)}) =="
+    ]
+    if counters:
+        width = max(len(n) for n in counters)
+        lines.append(
+            f"{'counter':<{width}}  {'fleet_total':>12}  {'median_rank':>11}  "
+            f"{'p95_rank':>9}  {'skew':>6}  per_host"
+        )
+        for name, row in counters.items():
+            skew = f"{row['skew']:.2f}" if row["skew"] is not None else "-"
+            hosts_s = " ".join(
+                f"{host}={value:g}" for host, value in row["per_host"].items()
+            )
+            lines.append(
+                f"{name:<{width}}  {row['total']:>12g}  "
+                f"{row['median_rank']:>11g}  {row['p95_rank']:>9g}  "
+                f"{skew:>6}  {hosts_s}"
+            )
+    if hists:
+        lines.append("")
+        lines.append("-- merged histograms (bucket-wise across ranks) --")
+        width = max(len(n) for n in hists)
+        lines.append(
+            f"{'histogram':<{width}}  {'count':>8}  {'p50':>9}  {'p95':>9}  "
+            f"{'p99':>9}"
+        )
+        for name, merged in sorted(hists.items()):
+            q = histogram_quantiles(merged)
+            lines.append(
+                f"{name:<{width}}  {merged['count']:>8}  {q['p50']:>9.2f}  "
+                f"{q['p95']:>9.2f}  {q['p99']:>9.2f}"
+            )
+    fleet_gauges = {
+        n: v for n, v in gauges.items()
+        if n.startswith("fleet.") or len(records) > 1
+    }
+    if fleet_gauges:
+        lines.append("")
+        lines.append("-- gauges (per-rank; point-in-time, never summed) --")
+        width = max(len(n) for n in fleet_gauges)
+        for name, per_rank in fleet_gauges.items():
+            ranks_s = " ".join(
+                f"{rank}={value:g}" for rank, value in per_rank.items()
+            )
+            lines.append(f"{name:<{width}}  {ranks_s}")
+    return "\n".join(lines)
+
+
+def render_watch(run: str, records: List[Dict[str, Any]],
+                 factor: float) -> Tuple[str, bool]:
+    """Liveness table + straggler flags for one run; returns the text
+    and whether any rank is flagged."""
+    flagged = detect_stragglers(records, factor)
+    flagged_by_proc = {f["process_index"]: f for f in flagged}
+    heartbeats = [
+        float(r.get("heartbeat_ms")
+              or (r.get("gauges") or {}).get("fleet.heartbeat_ms", 0))
+        for r in records
+    ]
+    ref_ms = max(heartbeats, default=0.0)
+    lines = [f"== run {run}: liveness ({len(records)} rank(s), "
+             f"straggler factor {factor:g}) =="]
+    lines.append(f"{'rank':<24}  {'watermark':>9}  {'hb_age_ms':>10}  status")
+    for rec, hb in zip(records, heartbeats):
+        ident = rec.get("identity") or {}
+        proc = ident.get("process_index")
+        watermark = float((rec.get("gauges") or {}).get("fleet.watermark", 0))
+        age = f"{ref_ms - hb:.0f}" if hb else "-"
+        hit = flagged_by_proc.get(proc)
+        status = (
+            f"STRAGGLER ({'+'.join(hit['reasons'])})" if hit else "ok"
+        )
+        lines.append(
+            f"{_rank_label(rec):<24}  {watermark:>9g}  {age:>10}  {status}"
+        )
+    return "\n".join(lines), bool(flagged)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Merge per-process metric shards "
+        "(BCG_TPU_METRICS_SHARD_DIR) into fleet tables with per-host "
+        "breakdowns, cross-rank skew, and straggler flags."
+    )
+    parser.add_argument("shards", nargs="+",
+                        help="shard dirs and/or shard-*.jsonl paths")
+    parser.add_argument("--watch", action="store_true",
+                        help="liveness pass: per-rank watermark + "
+                        "heartbeat age; exit 3 when any rank is flagged "
+                        "as a straggler")
+    parser.add_argument("--straggler-factor", type=float, default=3.0,
+                        help="lag factor for --watch flags (default 3)")
+    args = parser.parse_args(argv)
+    problems: List[str] = []
+    records = load_shards(args.shards, problems)
+    if not records:
+        print("fleet_report: no shard records found", file=sys.stderr)
+        for problem in problems:
+            print(f"WARNING: {problem}", file=sys.stderr)
+        return 1
+    runs = group_by_run(records)
+    any_stragglers = False
+    blocks = []
+    for run, group in runs.items():
+        if args.watch:
+            text, flagged = render_watch(run, group, args.straggler_factor)
+            any_stragglers = any_stragglers or flagged
+            blocks.append(text)
+        else:
+            blocks.append(render_run(run, group, problems))
+    print("\n\n".join(blocks))
+    for problem in problems:
+        print(f"WARNING: {problem}", file=sys.stderr)
+    return 3 if (args.watch and any_stragglers) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

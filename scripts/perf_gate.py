@@ -52,8 +52,9 @@ from typing import Dict, List, Optional
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 SCENARIOS = ("serve", "engine", "paged", "sampler", "int4", "consensus",
-             "hlo")
-REGRESSIONS = ("none", "spec-off", "fail-rows", "events-off")
+             "fleet", "hlo")
+REGRESSIONS = ("none", "spec-off", "fail-rows", "events-off",
+               "straggler-off")
 
 DECISION = {
     "type": "object",
@@ -613,6 +614,148 @@ def run_consensus_scenario(inject: str = "none") -> Dict[str, float]:
     }
 
 
+def run_fleet_scenario(inject: str = "none") -> Dict[str, float]:
+    """Distributed observability plane (bcg_tpu/obs/fleet.py +
+    scripts/fleet_report.py) on a REAL 2-process CPU cluster — the
+    tests/_multihost_worker.py coordinator-handshake idiom, but each
+    rank plays a FakeEngine consensus game with metric shards + game
+    events on, and the last rank runs with a FROZEN fleet watermark
+    (fleet.freeze_watermark, the documented chaos hook).  Gated:
+
+    * ``shard_completeness`` — every rank's shard file present for the
+      shared run id;
+    * ``merged_p50_rel_err`` / ``merged_p95_rel_err`` — fleet_report's
+      bucket-wise merge of the ranks' deterministic ``fleet.probe_ms``
+      histograms vs a single-stream oracle bucketing the union of the
+      same values in-process;
+    * ``counter_merge_error`` — the merged ``fleet.probe`` counter vs
+      the exact cross-rank sum the workers incremented;
+    * ``events_dropped`` — the bounded event sinks shed nothing at this
+      scale (summed across ranks from the merged shards);
+    * ``straggler_flagged`` — the HEALTHY rank's runtime straggler pass
+      (fleet.stragglers gauge in its final shard flush) flagged the
+      frozen rank.  ``--inject-regression straggler-off`` disables
+      detection (BCG_TPU_FLEET_STRAGGLER_FACTOR=0): the flag stays 0
+      and the gate must fail naming this metric — detection can never
+      pass vacuously."""
+    import importlib.util
+    import socket
+    import subprocess
+    import tempfile
+    import uuid
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = os.path.join(root, "tests", "_fleet_worker.py")
+    wspec = importlib.util.spec_from_file_location("_fleet_worker", worker)
+    wmod = importlib.util.module_from_spec(wspec)
+    wspec.loader.exec_module(wmod)  # formulas only; main() is guarded
+
+    tmp = tempfile.mkdtemp(prefix="bcg-fleet-gate-")
+    shard_dir = os.path.join(tmp, "shards")
+    run = uuid.uuid4().hex[:12]
+    nproc = 2
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        coord = f"127.0.0.1:{s.getsockname()[1]}"
+    base_env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+        PYTHONPATH=root,
+        BCG_TPU_RUN_ID=run,
+        BCG_TPU_METRICS_SHARD_DIR=shard_dir,
+        BCG_TPU_METRICS_SHARD_MS="100",
+        BCG_TPU_FLEET_STRAGGLER_FACTOR=(
+            "0" if inject == "straggler-off" else "3"
+        ),
+    )
+    procs = []
+    for pid in range(nproc):
+        env = dict(base_env)
+        env["BCG_TPU_GAME_EVENTS"] = os.path.join(
+            tmp, f"events-{pid}.jsonl"
+        )
+        straggle = "1" if pid == nproc - 1 else "0"
+        procs.append(subprocess.Popen(
+            [sys.executable, worker, coord, str(nproc), str(pid), straggle],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env, cwd=root,
+        ))
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"fleet worker rank {pid} failed:\n{out[-3000:]}"
+            )
+
+    fr_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "fleet_report.py"
+    )
+    frspec = importlib.util.spec_from_file_location("fleet_report", fr_path)
+    fr = importlib.util.module_from_spec(frspec)
+    frspec.loader.exec_module(fr)
+    problems: List[str] = []
+    records = [
+        r for r in fr.load_shards([shard_dir], problems)
+        if (r.get("identity") or {}).get("run_id") == run
+    ]
+    for problem in problems:
+        print(f"perf_gate[fleet]: {problem}", file=sys.stderr)
+    merged_counters = fr.merge_counters(records)
+    merged_hists = fr.merge_histograms(records, problems)
+    ranks = {
+        (r.get("identity") or {}).get("process_index") for r in records
+    }
+    completeness = len(ranks) / nproc
+
+    # Single-stream oracle: bucket the UNION of every rank's probe
+    # values through one in-process registry histogram, then compare
+    # fleet_report's merged quantiles against it.
+    from bcg_tpu.obs.counters import Histogram
+
+    oracle = Histogram("fleet.probe_oracle", wmod.PROBE_BOUNDS)
+    for pid in range(nproc):
+        for value in wmod.probe_values(pid):
+            oracle.observe(value)
+    oq = oracle.quantiles()
+    merged_probe = merged_hists.get("fleet.probe_ms")
+    if merged_probe is not None and merged_probe["count"]:
+        mq = fr.histogram_quantiles(merged_probe)
+        p50_err = abs(mq["p50"] - oq["p50"]) / max(1e-9, oq["p50"])
+        p95_err = abs(mq["p95"] - oq["p95"]) / max(1e-9, oq["p95"])
+    else:
+        p50_err = p95_err = 1.0
+
+    probe_total = merged_counters.get("fleet.probe", {}).get("total", 0)
+    expected_probe = sum(100 + pid for pid in range(nproc))
+    drops = (
+        merged_counters.get("game.events_dropped", {}).get("total", 0)
+        + merged_counters.get("serve.events_dropped", {}).get("total", 0)
+    )
+    flagged = 0.0
+    for rec in records:
+        if (rec.get("identity") or {}).get("process_index") == 0:
+            flagged = float(
+                (rec.get("gauges") or {}).get("fleet.stragglers", 0) >= 1
+            )
+    return {
+        "fleet.shard_completeness": completeness,
+        "fleet.merged_p50_rel_err": p50_err,
+        "fleet.merged_p95_rel_err": p95_err,
+        "fleet.counter_merge_error": abs(probe_total - expected_probe),
+        "fleet.events_dropped": float(drops),
+        "fleet.straggler_flagged": flagged,
+    }
+
+
 def run_hlo_scenario(inject: str = "none") -> Dict[str, float]:
     """Kernel-census drift findings (scripts/hlo_census.py) as a gated
     metric — 0 findings = the lowered programs still match
@@ -638,6 +781,7 @@ _RUNNERS = {
     "sampler": run_sampler_scenario,
     "int4": run_int4_scenario,
     "consensus": run_consensus_scenario,
+    "fleet": run_fleet_scenario,
     "hlo": run_hlo_scenario,
 }
 

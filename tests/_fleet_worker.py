@@ -1,0 +1,121 @@
+"""Worker process for the perf-gate "fleet" scenario (not a pytest
+module; launched by scripts/perf_gate.py run_fleet_scenario and
+tests/test_fleet.py).
+
+Runs as one rank of a REAL 2-process JAX CPU cluster (the
+tests/_multihost_worker.py coordinator-handshake idiom): joins the
+process group through bcg_tpu.parallel.distributed.initialize — which
+hands the observability plane its process identity — then exercises the
+fleet plane end to end:
+
+* starts the metric-shard flusher (BCG_TPU_METRICS_SHARD_DIR, set by
+  the launcher together with a shared BCG_TPU_RUN_ID),
+* observes a DETERMINISTIC per-rank probe set into the
+  ``fleet.probe_ms`` histogram and ``fleet.probe`` counter — the
+  launcher recomputes the same formulas as the single-stream oracle the
+  merged shards must match,
+* plays one seeded FakeEngine consensus game with game-event telemetry
+  on (per-rank BCG_TPU_GAME_EVENTS path),
+* straggler arm (argv[4] = 1): freezes this rank's fleet watermark
+  (the documented chaos hook) so the HEALTHY rank's runtime straggler
+  pass must flag it — never vacuously green,
+* rank 0 polls ``fleet.check_stragglers`` until the lagging rank is
+  flagged (or a deadline passes — the gate then fails loudly on
+  ``fleet.straggler_flagged``).
+
+Usage: python tests/_fleet_worker.py <coordinator> <num_procs> <pid> <straggle>
+"""
+
+import sys
+import time
+
+# Per-rank probe distribution — the launcher mirrors these two
+# definitions to build the single-stream oracle; a drift between the
+# two fails the merged-quantile gate loudly.
+PROBE_BOUNDS = (5, 10, 25, 50, 100, 250)
+
+
+def probe_values(rank: int):
+    return [((7 * i + 13 * rank) % 240) + 1 for i in range(50)]
+
+
+def main() -> None:
+    coord, nproc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    straggle = bool(int(sys.argv[4]))
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from bcg_tpu.parallel import distributed
+
+    distributed.initialize(
+        coordinator_address=coord, num_processes=nproc, process_id=pid
+    )
+
+    from bcg_tpu.obs import counters as obs_counters, fleet, game_events
+    from bcg_tpu.runtime import envflags
+
+    writer = fleet.maybe_start_shard_writer()
+    assert writer is not None, "launcher must set BCG_TPU_METRICS_SHARD_DIR"
+    assert fleet.process_index() == pid, fleet.identity()
+    assert fleet.process_count() == nproc, fleet.identity()
+    assert fleet.enabled()
+
+    if straggle:
+        fleet.freeze_watermark()
+
+    hist = obs_counters.histogram("fleet.probe_ms", PROBE_BOUNDS)
+    for value in probe_values(pid):
+        hist.observe(value)
+    obs_counters.inc("fleet.probe", 100 + pid)
+
+    import dataclasses
+
+    from bcg_tpu.config import (
+        BCGConfig, EngineConfig, GameConfig, MetricsConfig, NetworkConfig,
+    )
+    from bcg_tpu.runtime.orchestrator import BCGSimulation
+
+    cfg = dataclasses.replace(
+        BCGConfig(),
+        game=GameConfig(num_honest=4, num_byzantine=1, max_rounds=4,
+                        seed=7 + pid),
+        network=NetworkConfig(topology_type="fully_connected"),
+        engine=EngineConfig(backend="fake"),
+        metrics=MetricsConfig(save_results=False),
+        verbose=False,
+    )
+    sim = BCGSimulation(config=cfg)
+    try:
+        sim.run()
+    finally:
+        sim.close()
+    game_events.reset_sink()  # drain + close this rank's event file
+
+    # Straggler phase: the healthy rank 0 polls detection until the
+    # frozen rank is flagged; other ranks linger so their shards stay
+    # fresh while rank 0 looks.  With detection disabled (factor 0, the
+    # --inject-regression straggler-off arm) rank 0 skips the poll and
+    # the fleet.stragglers gauge never appears — the gate must then
+    # fail loudly on fleet.straggler_flagged.
+    factor = envflags.get_int("BCG_TPU_FLEET_STRAGGLER_FACTOR")
+    if pid == 0 and factor > 0 and nproc > 1:
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            if fleet.check_stragglers(force=True):
+                break
+            time.sleep(0.15)
+    else:
+        time.sleep(1.5)
+    fleet.flush_shards()
+    print(
+        f"FLEET-OK pid={pid} "
+        f"watermark={obs_counters.value('fleet.watermark', 0)} "
+        f"stragglers={obs_counters.value('fleet.stragglers', 0)}",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,5 +1,5 @@
 """Seeded BCG-OBS-NAME violations: metric names off the taxonomy
-(4 findings)."""
+(5 findings)."""
 from bcg_tpu.obs import counters as obs_counters
 
 
@@ -10,3 +10,5 @@ def record(entry):
     #                                               subsystem prefix
     obs_counters.histogram("RoundMs", (1, 5))     # finding 4: histogram
     #                                               names are checked too
+    obs_counters.inc("warp.requests")             # finding 5: unknown
+    #                                               subsystem (namespace fork)

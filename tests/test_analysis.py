@@ -96,7 +96,7 @@ class TestRuleFixtures:
             "BCG-JIT-DONATE": 1,
             "BCG-LOCK-CALL": 3,
             "BCG-TIME-WALL": 3,
-            "BCG-OBS-NAME": 4,
+            "BCG-OBS-NAME": 5,
             "BCG-OBS-BUCKET": 3,
         }
         for rule_id, want in expected.items():

@@ -1,0 +1,555 @@
+"""Fleet observability plane (bcg_tpu/obs/fleet.py +
+scripts/fleet_report.py + the perf-gate "fleet" scenario).
+
+The ISSUE-11 acceptance surface:
+
+* identity stamping is OFF by default — the Prometheus exposition is
+  byte-identical to the unstamped form in a single-process run — and
+  ON under BCG_TPU_FLEET / a shard dir / a multi-process group, where
+  every sample carries ``process=``/``host=`` labels and stays
+  v0.0.4-conformant (scrape-tested on an ephemeral port);
+* the ``/metrics`` port offsets by process_index (the multi-rank local
+  cluster collision fix) and the bound port lands in the run manifest;
+* both JSONL run manifests carry the fleet identity, and ranks of one
+  run share the run id (BCG_TPU_RUN_ID);
+* metric shards round-trip through scripts/fleet_report.py: counters
+  sum, gauges stay per-rank, histograms merge bucket-wise with
+  quantiles matching the in-process registry oracle; the straggler
+  rule's two implementations (runtime + report, mirrored by value)
+  reach the same verdicts;
+* the perf-gate "fleet" scenario is green on a REAL 2-process CPU
+  cluster, its baseline entries are load-bearing (resurface contract
+  owned HERE — test_perf_gate.py skip-lists the fleet.* namespace),
+  and the injected-straggler arm fails loudly when detection is
+  disabled.
+"""
+
+import importlib.util
+import json
+import os
+import socket
+import subprocess
+import sys
+import urllib.request
+
+import pytest
+
+from bcg_tpu.obs import counters as obs_counters, export, fleet
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FLEET_REPORT = os.path.join(REPO, "scripts", "fleet_report.py")
+PERF_GATE = os.path.join(REPO, "scripts", "perf_gate.py")
+
+
+def _load(path, name):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture
+def clean_fleet(monkeypatch):
+    """Fleet state isolated: env cleared, caches dropped before AND
+    after (set_process_provider / run_id / writer are module globals)."""
+    for flag in ("BCG_TPU_FLEET", "BCG_TPU_RUN_ID",
+                 "BCG_TPU_METRICS_SHARD_DIR", "BCG_TPU_METRICS_SHARD_MS"):
+        monkeypatch.delenv(flag, raising=False)
+    fleet.reset()
+    yield
+    fleet.reset()
+
+
+# -------------------------------------------------------------- identity
+class TestIdentity:
+    def test_single_process_default(self, clean_fleet):
+        ident = fleet.identity()
+        assert ident["process_index"] == 0
+        assert ident["process_count"] == 1
+        assert len(ident["run_id"]) == 12
+        assert ident["pid"] == os.getpid()
+        assert not fleet.enabled()
+        assert fleet.prom_label_body() == ""
+
+    def test_run_id_env_shared(self, clean_fleet, monkeypatch):
+        monkeypatch.setenv("BCG_TPU_RUN_ID", "sweep42")
+        assert fleet.run_id() == "sweep42"
+        assert fleet.identity()["run_id"] == "sweep42"
+
+    def test_process_provider_engages_stamping(self, clean_fleet):
+        fleet.set_process_provider(lambda: (3, 8))
+        assert fleet.process_index() == 3
+        assert fleet.process_count() == 8
+        assert fleet.enabled()
+        body = fleet.prom_label_body()
+        assert body.startswith('process="3",host="')
+
+    def test_flag_forces_stamping_single_process(self, clean_fleet,
+                                                 monkeypatch):
+        monkeypatch.setenv("BCG_TPU_FLEET", "1")
+        assert fleet.enabled()
+        assert 'process="0"' in fleet.prom_label_body()
+
+    def test_manifest_carries_identity_and_run_id(self, clean_fleet,
+                                                  monkeypatch):
+        monkeypatch.setenv("BCG_TPU_RUN_ID", "manifestrun")
+        manifest = export.run_manifest(kind="game")
+        assert manifest["run_id"] == "manifestrun"
+        assert manifest["host"] == fleet.identity()["host"]
+        assert manifest["process_index"] == 0
+        assert manifest["process_count"] == 1
+        assert "metrics_port" in manifest  # None while the endpoint is off
+        # Both sinks of one process share the run id.
+        assert export.run_manifest(kind="serve")["run_id"] == "manifestrun"
+
+
+# ------------------------------------------------------------- exposition
+class TestLabeledExposition:
+    TYPED = {
+        "counters": {"serve.requests": 3},
+        "gauges": {"hbm.total_bytes": 1536.5},
+        "histograms": {
+            "serve.e2e_ms": {
+                "buckets": [[5.0, 2], [10.0, 3]], "sum": 17.5, "count": 4,
+            },
+        },
+    }
+
+    def test_byte_identical_when_stamping_off(self, clean_fleet):
+        """Acceptance criterion: with fleet stamping off the exposition
+        is byte-identical to the unstamped (pre-fleet) renderer."""
+        expected = (
+            "# HELP bcg_hbm_total_bytes bcg_tpu registry gauge "
+            "'hbm.total_bytes'\n"
+            "# TYPE bcg_hbm_total_bytes gauge\n"
+            "bcg_hbm_total_bytes 1536.5\n"
+            "# HELP bcg_serve_e2e_ms bcg_tpu registry histogram "
+            "'serve.e2e_ms'\n"
+            "# TYPE bcg_serve_e2e_ms histogram\n"
+            'bcg_serve_e2e_ms_bucket{le="5"} 2\n'
+            'bcg_serve_e2e_ms_bucket{le="10"} 3\n'
+            'bcg_serve_e2e_ms_bucket{le="+Inf"} 4\n'
+            "bcg_serve_e2e_ms_sum 17.5\n"
+            "bcg_serve_e2e_ms_count 4\n"
+            "# HELP bcg_serve_requests_total bcg_tpu registry counter "
+            "'serve.requests'\n"
+            "# TYPE bcg_serve_requests_total counter\n"
+            "bcg_serve_requests_total 3\n"
+        )
+        assert export.render_prometheus(self.TYPED) == expected
+
+    def test_labels_on_every_sample_when_stamping_on(self, clean_fleet):
+        fleet.set_process_provider(lambda: (2, 4))
+        text = export.render_prometheus(self.TYPED)
+        host = fleet.identity()["host"]
+        assert f'bcg_serve_requests_total{{process="2",host="{host}"}} 3' \
+            in text
+        assert f'bcg_hbm_total_bytes{{process="2",host="{host}"}} 1536.5' \
+            in text
+        # Histogram buckets merge identity labels with le; sum/count
+        # take the plain label set.
+        bucket5 = (f'bcg_serve_e2e_ms_bucket'
+                   f'{{process="2",host="{host}",le="5"}} 2')
+        bucket_inf = (f'bcg_serve_e2e_ms_bucket'
+                      f'{{process="2",host="{host}",le="+Inf"}} 4')
+        assert bucket5 in text
+        assert bucket_inf in text
+        assert f'bcg_serve_e2e_ms_sum{{process="2",host="{host}"}} 17.5' \
+            in text
+        # HELP/TYPE metadata lines never carry labels (spec: labels
+        # belong to samples).
+        for line in text.splitlines():
+            if line.startswith("#"):
+                assert "process=" not in line
+
+    def test_labeled_scrape_is_conformant(self, clean_fleet, monkeypatch):
+        """Ephemeral-port scrape with stamping on: every sample line
+        parses as <name>{labels} <value> with v0.0.4 content type."""
+        monkeypatch.setenv("BCG_TPU_FLEET", "1")
+        obs_counters.inc("fleet.probe")
+        server, port = export.start_http_server(0)
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10
+            ) as resp:
+                assert resp.headers["Content-Type"].startswith(
+                    "text/plain; version=0.0.4"
+                )
+                body = resp.read().decode()
+        finally:
+            server.shutdown()
+            server.server_close()
+        import re
+
+        sample = re.compile(
+            r'^[a-zA-Z_:][a-zA-Z0-9_:]*\{[a-zA-Z0-9_]+="[^"]*"'
+            r'(,[a-zA-Z0-9_]+="[^"]*")*\} -?[0-9.e+-]+$'
+        )
+        samples = [l for l in body.splitlines() if not l.startswith("#")]
+        assert samples
+        for line in samples:
+            assert sample.match(line), line
+        assert 'bcg_fleet_probe_total{process="0",host="' in body
+
+    def test_port_offsets_by_process_index(self, clean_fleet, monkeypatch):
+        """Satellite: rank r binds base+r, so every rank of a local
+        cluster is scrapeable instead of warn-and-skipping on the bind
+        collision; the bound port surfaces in the run manifest."""
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            free = s.getsockname()[1]
+        fleet.set_process_provider(lambda: (2, 4))
+        monkeypatch.setenv("BCG_TPU_METRICS_PORT", str(free - 2))
+        export.stop_http_server()
+        try:
+            bound = export.maybe_start_http_server()
+            assert bound == free
+            assert export.current_http_port() == free
+            assert export.run_manifest(kind="serve")["metrics_port"] == free
+        finally:
+            export.stop_http_server()
+
+
+# ------------------------------------------------- watermarks + shard writer
+class TestLivenessAndShards:
+    def test_watermark_advances_and_freezes(self, clean_fleet, monkeypatch):
+        monkeypatch.setenv("BCG_TPU_FLEET", "1")
+        fleet.note_round()
+        fleet.note_dispatch()
+        # clean_fleet reset the internal watermark to 0, so two
+        # advances publish exactly 2 regardless of earlier tests.
+        assert obs_counters.value("fleet.watermark") == 2
+        fleet.freeze_watermark()
+        fleet.note_round()
+        assert obs_counters.value("fleet.watermark") == 2
+
+    def test_watermark_noop_when_stamping_off(self, clean_fleet):
+        before = obs_counters.value("fleet.watermark", -1)
+        fleet.note_round()
+        assert obs_counters.value("fleet.watermark", -1) == before
+
+    def test_shard_writer_roundtrip(self, clean_fleet, monkeypatch,
+                                    tmp_path):
+        monkeypatch.setenv("BCG_TPU_RUN_ID", "shardrun")
+        monkeypatch.setenv("BCG_TPU_METRICS_SHARD_DIR", str(tmp_path))
+        monkeypatch.setenv("BCG_TPU_METRICS_SHARD_MS", "60000")
+        writer = fleet.maybe_start_shard_writer()
+        assert writer is not None
+        assert os.path.basename(writer.path) == "shard-shardrun-0.jsonl"
+        obs_counters.inc("fleet.probe", 5)
+        obs_counters.histogram("fleet.probe_ms", (5, 10, 25, 50, 100, 250))
+        fleet.flush_shards()
+        rec = fleet.read_last_record(writer.path)
+        assert rec["schema_version"] == fleet.SHARD_SCHEMA_VERSION
+        assert rec["identity"]["run_id"] == "shardrun"
+        assert rec["counters"]["fleet.probe"] >= 5
+        assert rec["gauges"]["fleet.heartbeat_ms"] > 0
+        assert rec["gauges"]["fleet.process_count"] == 1
+        assert "fleet.probe_ms" in rec["histograms"]
+        assert fleet.summary()["shard_path"] == writer.path
+
+    def test_read_last_record_skips_truncated_tail(self, tmp_path):
+        path = tmp_path / "shard-x-0.jsonl"
+        good = {"schema_version": 1, "identity": {"process_index": 0}}
+        path.write_text(json.dumps(good) + "\n" + '{"truncated": tr')
+        assert fleet.read_last_record(str(path)) == good
+
+
+# ------------------------------------------------------ straggler detection
+def _record(proc, watermark, hb_ms, flush_ms=100, host="h"):
+    return {
+        "schema_version": 1,
+        "flush_ms": flush_ms,
+        "heartbeat_ms": hb_ms,
+        "identity": {"run_id": "r", "process_index": proc, "host": host},
+        "counters": {},
+        "gauges": {"fleet.watermark": watermark,
+                   "fleet.heartbeat_ms": hb_ms},
+        "histograms": {},
+    }
+
+
+class TestStragglerRule:
+    def test_watermark_lag_flags(self):
+        records = [_record(0, 12, 1000.0), _record(1, 1, 1000.0)]
+        flagged = fleet.detect_stragglers(records, 3, now_ms=1000.0)
+        assert [f["process_index"] for f in flagged] == [1]
+        assert flagged[0]["reasons"] == ["watermark"]
+
+    def test_heartbeat_lag_flags(self):
+        records = [_record(0, 5, 10_000.0), _record(1, 5, 9_000.0)]
+        flagged = fleet.detect_stragglers(records, 3, now_ms=10_000.0)
+        assert [f["process_index"] for f in flagged] == [1]
+        assert flagged[0]["reasons"] == ["heartbeat"]
+
+    def test_factor_zero_disables(self):
+        records = [_record(0, 12, 1000.0), _record(1, 0, 100.0)]
+        assert fleet.detect_stragglers(records, 0, now_ms=1000.0) == []
+
+    def test_single_rank_never_flags(self):
+        assert fleet.detect_stragglers([_record(0, 0, 1.0)], 3) == []
+
+    def test_report_mirror_reaches_same_verdicts(self):
+        """The import-free fleet_report mirror and the runtime rule
+        must agree verdict-for-verdict on the same records."""
+        fr = _load(FLEET_REPORT, "fleet_report_mirror")
+        cases = [
+            [_record(0, 12, 1000.0), _record(1, 1, 1000.0)],
+            [_record(0, 5, 10_000.0), _record(1, 5, 9_000.0)],
+            [_record(0, 6, 1000.0), _record(1, 6, 1000.0)],
+            [_record(0, 0, 1000.0), _record(1, 0, 1000.0)],
+        ]
+        for records in cases:
+            for factor in (0, 2, 3, 10):
+                ours = fleet.detect_stragglers(
+                    records, factor, now_ms=10_000.0
+                )
+                theirs = fr.detect_stragglers(
+                    records, factor, now_ms=10_000.0
+                )
+                assert [f["process_index"] for f in ours] == \
+                    [f["process_index"] for f in theirs], (records, factor)
+                assert [f["reasons"] for f in ours] == \
+                    [f["reasons"] for f in theirs]
+
+    def test_runtime_check_publishes_gauge(self, clean_fleet, monkeypatch,
+                                           tmp_path):
+        """check_stragglers reads PEER shards from the dir and exports
+        fleet.stragglers — the serve scheduler's per-dispatch hook."""
+        monkeypatch.setenv("BCG_TPU_RUN_ID", "livecheck")
+        monkeypatch.setenv("BCG_TPU_METRICS_SHARD_DIR", str(tmp_path))
+        monkeypatch.setenv("BCG_TPU_METRICS_SHARD_MS", "60000")
+        monkeypatch.setenv("BCG_TPU_FLEET", "1")
+        for _ in range(8):
+            fleet.note_round()
+        fleet.flush_shards()
+        # A lagging peer rank appears in the shard dir.
+        lagging = _record(1, 0, 50.0)
+        lagging["identity"]["run_id"] = "livecheck"
+        (tmp_path / "shard-livecheck-1.jsonl").write_text(
+            json.dumps(lagging) + "\n"
+        )
+        flagged = fleet.check_stragglers(force=True)
+        assert [f["process_index"] for f in flagged] == [1]
+        assert obs_counters.value("fleet.stragglers") == 1
+
+
+# ----------------------------------------------------------- shard merging
+class TestFleetReportMerge:
+    BOUNDS = (5.0, 10.0, 25.0, 50.0)
+
+    def _shard(self, proc, values, counter, gauge, host):
+        hist = obs_counters.Histogram(f"probe{proc}", self.BOUNDS)
+        for v in values:
+            hist.observe(v)
+        return {
+            "schema_version": 1,
+            "flush_ms": 100,
+            "heartbeat_ms": 1000.0,
+            "identity": {"run_id": "merge", "process_index": proc,
+                         "host": host},
+            "counters": {"game.rounds": counter},
+            "gauges": {"fleet.watermark": gauge},
+            "histograms": {
+                "game.round_ms": {
+                    "buckets": [[b, c] for b, c in hist.cumulative()],
+                    "sum": hist.sum,
+                    "count": hist.count,
+                },
+            },
+        }
+
+    def test_counters_sum_with_skew_and_hosts(self):
+        fr = _load(FLEET_REPORT, "fleet_report_merge")
+        records = [
+            self._shard(0, [], 10, 5, "host-a"),
+            self._shard(1, [], 30, 6, "host-b"),
+        ]
+        merged = fr.merge_counters(records)
+        row = merged["game.rounds"]
+        assert row["total"] == 40
+        assert row["per_host"] == {"host-a": 10, "host-b": 30}
+        assert row["median_rank"] == 20
+        assert row["p95_rank"] == 30
+        assert row["skew"] == 1.5
+        gauges = fr.merge_gauges(records)
+        assert gauges["fleet.watermark"] == {
+            "0@host-a": 5, "1@host-b": 6,
+        }
+
+    def test_histogram_merge_matches_single_stream_oracle(self):
+        """Bucket-wise merge of two ranks' histograms must produce the
+        same quantiles as one registry histogram observing the union —
+        the perf-gate fleet scenario's oracle contract, unit-scale."""
+        fr = _load(FLEET_REPORT, "fleet_report_hist")
+        values_a = [2, 7, 7, 12, 30]
+        values_b = [4, 8, 20, 45, 45, 60]
+        records = [
+            self._shard(0, values_a, 0, 0, "a"),
+            self._shard(1, values_b, 0, 0, "b"),
+        ]
+        problems = []
+        merged = fr.merge_histograms(records, problems)["game.round_ms"]
+        assert problems == []
+        assert merged["count"] == len(values_a) + len(values_b)
+        oracle = obs_counters.Histogram("oracle", self.BOUNDS)
+        for v in values_a + values_b:
+            oracle.observe(v)
+        got = fr.histogram_quantiles(merged)
+        want = oracle.quantiles()
+        for q in ("p50", "p95", "p99"):
+            assert got[q] == pytest.approx(want[q], rel=1e-9), q
+
+    def test_bound_mismatch_is_reported_not_blended(self):
+        fr = _load(FLEET_REPORT, "fleet_report_bounds")
+        a = self._shard(0, [2], 0, 0, "a")
+        b = self._shard(1, [2], 0, 0, "b")
+        b["histograms"]["game.round_ms"]["buckets"] = [[1.0, 1], [99.0, 1]]
+        problems = []
+        merged = fr.merge_histograms([a, b], problems)["game.round_ms"]
+        assert merged["count"] == 1  # rank b skipped, not blended
+        assert problems and "bounds" in problems[0]
+
+    def test_cli_report_and_watch(self, tmp_path):
+        """Script smoke: fleet table on merged shards (rc 0), --watch
+        flags the lagging rank (rc 3), and the script keeps the
+        bcg_tpu-import-free contract."""
+        healthy = self._shard(0, [2, 7], 10, 8, "host-a")
+        lagging = self._shard(1, [4], 30, 0, "host-b")
+        (tmp_path / "shard-merge-0.jsonl").write_text(
+            json.dumps(healthy) + "\n"
+        )
+        (tmp_path / "shard-merge-1.jsonl").write_text(
+            json.dumps(lagging) + "\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, FLEET_REPORT, str(tmp_path)],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "run merge: 2 rank(s) on 2 host(s)" in proc.stdout
+        assert "game.rounds" in proc.stdout
+        assert "host-a=10 host-b=30" in proc.stdout
+        assert "game.round_ms" in proc.stdout
+        watch = subprocess.run(
+            [sys.executable, FLEET_REPORT, str(tmp_path), "--watch"],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert watch.returncode == 3, watch.stdout + watch.stderr
+        assert "STRAGGLER" in watch.stdout
+        assert "1@host-b" in watch.stdout
+        src = open(FLEET_REPORT).read()
+        assert "import bcg_tpu" not in src and "from bcg_tpu" not in src
+
+
+# ------------------------------------------------- consensus_report grouping
+class TestConsensusReportRunGrouping:
+    def test_two_rank_files_of_one_run_merge_into_one_row(self, tmp_path):
+        """Satellite: rank files sharing a stamped run_id report as ONE
+        run (ranks=2), not two independent runs."""
+        report = _load(
+            os.path.join(REPO, "scripts", "consensus_report.py"),
+            "consensus_report_fleet",
+        )
+        for proc in (0, 1):
+            lines = [
+                {"event": "manifest", "schema_version": 1,
+                 "run_id": "fleetrun", "process_index": proc,
+                 "host": f"host-{proc}", "flags": {}},
+                {"event": "game_start", "game": "g1", "round": None,
+                 "num_honest": 4, "num_byzantine": 1,
+                 "topology": "fully_connected"},
+                {"event": "round_end", "game": "g1", "round": 1,
+                 "has_consensus": True, "byzantine_influence": 0,
+                 "duration_ms": 2.0},
+                {"event": "game_end", "game": "g1", "round": 1,
+                 "converged": True, "rounds": 1,
+                 "byzantine_influence": 0},
+            ]
+            (tmp_path / f"ev-{proc}.jsonl").write_text(
+                "\n".join(json.dumps(l) for l in lines) + "\n"
+            )
+        problems = []
+        games = []
+        for proc in (0, 1):
+            games.extend(
+                report.parse_file(str(tmp_path / f"ev-{proc}.jsonl"),
+                                  problems)
+            )
+        out = report.render_report(games, problems)
+        rows = [l for l in out.splitlines() if "fully_connected" in l]
+        assert len(rows) == 1, out  # ONE row for the run, not two
+        fields = rows[0].split()
+        assert fields[0] == "1"  # runs column: ONE run, not two
+        assert fields[1] == "2"  # ranks column: two contributing ranks
+        assert "100.0%" in rows[0]
+
+
+# --------------------------------------------------- gate-backed (2-process)
+@pytest.fixture(scope="module")
+def fleet_gate():
+    mod = _load(PERF_GATE, "perf_gate_fleet")
+    measured = mod.run_fleet_scenario()
+    return mod, measured
+
+
+class TestFleetGate:
+    def test_green_at_head(self, fleet_gate):
+        """Acceptance criterion: the fleet scenario is green on a real
+        2-process CPU cluster — all-rank shard completeness, merged
+        quantiles matching the single-stream oracle, zero drops, and
+        the frozen rank flagged."""
+        mod, measured = fleet_gate
+        findings = mod.check_metrics(measured, mod.load_baseline())
+        findings += mod.check_stale(measured, mod.load_baseline(),
+                                    ("fleet",))
+        assert findings == [], "\n".join(findings)
+
+    def test_advertised_metrics_measured(self, fleet_gate):
+        _, measured = fleet_gate
+        assert sorted(measured) == [
+            "fleet.counter_merge_error",
+            "fleet.events_dropped",
+            "fleet.merged_p50_rel_err",
+            "fleet.merged_p95_rel_err",
+            "fleet.shard_completeness",
+            "fleet.straggler_flagged",
+        ]
+
+    def test_hard_contracts(self, fleet_gate):
+        _, measured = fleet_gate
+        assert measured["fleet.shard_completeness"] == 1.0
+        assert measured["fleet.counter_merge_error"] == 0
+        assert measured["fleet.events_dropped"] == 0
+        assert measured["fleet.straggler_flagged"] == 1.0
+
+    def test_removing_a_fleet_entry_resurfaces_its_finding(self,
+                                                           fleet_gate):
+        """Resurface contract for the fleet.* namespace (skip-listed in
+        test_perf_gate.py; owned here)."""
+        mod, measured = fleet_gate
+        baseline = mod.load_baseline()
+        fleet_entries = [
+            n for n in baseline["metrics"] if n.startswith("fleet.")
+        ]
+        assert sorted(fleet_entries) == sorted(measured)
+        for removed in fleet_entries:
+            pruned = json.loads(json.dumps(baseline))
+            del pruned["metrics"][removed]
+            findings = mod.check_metrics(measured, pruned)
+            assert any(
+                removed in f and "no entry" in f for f in findings
+            ), (removed, findings)
+
+    def test_straggler_detection_disabled_fails_loudly(self, fleet_gate):
+        """Acceptance criterion: with detection disabled
+        (BCG_TPU_FLEET_STRAGGLER_FACTOR=0) the injected-straggler arm
+        must FAIL naming fleet.straggler_flagged — never vacuously
+        green."""
+        mod, _ = fleet_gate
+        measured = mod.run_fleet_scenario(inject="straggler-off")
+        assert measured["fleet.straggler_flagged"] == 0.0
+        findings = mod.check_metrics(measured, mod.load_baseline())
+        hits = [f for f in findings if "fleet.straggler_flagged" in f]
+        assert hits, findings
+        assert ">=" in hits[0]

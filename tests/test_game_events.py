@@ -278,12 +278,21 @@ class TestConsensusReport:
         assert proc.returncode == 0, proc.stderr
         out = proc.stdout
         assert "consensus outcomes by config" in out
+        # Actual table rows (start with the numeric runs column), not
+        # warnings or headers that happen to mention a topology.
         table_rows = [
             l for l in out.splitlines()
             if l.strip() and l.lstrip()[0].isdigit()
+            and ("fully_connected" in l or "ring" in l)
         ]
         assert any("fully_connected" in l for l in table_rows)
         assert any("ring" in l for l in table_rows)
+        # Both files came from ONE process (shared per-process run id +
+        # stamped rank): each config row reports exactly 1 run and 1
+        # contributing rank, not an anonymous pile of files.
+        assert len(table_rows) == 2
+        for row in table_rows:
+            assert row.split()[:2] == ["1", "1"], row
         assert "100.0%" in out            # both seeded games converge
         assert "rounds-to-consensus distribution" in out
         assert "round duration" in out

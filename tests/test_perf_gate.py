@@ -150,17 +150,21 @@ class TestBaselineLoadBearing:
                 continue  # exercised by tests/test_guided_sampler.py
             if name.startswith("int4."):
                 continue  # exercised by tests/test_int4_kv.py
+            if name.startswith("fleet."):
+                continue  # exercised by tests/test_fleet.py
             assert name in measured, name
 
     def test_removing_an_entry_resurfaces_its_finding(self, gate):
         mod, measured = gate
         baseline = mod.load_baseline()
         for removed in baseline["metrics"]:
-            if removed.startswith(("hlo.", "paged.", "sampler.", "int4.")):
-                # hlo: tests/test_hlo_census.py; paged/sampler/int4:
-                # the same resurface contract is asserted by their own
-                # test files over their scenarios (test_paged_kv.py,
-                # test_guided_sampler.py, test_int4_kv.py).
+            if removed.startswith(("hlo.", "paged.", "sampler.", "int4.",
+                                   "fleet.")):
+                # hlo: tests/test_hlo_census.py; paged/sampler/int4/
+                # fleet: the same resurface contract is asserted by
+                # their own test files over their scenarios
+                # (test_paged_kv.py, test_guided_sampler.py,
+                # test_int4_kv.py, test_fleet.py).
                 continue
             pruned = json.loads(json.dumps(baseline))
             del pruned["metrics"][removed]

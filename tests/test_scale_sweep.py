@@ -1,0 +1,59 @@
+"""scripts/scale_sweep.py smoke — the one-agent-per-chip sweep driver
+had NO test coverage: a schema drift in its JSON line (the thing sweep
+harnesses and BASELINE config 4 consume) or a dp-derivation bug would
+only surface on hardware.
+
+Runs the real script as a subprocess on a virtual 8-CPU-device mesh
+(the hermetic invocation its own docstring advertises) with a tiny
+model/window, and pins the emitted JSON schema: every advertised key
+present, throughput fields populated (> 0), and the mesh layout fields
+consistent with the requested agent count.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "scale_sweep.py")
+
+# Every key the script's docstring + BASELINE config 4 harnesses rely on.
+EXPECTED_KEYS = {
+    "agents", "devices", "dp", "model", "rounds", "rounds_per_sec",
+    "decisions_per_sec", "dp_batches", "dp_bypasses", "sp_bypasses",
+    "spmd_mesh_dp", "consensus",
+}
+
+
+def test_scale_sweep_emits_schema_on_virtual_devices():
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8",
+        PYTHONPATH=REPO,
+    )
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, "--agents", "8", "--rounds", "2",
+         "--max-model-len", "256", "--decide-tokens", "24",
+         "--vote-tokens", "16"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    # The LAST stdout line is the one JSON row (stage noise may precede).
+    json_lines = [
+        l for l in proc.stdout.splitlines() if l.strip().startswith("{")
+    ]
+    assert json_lines, proc.stdout
+    row = json.loads(json_lines[-1])
+    assert EXPECTED_KEYS <= set(row), sorted(row)
+    assert row["agents"] == 8
+    assert row["devices"] == 8
+    # dp is the largest divisor of the agent count that fits the mesh.
+    assert row["dp"] == 8
+    assert row["spmd_mesh_dp"] == 8          # --spmd-exchange layout
+    assert 1 <= row["rounds"] <= 2
+    assert row["rounds_per_sec"] > 0
+    assert row["decisions_per_sec"] > 0
+    assert row["dp_batches"] >= 1            # batches actually sharded
+    assert isinstance(row["consensus"], bool)
