@@ -12,7 +12,9 @@ Rule catalog (stable IDs — see DESIGN.md "Static analysis pass"):
 
 * ``BCG-HOST-SYNC``     host↔device sync (``.item()``, ``device_get``,
                         ``block_until_ready``, ``np.asarray``) inside a
-                        jitted region or a ``lax`` loop body
+                        jitted region or a ``lax`` loop body (runtime
+                        complement: obs/hostsync.py, which counts the
+                        eager seams this rule cannot see)
 * ``BCG-JIT-NP``        other ``np.*`` calls inside jitted regions
 * ``BCG-JIT-BRANCH``    Python ``if``/``while`` on a (non-static) traced
                         parameter of a jitted function

@@ -92,7 +92,15 @@ def rule_host_sync(ctx: ModuleContext) -> Iterable[Finding]:
     ``jax.device_get``, ``block_until_ready``, ``np.asarray``/``np.array``.
     Inside jit these either fail at trace time or silently force a
     device round-trip per retrace — in the decode loop that is a stall
-    per token step."""
+    per token step.
+
+    Runtime complement: ``bcg_tpu/obs/hostsync.py``
+    (``BCG_TPU_HOSTSYNC``) counts and attributes the syncs the running
+    system actually performs at the EAGER seams this AST rule cannot
+    see, and every justified suppression of this rule in
+    ``lint_baseline.json`` must register its runtime verification in
+    ``tests/test_hostsync.py`` (HOST_SYNC_SUPPRESSION_COVERAGE) — the
+    static and runtime views are cross-linked, not parallel."""
     for node in ast.walk(ctx.tree):
         if not isinstance(node, ast.Call) or not ctx.in_jit_region(node):
             continue

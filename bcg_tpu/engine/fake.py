@@ -49,7 +49,12 @@ from collections import Counter
 from typing import Any, Dict, List, Tuple
 
 from bcg_tpu.engine.interface import InferenceEngine
-from bcg_tpu.obs import counters as obs_counters, tracer as obs_tracer
+from bcg_tpu.obs import (
+    counters as obs_counters,
+    hostsync as obs_hostsync,
+    tracer as obs_tracer,
+)
+from bcg_tpu.runtime import envflags
 
 # Matches per-agent proposal lines in round summaries ("agent_3 value: 17"),
 # not the agent's own "Your current value: N" line.
@@ -148,6 +153,17 @@ class FakeEngine(InferenceEngine):
                 if isinstance(user_prompt, tuple):  # (shared_core, tail)
                     user_prompt = "".join(user_prompt)
                 rows.append((system_prompt, user_prompt, schema))
+            # Hermetic host-sync mirror (the engine.spec.* idiom): one
+            # batched JaxEngine call performs exactly these device->host
+            # materializations — the prefill timing barrier, then the
+            # decode-loop output + step-count readbacks below.  Mirrored
+            # here so a FakeEngine game carries the REAL loop's
+            # syncs-per-round structure (2 batched calls x 3 syncs per
+            # lockstep round), which is the baseline ROADMAP item 2's
+            # on-device mega-round must drive toward ~1 — perf_gate's
+            # 'hostsync' scenario pins it (no-ops unless
+            # BCG_TPU_HOSTSYNC is on).
+            obs_hostsync.note("prefill_barrier", entry="prefill")
         out = []
         with obs_tracer.span("engine.decode", args={"rows": len(rows)}):
             for system_prompt, user_prompt, schema in rows:
@@ -158,7 +174,17 @@ class FakeEngine(InferenceEngine):
                     )
                 else:
                     out.append(self._respond(system_prompt, user_prompt, schema))
+            # Spec-on calls run the real engine's spec loop, so ALL
+            # post-loop readbacks attribute to its entry name there —
+            # mirror the same attribution (jax_engine.py loop_entry).
+            loop_entry = (
+                "spec_decode_loop"
+                if envflags.get_bool("BCG_TPU_SPEC") else "decode_loop"
+            )
+            obs_hostsync.note("decode_readback", entry=loop_entry)
+            obs_hostsync.note("steps_readback", entry=loop_entry)
         self._mirror_speculation(rows, out)
+        obs_hostsync.publish()
         return out
 
     def _mirror_speculation(self, rows, results) -> None:
@@ -197,6 +223,11 @@ class FakeEngine(InferenceEngine):
                 )
                 drafted += d
                 accepted += a
+        # Host-sync mirror of the spec arm: the real spec loop reads the
+        # drafted/accepted vectors back (2 extra materializations per
+        # call — jax_engine.py spec_readback), so a spec-on hermetic
+        # game must carry 5 syncs/call, not the plain loop's 3.
+        obs_hostsync.note("spec_readback", n=2, entry="spec_decode_loop")
         if drafted:
             obs_counters.inc("engine.spec.drafted", drafted)
             obs_counters.inc("engine.spec.accepted", accepted)

@@ -50,6 +50,7 @@ from bcg_tpu.config import env_flag
 from bcg_tpu.obs import (
     counters as obs_counters,
     hlo as obs_hlo,
+    hostsync as obs_hostsync,
     ledger as obs_ledger,
     tracer as obs_tracer,
 )
@@ -2807,6 +2808,7 @@ class JaxEngine(InferenceEngine):
             # Always sync here: prefill/decode wall-clock split feeds the
             # achieved-GB/s / MFU accounting (the extra host round-trip is a
             # few ms against multi-hundred-ms phases).
+            obs_hostsync.note("prefill_barrier", entry="prefill")
             first_logits.block_until_ready()
         t1 = time.perf_counter()
 
@@ -2830,6 +2832,14 @@ class JaxEngine(InferenceEngine):
             census_prefix = ""
         if self._sampler_loop_impl != "xla":
             census_prefix += "fused_"
+        # Host-sync attribution entry: the census name of the decode
+        # loop this call executes — what the auditor attributes the
+        # post-loop readbacks to when tracing is off.
+        loop_entry = census_prefix + (
+            "spec_decode_loop" if use_spec
+            else "ff_decode_loop" if use_ff
+            else "decode_loop"
+        )
         if paged:
             self._paged_dirty = True  # pool rides the donated loop call
         with obs_tracer.span("engine.decode",
@@ -2943,6 +2953,7 @@ class JaxEngine(InferenceEngine):
                 self._paged.adopt(_cache_out)
                 self._paged_dirty = False
             del _cache_out  # dense: dropped immediately (aliasing only)
+            obs_hostsync.note("decode_readback", entry=loop_entry)
             out_np = np.asarray(out)
         t2 = time.perf_counter()
         if not self._first_call_recorded:
@@ -2953,6 +2964,7 @@ class JaxEngine(InferenceEngine):
             self._first_call_recorded = True
         # Observability: decode-loop iterations of the last call (each is
         # one weight pass — the wall-clock unit of the decode phase).
+        obs_hostsync.note("steps_readback", entry=loop_entry)
         self.last_decode_steps = int(steps)
         self.total_decode_steps += int(steps)
         if self._sampler_loop_impl != "xla":
@@ -2968,6 +2980,7 @@ class JaxEngine(InferenceEngine):
             # but keys are only created once something drafted, so a
             # spec-off engine's counter namespace stays byte-identical
             # to HEAD's.
+            obs_hostsync.note("spec_readback", n=2, entry=loop_entry)
             spec_drafted = int(np.asarray(drafted)[:real_B].sum())
             spec_accepted = int(np.asarray(accepted)[:real_B].sum())
             if spec_drafted:
@@ -2976,6 +2989,10 @@ class JaxEngine(InferenceEngine):
                 obs_counters.inc(
                     "engine.spec.rejected", spec_drafted - spec_accepted
                 )
+        # Refresh LAST_HOSTSYNC once per generation call (no-op when
+        # the auditor is off) — a crash after this call keeps the sync
+        # profile in the bench error JSON.
+        obs_hostsync.publish()
         # Perf accounting.  Decode streams the whole ALLOCATED cache
         # window every step (einsum and Pallas paths both read all S
         # slots, masked), plus one full weight pass per loop iteration.

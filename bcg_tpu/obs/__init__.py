@@ -19,6 +19,11 @@ slabs / prefix entries / spec slots (``hbm.*`` gauges).
 ``bcg_tpu.obs.export`` — Prometheus text exposition, the
 ``BCG_TPU_SERVE_EVENTS`` request-lifecycle JSONL sink, and the
 ``BCG_TPU_METRICS_PORT`` HTTP ``/metrics`` endpoint.
+``bcg_tpu.obs.hostsync`` — runtime host↔device transfer auditor
+(``BCG_TPU_HOSTSYNC``): per-sync span/jit-entry attribution
+(``engine.hostsync.*``), the ``game.host_syncs`` per-round histogram,
+and the perf_gate ``hostsync`` drift gate for ROADMAP item 2's
+host-syncs-per-round target.
 
 None of these modules import jax at module scope: flag-only consumers
 (bench.py's error path) stay light.  Enable tracing with
@@ -26,8 +31,8 @@ None of these modules import jax at module scope: flag-only consumers
 taxonomy and the device-cost subsection.
 """
 
-from bcg_tpu.obs import counters, export, hlo, ledger, tracer  # noqa: F401
+from bcg_tpu.obs import counters, export, hlo, hostsync, ledger, tracer  # noqa: F401
 
 # game_events is NOT imported eagerly: it pulls game.statistics, which
 # flag-only consumers never need; the orchestrator imports it directly.
-__all__ = ["counters", "export", "hlo", "ledger", "tracer"]
+__all__ = ["counters", "export", "hlo", "hostsync", "ledger", "tracer"]

@@ -185,6 +185,18 @@ _register(
     "eviction).",
 )
 
+_register(
+    "BCG_TPU_HOSTSYNC", "bool", False,
+    "Runtime host-sync auditor (bcg_tpu/obs/hostsync.py): count every "
+    "device->host materialization at the instrumented decode-path "
+    "seams (plus intercepted jax.device_get), attributed to the active "
+    "tracer span or jit entry — engine.hostsync.* counters, the "
+    "game.host_syncs per-round histogram, and the perf_gate 'hostsync' "
+    "scenario's syncs-per-round baseline (ROADMAP item 2's target "
+    "metric).  Off: zero surface — nothing registered, nothing "
+    "intercepted.",
+)
+
 # BCG_TPU_HLO_CENSUS / METRICS / EVENTS — device-cost observability
 # (bcg_tpu/obs: hlo.py, export.py, ledger.py).
 _register(

@@ -92,6 +92,23 @@ def publish_game_stats(snapshot: Optional[Dict]) -> None:
     LAST_GAME_STATS = snapshot
 
 
+# Latest host-sync auditor summary (obs/hostsync.summary: total/
+# attributed device->host transfers, per-site and per-span attribution
+# tables, syncs per round) — published by the auditor after each
+# generation call and each observed round so bench.py can attach the
+# sync profile on success AND error paths, mirroring LAST_SERVE_STATS.
+# None until the auditor runs (i.e. always None unless BCG_TPU_HOSTSYNC
+# is set).
+LAST_HOSTSYNC: Optional[Dict] = None
+
+
+def publish_hostsync(snapshot: Optional[Dict]) -> None:
+    """Record the most recent host-sync summary (called by
+    ``obs.hostsync.HostSyncAuditor.publish``)."""
+    global LAST_HOSTSYNC
+    LAST_HOSTSYNC = snapshot
+
+
 def _device_memory():
     """(bytes_in_use, peak_bytes_in_use) as the MAX across all devices,
     or (None, None) where the backend exposes no allocator stats (CPU).

@@ -41,6 +41,7 @@ from bcg_tpu.engine.interface import InferenceEngine, create_engine
 from bcg_tpu.game import ByzantineConsensusGame
 from bcg_tpu.obs import fleet as obs_fleet
 from bcg_tpu.obs import game_events as obs_game_events
+from bcg_tpu.obs import hostsync as obs_hostsync
 from bcg_tpu.obs import tracer as obs_tracer
 from bcg_tpu.runtime import envflags
 from bcg_tpu.runtime.logging import RunLogger
@@ -495,12 +496,33 @@ class BCGSimulation:
         phase blocks below open ``decide``/``broadcast``/``receive``/
         ``vote`` child spans, so one game round reads as one nested
         slice group in a Perfetto trace.
+
+        When the host-sync auditor is on (BCG_TPU_HOSTSYNC), the
+        device->host transfers observed inside the round span land in
+        the ``game.host_syncs`` per-round histogram — ROADMAP item 2's
+        target metric (host-syncs per round -> ~1), measured where the
+        round actually runs.  Rounds of concurrent games overlapping in
+        one process are counted (engine.hostsync.rounds_overlapped)
+        instead of observed — the process-wide total cannot split a
+        shared dispatch batch's syncs between games.
         """
-        with obs_tracer.span(
-            "round",
-            args={"round": self.game.current_round, "sim": self._sim_uid},
-        ):
-            self._run_round()
+        audit = obs_hostsync.auditor()
+        window = audit.begin_round() if audit is not None else None
+        try:
+            with obs_tracer.span(
+                "round",
+                args={"round": self.game.current_round, "sim": self._sim_uid},
+            ):
+                self._run_round()
+        except BaseException:
+            # Discard without observing: a partial round's sync count
+            # is not a round observation, but the window MUST come off
+            # the open list or every later round reads overlapped.
+            if audit is not None:
+                audit.end_round(window, observe=False)
+            raise
+        if audit is not None:
+            audit.end_round(window)
 
     def _run_round(self) -> None:
         round_num = self.game.current_round

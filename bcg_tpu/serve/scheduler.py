@@ -47,6 +47,7 @@ from bcg_tpu.obs import (
     counters as obs_counters,
     export as obs_export,
     fleet as obs_fleet,
+    hostsync as obs_hostsync,
     ledger as obs_ledger,
     tracer as obs_tracer,
 )
@@ -165,6 +166,12 @@ class SchedulerStats:
         self.max_queue_rows = 0
         self.slo_ms = max(0, slo_ms)
         self.slo_violations = 0
+        # Host-sync accounting (BCG_TPU_HOSTSYNC): device->host
+        # transfers observed across THIS scheduler's engine dispatches
+        # (auditor-total deltas read INSIDE the device lock, bracketing
+        # only the engine call; see _dispatch for the shared-total
+        # caveat under concurrent non-serve auditing).
+        self.dispatch_syncs = 0
         self.lat = SpanAggregator()
         self._hists = {
             "queue_wait": obs_counters.histogram(
@@ -308,6 +315,25 @@ class SchedulerStats:
             # headroom + radix prefix hit rate — the block-level
             # counterpart of row_cap on paged engines (None on dense).
             "kv_pool": kv_pool,
+            # Host-sync view (BCG_TPU_HOSTSYNC): device->host transfers
+            # this scheduler's dispatches performed, normalized per
+            # dispatch and per completed request — the serve-side form
+            # of ROADMAP item 2's syncs-per-round metric.  None when
+            # the auditor is off (kv_pool idiom).
+            "hostsync": (
+                {
+                    "syncs": self.dispatch_syncs,
+                    "syncs_per_dispatch": (
+                        round(self.dispatch_syncs / self.dispatches, 4)
+                        if self.dispatches else None
+                    ),
+                    "syncs_per_request": (
+                        round(self.dispatch_syncs / self.completed, 4)
+                        if self.completed else None
+                    ),
+                }
+                if obs_hostsync.enabled() else None
+            ),
         }
 
     def _spec_snapshot(self) -> Optional[Dict[str, Any]]:
@@ -665,6 +691,8 @@ class Scheduler:
             # (collective.py idiom).
             temperature = temps[0] if len(set(temps)) == 1 else temps
             max_tokens = budgets[0] if len(set(budgets)) == 1 else budgets
+        audit = obs_hostsync.auditor()
+        dispatch_syncs = 0
         try:
             device_t0 = time.monotonic()
             with obs_tracer.span("serve.device", parent=anchor,
@@ -672,6 +700,14 @@ class Scheduler:
                                  args={"rows": len(merged),
                                        "requests": len(batch)}):
                 with self._device_lock:
+                    # Host-sync delta over the engine call only, read
+                    # inside the lock so other dispatches through THIS
+                    # scheduler can never land in the window.  Still a
+                    # process-wide total: a direct-engine thread or a
+                    # second scheduler auditing concurrently is counted
+                    # here too (the can't-split-a-shared-total caveat
+                    # the round path resolves with rounds_overlapped).
+                    syncs_before = audit.total() if audit is not None else 0
                     if sig[0] == "json":
                         # The device lock guards ONLY the engine call; it
                         # is never held together with the queue cond nor
@@ -689,6 +725,8 @@ class Scheduler:
                             merged, temperature=temperature,
                             max_tokens=max_tokens, top_p=sig[1],
                         )
+                    if audit is not None:
+                        dispatch_syncs = audit.total() - syncs_before
             device_s = time.monotonic() - device_t0
             device_ms = round(device_s * 1e3, 3)
             self.stats.record_device_time(device_s)
@@ -713,6 +751,7 @@ class Scheduler:
                 self.stats.dispatches += 1
                 self.stats.dispatched_rows += len(merged)
                 self.stats.slo_violations += slo_violations
+                self.stats.dispatch_syncs += dispatch_syncs
             obs_counters.inc("serve.dispatches")
             obs_counters.inc("serve.dispatched_rows", len(merged))
             if slo_violations:
@@ -726,6 +765,9 @@ class Scheduler:
                 self.stats.engine_errors += 1
                 self.stats.dispatches += 1
                 self.stats.dispatched_rows += len(merged)
+                # 0 when the engine call itself died mid-window — a
+                # failed dispatch's partial delta is not charged.
+                self.stats.dispatch_syncs += dispatch_syncs
             obs_counters.inc("serve.dispatches")
             obs_counters.inc("serve.dispatched_rows", len(merged))
             obs_counters.inc("serve.engine_errors")

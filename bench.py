@@ -159,7 +159,7 @@ _CONFIG_OVERRIDE_ENVS = (
     "BCG_TPU_PAGED_KV_IMPL", "BCG_TPU_PAGED_PAGES_PER_PROGRAM",
     "BCG_TPU_GAME_EVENTS", "BCG_TPU_SERVE_SLO_MS",
     "BCG_TPU_FLEET", "BCG_TPU_METRICS_SHARD_DIR",
-    "BCG_TPU_FLEET_STRAGGLER_FACTOR",
+    "BCG_TPU_FLEET_STRAGGLER_FACTOR", "BCG_TPU_HOSTSYNC",
     # BCG_TPU_RUN_ID / BCG_TPU_METRICS_SHARD_MS stay out: a run label
     # and a flush period are provenance/measurement knobs, not a change
     # to the served configuration.
@@ -243,6 +243,21 @@ def _game_stats_or_none():
         from bcg_tpu.runtime import metrics as _metrics
 
         return _metrics.LAST_GAME_STATS
+    except Exception:
+        # Inside the never-rc=1 contract (see _obs_payload).
+        return None
+
+
+def _hostsync_stats_or_none():
+    """Host-sync auditor summary (syncs per phase site, syncs/round,
+    top attribution spans) when BCG_TPU_HOSTSYNC audited the window;
+    None otherwise.  Read from runtime.metrics (not the auditor object)
+    so the ERROR path — where no engine handle survives — keeps the
+    sync profile the completed calls already published."""
+    try:
+        from bcg_tpu.runtime import metrics as _metrics
+
+        return _metrics.LAST_HOSTSYNC
     except Exception:
         # Inside the never-rc=1 contract (see _obs_payload).
         return None
@@ -349,6 +364,12 @@ def _error_result(exc: BaseException, retried: bool) -> dict:
     game_stats = _game_stats_or_none()
     if game_stats:
         out["game_stats"] = game_stats
+    # Host-sync profile of the failed attempt (syncs per site,
+    # syncs/round, attribution spans) — same mid-crash-forensics idiom
+    # as serve_stats/kv_pool.
+    hostsync_stats = _hostsync_stats_or_none()
+    if hostsync_stats:
+        out["hostsync"] = hostsync_stats
     # Fleet identity of the failed attempt (which rank, which shard
     # file, heartbeat age at death) — the line a multi-host sweep's
     # post-mortem greps for.
@@ -771,6 +792,10 @@ def _run_attempt(cfg, model: str, backend: str, concurrency: int,
             # BCG_TPU_GAME_EVENTS: cumulative consensus-game telemetry
             # (converged/rounds/byzantine adoptions/event drops).
             "game_stats": _game_stats_or_none(),
+            # BCG_TPU_HOSTSYNC: host-sync audit of the window (total/
+            # attributed transfers, syncs per phase site, syncs/round,
+            # top attribution spans); None when the auditor is off.
+            "hostsync": _hostsync_stats_or_none(),
             # Fleet identity (run id, rank, host, shard path, heartbeat
             # age, straggler count) when fleet stamping is on; None
             # single-process.

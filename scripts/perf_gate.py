@@ -52,9 +52,9 @@ from typing import Dict, List, Optional
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 SCENARIOS = ("serve", "engine", "paged", "sampler", "int4", "consensus",
-             "fleet", "hlo")
+             "fleet", "hostsync", "hlo")
 REGRESSIONS = ("none", "spec-off", "fail-rows", "events-off",
-               "straggler-off")
+               "straggler-off", "hostsync-off")
 
 DECISION = {
     "type": "object",
@@ -756,6 +756,129 @@ def run_fleet_scenario(inject: str = "none") -> Dict[str, float]:
     }
 
 
+def run_hostsync_scenario(inject: str = "none") -> Dict[str, float]:
+    """Runtime host-sync auditor (bcg_tpu/obs/hostsync.py) gates — the
+    drift baseline for ROADMAP item 2's on-device mega-round (host-syncs
+    per round -> ~1), pinned the way the while-body kernel census pinned
+    PRs 8/10's fusion claims:
+
+    * ``syncs_per_round`` — mean of the ``game.host_syncs`` per-round
+      histogram over one hermetic FakeEngine consensus game.  The
+      FakeEngine mirrors the real decode path's sync profile (3
+      materializations per batched call — the engine.spec.* mirror
+      idiom), so this pins the game loop's host-round-trip STRUCTURE:
+      2 batched engine calls per lockstep round (decide + vote) x 3
+      syncs.  A fusion PR that moves game phases on device changes the
+      call structure and must justify the new value here.
+    * ``syncs_per_decision`` — observed transfers per agent decision on
+      the tiny REAL engine's guided-JSON benchmark (one batched call,
+      3 decisions): the decode path's actual materialization count
+      (prefill barrier + decode readback + step readback), exact on any
+      backend.
+    * ``attribution_coverage`` — attributed / total over the whole
+      scenario (acceptance: >= 0.95; tracing is off here, so this is
+      the jit-entry attribution path doing the work).
+    * ``error_rows`` — every real-engine row parses as valid guided
+      JSON (the decision benchmark can't degrade to cover a sync
+      regression).
+
+    ``hostsync-off`` injection unsets the flag — the auditor observes
+    nothing and the gate must FAIL naming syncs_per_round /
+    syncs_per_decision / attribution_coverage rather than pass
+    vacuously (zero-surface means zero metrics, not green metrics)."""
+    import dataclasses
+
+    from bcg_tpu.config import (
+        BCGConfig, EngineConfig, GameConfig, MetricsConfig,
+    )
+    from bcg_tpu.obs import counters as obs_counters, hostsync as obs_hostsync
+    from bcg_tpu.runtime.orchestrator import BCGSimulation
+
+    # Save/restore the RAW value (None vs "") — registry accessors
+    # cannot round-trip "was unset".
+    prior = os.environ.get("BCG_TPU_HOSTSYNC")  # lint: ignore[BCG-ENV-RAW]
+    if inject == "hostsync-off":
+        os.environ.pop("BCG_TPU_HOSTSYNC", None)
+    else:
+        os.environ["BCG_TPU_HOSTSYNC"] = "1"
+    obs_hostsync.reset()
+    total_before = obs_counters.value("engine.hostsync.total")
+    attr_before = obs_counters.value("engine.hostsync.attributed")
+    rounds_before = obs_counters.value("game.host_syncs.count")
+    round_syncs_before = obs_counters.value("game.host_syncs.sum")
+    try:
+        # Arm 1: hermetic FakeEngine game (same geometry as the
+        # consensus scenario's converging seed).
+        cfg = dataclasses.replace(
+            BCGConfig(),
+            game=GameConfig(num_honest=4, num_byzantine=1,
+                            max_rounds=6, seed=7),
+            engine=EngineConfig(backend="fake"),
+            metrics=MetricsConfig(save_results=False),
+            verbose=False,
+        )
+        sim = BCGSimulation(config=cfg)
+        try:
+            sim.run()
+        finally:
+            sim.close()
+        rounds = obs_counters.value("game.host_syncs.count") - rounds_before
+        round_syncs = (
+            obs_counters.value("game.host_syncs.sum") - round_syncs_before
+        )
+
+        # Arm 2: tiny real engine, guided-JSON decision benchmark
+        # (deterministic at temperature 0 — the engine scenario's
+        # prompt set).
+        _force_cpu()
+        from bcg_tpu.engine.jax_engine import JaxEngine
+
+        prompts = [
+            ("honest agent system prompt", "Round 3: propose a value",
+             DECISION),
+            ("byzantine agent system prompt", "Round 3: vote now", VOTE),
+            ("honest agent system prompt", "Round 4: propose a value",
+             DECISION),
+        ]
+        eng_before = obs_counters.value("engine.hostsync.total")
+        eng = JaxEngine(EngineConfig(
+            backend="jax", model_name="bcg-tpu/tiny-test",
+            max_model_len=2048,
+        ))
+        try:
+            results = eng.batch_generate_json(
+                prompts, temperature=0.0, max_tokens=64
+            )
+        finally:
+            eng.shutdown()
+        decision_syncs = (
+            obs_counters.value("engine.hostsync.total") - eng_before
+        )
+        bad = sum(
+            1 for r in results if not isinstance(r, dict) or "error" in r
+        )
+        total = obs_counters.value("engine.hostsync.total") - total_before
+        attributed = (
+            obs_counters.value("engine.hostsync.attributed") - attr_before
+        )
+    finally:
+        if prior is None:
+            os.environ.pop("BCG_TPU_HOSTSYNC", None)
+        else:
+            os.environ["BCG_TPU_HOSTSYNC"] = prior
+        obs_hostsync.reset()
+    return {
+        "hostsync.syncs_per_round": (
+            round_syncs / rounds if rounds else 0.0
+        ),
+        "hostsync.syncs_per_decision": decision_syncs / len(prompts),
+        "hostsync.attribution_coverage": (
+            attributed / total if total else 0.0
+        ),
+        "hostsync.error_rows": float(bad),
+    }
+
+
 def run_hlo_scenario(inject: str = "none") -> Dict[str, float]:
     """Kernel-census drift findings (scripts/hlo_census.py) as a gated
     metric — 0 findings = the lowered programs still match
@@ -782,6 +905,7 @@ _RUNNERS = {
     "int4": run_int4_scenario,
     "consensus": run_consensus_scenario,
     "fleet": run_fleet_scenario,
+    "hostsync": run_hostsync_scenario,
     "hlo": run_hlo_scenario,
 }
 

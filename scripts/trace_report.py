@@ -107,15 +107,16 @@ def render_report(trace: dict, top: int = 20) -> str:
             f"(dropped {dropped} unbalanced event(s) at the ring edge)"
         )
     counters = (trace.get("otherData") or {}).get("counters") or {}
-    # engine.hlo.* and hbm.* gauges get their own sections below, and so
-    # do histogram families (the flat .bucket.le_* / .sum / .count
-    # entries) — ranked by raw value (op counts, FLOPs, byte totals,
-    # cumulative bucket counts) they would crowd every actual event
-    # counter out of the top-N list.
+    # engine.hlo.*, hbm.*, and engine.hostsync.* get their own sections
+    # below, and so do histogram families (the flat .bucket.le_* /
+    # .sum / .count entries) — ranked by raw value (op counts, FLOPs,
+    # byte totals, cumulative bucket counts, per-span sync tallies)
+    # they would crowd every actual event counter out of the top-N
+    # list.
     hist_names = histogram_families(counters)
     ranked = sorted(
         ((k, v) for k, v in counters.items()
-         if not k.startswith(("engine.hlo.", "hbm."))
+         if not k.startswith(("engine.hlo.", "hbm.", "engine.hostsync."))
          and _histogram_owner(k, hist_names) is None),
         key=lambda kv: (-kv[1], kv[0]),
     )[:max(0, top)]
@@ -149,6 +150,10 @@ def render_report(trace: dict, top: int = 20) -> str:
     if fused:
         lines.append("")
         lines.append(fused)
+    hostsync = hostsync_section(counters)
+    if hostsync:
+        lines.append("")
+        lines.append(hostsync)
     return "\n".join(lines)
 
 
@@ -316,6 +321,35 @@ def fused_sampler_section(counters: Dict[str, float]) -> str:
             f"{fam:<{name_w}}  {xla_ops:>12.0f}  {fused_ops:>14.0f}  "
             f"{cc:>17.0f}"
         )
+    return "\n".join(lines)
+
+
+def hostsync_section(counters: Dict[str, float]) -> str:
+    """Host-syncs-by-span attribution table rebuilt from the exported
+    ``engine.hostsync.span.*`` counters (bcg_tpu/obs/hostsync.py), with
+    a totals footer (attributed/total coverage), or '' when the export
+    carries no audit.  Kept bcg_tpu-import-free like the rest of this
+    report: the counter names alone define the schema."""
+    prefix = "engine.hostsync.span."
+    rows = sorted(
+        ((k[len(prefix):], v) for k, v in counters.items()
+         if k.startswith(prefix)),
+        key=lambda kv: (-kv[1], kv[0]),
+    )
+    total = counters.get("engine.hostsync.total", 0)
+    if not rows and not total:
+        return ""
+    lines = ["== host syncs by span (engine.hostsync.*) =="]
+    if rows:
+        name_w = max(len("span"), max(len(r[0]) for r in rows))
+        lines.append(f"{'span':<{name_w}}  {'syncs':>8}")
+        for name, value in rows:
+            lines.append(f"{name:<{name_w}}  {value:>8.0f}")
+    attributed = counters.get("engine.hostsync.attributed", 0)
+    coverage = f" ({100.0 * attributed / total:.1f}% attributed)" if total else ""
+    lines.append(
+        f"total {total:.0f} sync(s), {attributed:.0f} attributed{coverage}"
+    )
     return "\n".join(lines)
 
 

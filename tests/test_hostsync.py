@@ -1,0 +1,674 @@
+"""Runtime host-sync auditor (bcg_tpu/obs/hostsync.py) in tier-1.
+
+ISSUE-12 contracts asserted here:
+
+* **Zero surface off** — with ``BCG_TPU_HOSTSYNC`` unset the module is
+  inert: nothing registered, nothing intercepted, and the Prometheus
+  exposition of an audited run minus the audit namespace is
+  BYTE-identical to an unaudited run of the same workload (subprocess
+  pin); the tracer export carries no trace of the namespace.
+* **Attribution** — span-first (the innermost open tracer span), jit-
+  entry fallback when tracing is off, unattributed syncs counted rather
+  than dropped; >= 95% coverage in the hermetic perf_gate scenario.
+* **Surfaces** — the ``game.host_syncs`` per-round histogram observed
+  around the orchestrator's round span, the serve ``SchedulerStats``
+  ``hostsync`` block, and the ``runtime.metrics.LAST_HOSTSYNC`` publish
+  bench.py attaches on success and error paths.
+* **Drift gate** — the perf_gate ``hostsync`` scenario is green against
+  justified ``perf_baseline.json`` entries, ``--inject-regression
+  hostsync-off`` fails naming the metrics, and removing any
+  ``hostsync.*`` entry resurfaces an unbaselined-metric finding (this
+  file is the namespace's registered owner —
+  tests/test_perf_gate.py NAMESPACE_OWNERS).
+* **Static↔runtime cross-link** — every justified ``BCG-HOST-SYNC``
+  suppression in ``lint_baseline.json`` must register its runtime
+  verification in ``HOST_SYNC_SUPPRESSION_COVERAGE`` below, so static
+  baseline entries stop being unverifiable prose.
+* **Disabled overhead** — auditing compiled in but off adds <5% to the
+  straggler micro-benchmark's wall-clock (the PR 4 tracer idiom:
+  no-op unit cost x call volume).
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from bcg_tpu.api import run_simulation
+from bcg_tpu.serve import run_serving_simulations
+from bcg_tpu.engine.fake import FakeEngine
+from bcg_tpu.engine.interface import InferenceEngine
+from bcg_tpu.obs import counters as obs_counters
+from bcg_tpu.obs import hostsync as obs_hostsync
+from bcg_tpu.obs import tracer as obs_tracer
+from bcg_tpu.runtime import metrics as runtime_metrics
+from bcg_tpu.serve.scheduler import Scheduler
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GATE_SCRIPT = os.path.join(REPO, "scripts", "perf_gate.py")
+
+
+def _load_gate():
+    spec = importlib.util.spec_from_file_location("perf_gate", GATE_SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture
+def audited(monkeypatch):
+    monkeypatch.setenv("BCG_TPU_HOSTSYNC", "1")
+    obs_hostsync.reset()
+    yield obs_hostsync.auditor()
+    obs_hostsync.reset()
+
+
+@pytest.fixture
+def unaudited(monkeypatch):
+    monkeypatch.delenv("BCG_TPU_HOSTSYNC", raising=False)
+    obs_hostsync.reset()
+    yield
+    obs_hostsync.reset()
+
+
+@pytest.fixture
+def untraced(monkeypatch):
+    monkeypatch.delenv("BCG_TPU_TRACE", raising=False)
+    monkeypatch.delenv("BCG_TPU_TRACE_OUT", raising=False)
+    obs_tracer.reset()
+    yield
+    obs_tracer.reset()
+
+
+@pytest.fixture
+def traced(monkeypatch):
+    monkeypatch.setenv("BCG_TPU_TRACE", "1")
+    monkeypatch.delenv("BCG_TPU_TRACE_OUT", raising=False)
+    obs_tracer.reset()
+    yield obs_tracer.get_tracer()
+    obs_tracer.reset()
+
+
+# The deterministic hermetic workload every surface test runs: the
+# perf_gate scenario's converging FakeEngine game geometry.
+def _run_game():
+    return run_simulation(
+        n_agents=5, byzantine_count=1, max_rounds=6, backend="fake", seed=7,
+    )
+
+
+# Worker for the exact-bytes subprocess pin: plays the game, bumps one
+# deterministic non-audit counter (so the unaudited exposition is
+# non-empty and the byte comparison can't pass vacuously), prints the
+# exposition.
+_EXPO_WORKER = """
+import sys
+sys.path.insert(0, sys.argv[1])
+from bcg_tpu.api import run_simulation
+from bcg_tpu.obs import counters as obs_counters, export as obs_export
+out = run_simulation(n_agents=5, byzantine_count=1, max_rounds=6,
+                     backend="fake", seed=7)
+assert out["metrics"]["total_rounds"] >= 1
+obs_counters.inc("engine.probe", 3)
+sys.stdout.write(obs_export.render_prometheus())
+"""
+
+
+class TestZeroSurface:
+    """Acceptance: flag off => no counters registered, no interception
+    installed, exposition and tracer export byte-identical to pre-PR."""
+
+    def test_disabled_module_is_inert(self, unaudited):
+        before = set(obs_counters.snapshot())
+        assert obs_hostsync.auditor() is None
+        assert not obs_hostsync.enabled()
+        obs_hostsync.note("probe_site", entry="decode_loop")
+        with obs_hostsync.jit_entry("prefill"):
+            obs_hostsync.note("probe_site")
+        obs_hostsync.publish()
+        assert obs_hostsync.total() == 0
+        assert obs_hostsync.summary() is None
+        FakeEngine(seed=0, policy="consensus").batch_generate_json(
+            [("sys", "Round 1. Decide.", {"type": "object"})]
+        )
+        _run_game()
+        new = set(obs_counters.snapshot()) - before
+        assert not [n for n in new if "hostsync" in n or "host_syncs" in n], new
+
+    def test_disabled_leaves_device_get_unwrapped(self, unaudited):
+        import jax
+
+        assert jax.device_get.__name__ != "_audited_device_get"
+
+    def test_exposition_exact_bytes_vs_unaudited_subprocess(self):
+        """The only exposition difference an enabled auditor may make
+        is the audit namespace itself: filtering ``hostsync`` /
+        ``host_syncs`` lines out of the audited run's exposition must
+        reproduce the unaudited run's exposition EXACTLY, byte for
+        byte (fresh subprocess per arm = a pristine registry, which an
+        in-process test cannot get back once other tests registered
+        audit counters)."""
+        def scrape(flag_on: bool) -> str:
+            env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO}
+            env.pop("BCG_TPU_HOSTSYNC", None)
+            if flag_on:
+                env["BCG_TPU_HOSTSYNC"] = "1"
+            proc = subprocess.run(
+                [sys.executable, "-c", _EXPO_WORKER, REPO],
+                capture_output=True, text=True, timeout=180, env=env,
+                cwd=REPO,
+            )
+            assert proc.returncode == 0, proc.stderr
+            return proc.stdout
+
+        expo_off = scrape(flag_on=False)
+        expo_on = scrape(flag_on=True)
+        assert "bcg_engine_probe_total" in expo_off  # non-vacuous
+        assert "hostsync" not in expo_off
+        # The audited run really surfaced the namespace...
+        # (the dotted name already ends in "total": the exposition's
+        # counter-suffix rule does not double it)
+        assert "bcg_engine_hostsync_total " in expo_on
+        assert "bcg_game_host_syncs_bucket" in expo_on
+        # ... and removing it reproduces the unaudited bytes exactly.
+        kept = [
+            line for line in expo_on.splitlines()
+            if "hostsync" not in line and "host_syncs" not in line
+        ]
+        filtered = "\n".join(kept) + ("\n" if kept else "")
+        assert filtered == expo_off
+
+    def test_tracer_export_carries_no_audit_when_off(self, unaudited,
+                                                     traced):
+        _run_game()
+        export = traced.export()
+        assert "hostsync" not in json.dumps(export)
+        assert "host_syncs" not in json.dumps(export)
+
+
+class TestAttribution:
+    def _delta(self, before):
+        return {
+            k: v for k, v in obs_counters.delta(before).items()
+            if k.startswith("engine.hostsync.")
+        }
+
+    def test_span_attribution_wins_over_entry(self, audited, traced):
+        before = obs_counters.snapshot()
+        with obs_tracer.span("decide"):
+            obs_hostsync.note("probe_site", entry="decode_loop")
+        moved = self._delta(before)
+        assert moved["engine.hostsync.span.decide"] == 1
+        assert moved["engine.hostsync.attributed"] == 1
+        assert "engine.hostsync.span.jit_decode_loop" not in moved
+
+    def test_span_names_sanitize_into_the_taxonomy(self, audited, traced):
+        before = obs_counters.snapshot()
+        with obs_tracer.span("serve.request"):
+            obs_hostsync.note("probe_site")
+        moved = self._delta(before)
+        assert moved["engine.hostsync.span.serve_request"] == 1
+
+    def test_jit_entry_attribution_with_tracing_off(self, audited,
+                                                    untraced):
+        """Satellite: auditor on, tracing off — syncs still attribute,
+        to jit-entry names (explicit ``entry=`` and the thread-local
+        stack both)."""
+        before = obs_counters.snapshot()
+        obs_hostsync.note("probe_site", entry="decode_loop")
+        with obs_hostsync.jit_entry("prefill"):
+            obs_hostsync.note("probe_site")
+        moved = self._delta(before)
+        assert moved["engine.hostsync.span.jit_decode_loop"] == 1
+        assert moved["engine.hostsync.span.jit_prefill"] == 1
+        assert moved["engine.hostsync.attributed"] == 2
+        assert "engine.hostsync.unattributed" not in moved
+
+    def test_unattributed_syncs_are_counted_not_dropped(self, audited,
+                                                        untraced):
+        before = obs_counters.snapshot()
+        obs_hostsync.note("orphan_site")
+        moved = self._delta(before)
+        assert moved["engine.hostsync.total"] == 1
+        assert moved["engine.hostsync.unattributed"] == 1
+        assert moved["engine.hostsync.span.unattributed"] == 1
+
+    def test_device_get_interception_counts_and_uninstalls(self, audited):
+        import jax
+        import numpy as np
+
+        assert jax.device_get.__name__ == "_audited_device_get"
+        before = obs_counters.snapshot()
+        jax.device_get(np.arange(3))
+        moved = self._delta(before)
+        assert moved["engine.hostsync.site.device_get"] == 1
+        obs_hostsync.reset()
+        assert jax.device_get.__name__ != "_audited_device_get"
+
+    def test_site_table_and_summary_shape(self, audited, untraced):
+        obs_hostsync.note("probe_site", n=3, entry="decode_loop")
+        summary = obs_hostsync.summary()
+        assert summary["total"] >= 3
+        assert summary["by_site"]["probe_site"] >= 3
+        assert summary["by_span"]["jit_decode_loop"] >= 3
+        assert 0.0 <= summary["attribution_coverage"] <= 1.0
+
+
+class TestRoundHistogram:
+    def test_game_observes_syncs_per_round(self, audited, untraced):
+        """The orchestrator observes each round's sync delta into
+        game.host_syncs: a lockstep FakeEngine round is 2 batched
+        engine calls (decide + vote) x 3 mirrored decode-path syncs —
+        ROADMAP item 2's baseline structure."""
+        rounds_before = obs_counters.value("game.host_syncs.count")
+        syncs_before = obs_counters.value("game.host_syncs.sum")
+        out = _run_game()
+        rounds = obs_counters.value("game.host_syncs.count") - rounds_before
+        syncs = obs_counters.value("game.host_syncs.sum") - syncs_before
+        assert rounds == out["metrics"]["total_rounds"]
+        assert syncs / rounds == 6.0
+
+    def test_game_syncs_attribute_fully(self, audited, untraced):
+        before_total = obs_counters.value("engine.hostsync.total")
+        before_attr = obs_counters.value("engine.hostsync.attributed")
+        _run_game()
+        total = obs_counters.value("engine.hostsync.total") - before_total
+        attr = obs_counters.value("engine.hostsync.attributed") - before_attr
+        assert total > 0
+        assert attr == total
+
+    def test_overlapping_rounds_are_counted_not_observed(self, audited,
+                                                         untraced):
+        """Concurrent games share one process-wide sync total, so a
+        round overlapping another cannot be split honestly — it must be
+        COUNTED (engine.hostsync.rounds_overlapped), never observed
+        wrong into the histogram or dropped silently."""
+        hist_before = obs_counters.value("game.host_syncs.count")
+        overlap_before = obs_counters.value(
+            "engine.hostsync.rounds_overlapped"
+        )
+        w1 = audited.begin_round()
+        w2 = audited.begin_round()  # a second game's round opens
+        obs_hostsync.note("probe_site", entry="decode_loop")
+        audited.end_round(w2)
+        audited.end_round(w1)
+        assert obs_counters.value(
+            "engine.hostsync.rounds_overlapped"
+        ) - overlap_before == 2
+        assert obs_counters.value("game.host_syncs.count") == hist_before
+        # A fresh, un-overlapped round observes again.
+        w3 = audited.begin_round()
+        audited.end_round(w3)
+        assert obs_counters.value(
+            "game.host_syncs.count"
+        ) == hist_before + 1
+
+    def test_spec_mirror_carries_the_spec_readbacks(self, audited,
+                                                    untraced, monkeypatch):
+        """The real spec loop reads drafted/accepted vectors back (2
+        extra syncs per call) and attributes EVERY post-loop readback
+        to its own entry name: the FakeEngine mirror must carry the
+        same 5-syncs-per-call, jit_spec_decode_loop-attributed profile
+        when BCG_TPU_SPEC is on."""
+        monkeypatch.setenv("BCG_TPU_SPEC", "1")
+        before = obs_counters.snapshot()
+        FakeEngine(seed=0, policy="consensus").batch_generate_json(
+            [("sys", "Round 1. Decide.", {"type": "object"})]
+        )
+        moved = obs_counters.delta(before)
+        assert moved["engine.hostsync.total"] == 5
+        assert moved["engine.hostsync.site.spec_readback"] == 2
+        # decode_readback + steps_readback + 2x spec_readback all land
+        # under the spec loop's entry (jax_engine.py loop_entry parity).
+        assert moved["engine.hostsync.span.jit_spec_decode_loop"] == 4
+        assert "engine.hostsync.span.jit_decode_loop" not in moved
+
+    def test_failed_round_does_not_poison_future_rounds(self, audited,
+                                                        untraced):
+        """A round that raises must still close its audit window: a
+        leaked entry would mark every later round overlapped and
+        silently stop the game.host_syncs histogram for the process."""
+        class _Boom(InferenceEngine):
+            def batch_generate_json(self, prompts, temperature=0.8,
+                                    max_tokens=512):
+                raise RuntimeError("injected engine failure")
+
+            def generate_json(self, prompt, schema, temperature=0.0,
+                              max_tokens=512, system_prompt=None):
+                raise RuntimeError("injected engine failure")
+
+            def generate(self, prompt, temperature=0.0, max_tokens=256,
+                         top_p=1.0, system_prompt=None):
+                raise RuntimeError("injected engine failure")
+
+            def batch_generate(self, prompts, temperature=0.0,
+                               max_tokens=256, top_p=1.0):
+                raise RuntimeError("injected engine failure")
+
+            def shutdown(self):
+                pass
+
+        hist_before = obs_counters.value("game.host_syncs.count")
+        with pytest.raises(RuntimeError):
+            run_simulation(n_agents=2, byzantine_count=0, max_rounds=1,
+                           backend="fake", seed=0, engine=_Boom())
+        # The failed round observed nothing...
+        assert obs_counters.value("game.host_syncs.count") == hist_before
+        # ... and did not leak its window: the next round still
+        # observes as un-overlapped.
+        window = audited.begin_round()
+        audited.end_round(window)
+        assert obs_counters.value(
+            "game.host_syncs.count"
+        ) == hist_before + 1
+
+    def test_round_span_attribution_when_traced(self, audited, traced):
+        """With tracing on the mirror's syncs attribute to the engine
+        span names (span wins over the jit-entry tag)."""
+        before = obs_counters.snapshot()
+        _run_game()
+        moved = obs_counters.delta(before)
+        assert moved.get("engine.hostsync.span.engine_prefill", 0) > 0
+        assert moved.get("engine.hostsync.span.engine_decode", 0) > 0
+
+
+class TestSchedulerSnapshot:
+    def test_snapshot_carries_per_request_sync_counts(self, audited,
+                                                      untraced):
+        sched = Scheduler(
+            FakeEngine(seed=0, policy="consensus"), linger_ms=0,
+            bucket_rows=4, max_queue_rows=64, deadline_ms=0,
+            strict_admission=False,
+        )
+        payload = [("sys", "Round 1. Decide.",
+                    {"type": "object", "properties": {},
+                     "additionalProperties": True})]
+        try:
+            for _ in range(3):
+                sched.submit_and_wait(("json",), list(payload), [0.0], [16])
+            snap = sched.snapshot()
+        finally:
+            sched.close()
+        hs = snap["hostsync"]
+        assert hs is not None
+        # 3 mirrored syncs per dispatched batch.
+        assert hs["syncs"] == 3 * snap["dispatches"]
+        assert hs["syncs_per_dispatch"] == 3.0
+        assert hs["syncs_per_request"] == round(
+            hs["syncs"] / snap["completed"], 4
+        )
+
+    def test_snapshot_block_is_none_when_off(self, unaudited):
+        sched = Scheduler(
+            FakeEngine(seed=0, policy="consensus"), linger_ms=0,
+            bucket_rows=4, max_queue_rows=64, deadline_ms=0,
+            strict_admission=False,
+        )
+        try:
+            snap = sched.snapshot()
+        finally:
+            sched.close()
+        assert snap["hostsync"] is None
+
+
+class TestBenchPublish:
+    def test_last_hostsync_published_on_engine_calls(self, audited,
+                                                     untraced):
+        runtime_metrics.publish_hostsync(None)
+        FakeEngine(seed=0, policy="consensus").batch_generate_json(
+            [("sys", "Round 1. Decide.", {"type": "object"})]
+        )
+        last = runtime_metrics.LAST_HOSTSYNC
+        assert last is not None
+        assert last["total"] >= 3
+        assert "by_site" in last and "by_span" in last
+
+    def test_bench_helper_reads_the_publish(self, audited, untraced):
+        import bench
+
+        runtime_metrics.publish_hostsync({"total": 7})
+        assert bench._hostsync_stats_or_none() == {"total": 7}
+        assert "BCG_TPU_HOSTSYNC" in bench._CONFIG_OVERRIDE_ENVS
+
+    def test_helper_none_when_never_published(self, unaudited):
+        import bench
+
+        runtime_metrics.publish_hostsync(None)
+        assert bench._hostsync_stats_or_none() is None
+
+
+@pytest.fixture(scope="module")
+def hostsync_gate():
+    """One in-process run of the perf_gate hostsync scenario — this
+    file owns the ``hostsync.`` namespace's resurface contract
+    (tests/test_perf_gate.py NAMESPACE_OWNERS)."""
+    mod = _load_gate()
+    return mod, mod.run_hostsync_scenario()
+
+
+class TestPerfGateHostsync:
+    def test_scenario_green_and_nothing_stale(self, hostsync_gate):
+        mod, measured = hostsync_gate
+        findings = mod.check_metrics(measured, mod.load_baseline())
+        findings += mod.check_stale(measured, mod.load_baseline(),
+                                    ("hostsync",))
+        assert findings == [], "\n".join(findings)
+
+    def test_acceptance_values(self, hostsync_gate):
+        _, measured = hostsync_gate
+        # 2 batched calls x 3 mirrored syncs per FakeEngine round.
+        assert measured["hostsync.syncs_per_round"] == 6.0
+        # 3 real-engine materializations / 3 decisions in one call.
+        assert measured["hostsync.syncs_per_decision"] == 1.0
+        # Acceptance criterion: >= 95% attributed (tracing off here, so
+        # the jit-entry fallback carries the whole table).
+        assert measured["hostsync.attribution_coverage"] >= 0.95
+        assert measured["hostsync.error_rows"] == 0
+
+    def test_hostsync_off_fails_naming_the_metrics(self, hostsync_gate):
+        """Acceptance: the auditor silently off can never read as a
+        green sync gate — the injection must fail naming the pinned
+        metrics."""
+        mod, _ = hostsync_gate
+        measured = mod.run_hostsync_scenario(inject="hostsync-off")
+        findings = mod.check_metrics(measured, mod.load_baseline())
+        for name in ("hostsync.syncs_per_round",
+                     "hostsync.syncs_per_decision",
+                     "hostsync.attribution_coverage"):
+            assert any(name in f for f in findings), (name, findings)
+
+    def test_removing_each_entry_resurfaces_its_finding(self, hostsync_gate):
+        mod, measured = hostsync_gate
+        baseline = mod.load_baseline()
+        hostsync_entries = [
+            n for n in baseline["metrics"] if n.startswith("hostsync.")
+        ]
+        assert sorted(hostsync_entries) == [
+            "hostsync.attribution_coverage", "hostsync.error_rows",
+            "hostsync.syncs_per_decision", "hostsync.syncs_per_round",
+        ]
+        for removed in hostsync_entries:
+            pruned = json.loads(json.dumps(baseline))
+            del pruned["metrics"][removed]
+            findings = mod.check_metrics(measured, pruned)
+            assert any(
+                removed in f and "no entry" in f for f in findings
+            ), (removed, findings)
+
+    @pytest.mark.slow
+    def test_cli_injection_exits_nonzero_and_names_metric(self):
+        """Subprocess CLI arm (slow: cold jax import + engine boot).
+        The exit-code/naming contract is already pinned in-process
+        above; the shared main() plumbing is pinned by
+        tests/test_perf_gate.py's CLI tests — this run keeps the exact
+        `--scenarios hostsync --inject-regression hostsync-off`
+        invocation honest in the full suite."""
+        proc = subprocess.run(
+            [sys.executable, GATE_SCRIPT, "--scenarios", "hostsync",
+             "--inject-regression", "hostsync-off"],
+            cwd=REPO, capture_output=True, text=True, timeout=300,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 2, proc.stdout + proc.stderr
+        assert "hostsync.syncs_per_round" in proc.stderr
+        assert "PERF REGRESSION" in proc.stderr
+
+
+# (path, stripped content) of every justified BCG-HOST-SYNC suppression
+# in lint_baseline.json -> one sentence naming the runtime verification
+# that covers it (a test in this file observing the site through the
+# auditor, or the reason the auditor provably cannot reach it).  The
+# cross-link test below asserts set equality BOTH ways, so a future
+# static suppression without a registered runtime story fails tier-1 —
+# baseline entries stop being unverifiable prose.  Today the set is
+# empty: every BCG-HOST-SYNC finding has been fixed rather than
+# suppressed, and the eager seams the auditor instruments live OUTSIDE
+# traced regions (where the static rule does not reach — which is
+# exactly why the runtime auditor exists).
+HOST_SYNC_SUPPRESSION_COVERAGE = {}
+
+
+class TestStaticRuntimeCrossLink:
+    def test_every_suppression_registers_runtime_coverage(self):
+        with open(os.path.join(REPO, "lint_baseline.json")) as f:
+            baseline = json.load(f)
+        entries = {
+            (e["path"], e["content"])
+            for e in baseline["suppressions"]
+            if e["rule"] == "BCG-HOST-SYNC"
+        }
+        assert entries == set(HOST_SYNC_SUPPRESSION_COVERAGE), (
+            "BCG-HOST-SYNC suppressions and HOST_SYNC_SUPPRESSION_COVERAGE "
+            "disagree — every justified static host-sync suppression must "
+            "register the runtime verification that observes (or provably "
+            "cannot reach) its site, and stale registrations must be "
+            f"pruned: baseline={sorted(entries)}, "
+            f"covered={sorted(HOST_SYNC_SUPPRESSION_COVERAGE)}"
+        )
+
+    def test_auditor_observes_the_documented_engine_sites(self,
+                                                          hostsync_gate):
+        """The runtime complement of the static rule: the decode-path
+        sites DESIGN.md documents (prefill barrier, decode readback,
+        step readback) are all actually observed by the auditor in the
+        hermetic scenario — the real-engine arm's counters moved for
+        each one."""
+        site_table = {
+            name[len("engine.hostsync.site."):]: value
+            for name, value in obs_counters.snapshot().items()
+            if name.startswith("engine.hostsync.site.")
+        }
+        for site in ("prefill_barrier", "decode_readback",
+                     "steps_readback"):
+            assert site_table.get(site, 0) > 0, (site, site_table)
+        # Tracing was off in the scenario: the attribution table is the
+        # jit-entry fallback's work (satellite: auditor-on, tracing-off
+        # still attributes).
+        span_table = {
+            name[len("engine.hostsync.span."):]: value
+            for name, value in obs_counters.snapshot().items()
+            if name.startswith("engine.hostsync.span.")
+        }
+        assert any(k.startswith("jit_") for k in span_table), span_table
+
+
+class _DelayedCalls(InferenceEngine):
+    """Per-call host-side delay in front of a shared proxy (the
+    straggler micro-benchmark's workload shape — tests/test_obs.py)."""
+
+    def __init__(self, engine, delay):
+        self._engine = engine
+        self._delay = delay
+
+    def batch_generate_json(self, prompts, temperature=0.8, max_tokens=512):
+        time.sleep(self._delay)
+        return self._engine.batch_generate_json(prompts, temperature,
+                                                max_tokens)
+
+    def generate_json(self, prompt, schema, temperature=0.0, max_tokens=512,
+                      system_prompt=None):
+        time.sleep(self._delay)
+        return self._engine.generate_json(
+            prompt, schema, temperature, max_tokens,
+            system_prompt=system_prompt,
+        )
+
+    def generate(self, prompt, temperature=0.0, max_tokens=256, top_p=1.0,
+                 system_prompt=None):
+        return self._engine.generate(prompt, temperature, max_tokens, top_p,
+                                     system_prompt=system_prompt)
+
+    def batch_generate(self, prompts, temperature=0.0, max_tokens=256,
+                       top_p=1.0):
+        return self._engine.batch_generate(prompts, temperature, max_tokens,
+                                           top_p)
+
+    def shutdown(self):
+        pass
+
+
+class TestDisabledOverhead:
+    """Satellite acceptance: BCG_TPU_HOSTSYNC=0 adds <5% wall-clock to
+    the straggler micro-benchmark scenario — measured the PR 4 way:
+    (note calls the scenario would make) x (per-call cost of a disabled
+    note), against the scenario's disabled wall-clock."""
+
+    FAST = 0.005
+    GAMES, ROUNDS = 8, 2
+
+    def _run_scenario(self):
+        def make(i):
+            delay = self.FAST * 10 if i == 0 else self.FAST
+
+            def go(engine):
+                return run_simulation(
+                    n_agents=4, byzantine_count=0, max_rounds=self.ROUNDS,
+                    backend="fake", seed=i,
+                    engine=_DelayedCalls(engine, delay),
+                )
+            return go
+
+        t0 = time.perf_counter()
+        outs = run_serving_simulations(
+            FakeEngine(seed=0, policy="stubborn"),
+            [make(i) for i in range(self.GAMES)],
+            max_concurrent=4, linger_ms=1,
+        )
+        assert all(isinstance(o, dict) for o in outs)
+        return time.perf_counter() - t0
+
+    def test_disabled_overhead_bound(self, unaudited, untraced,
+                                     monkeypatch):
+        # Unit cost of the disabled fast path.
+        probes = 20_000
+        t0 = time.perf_counter()
+        for _ in range(probes):
+            obs_hostsync.note("probe_site", entry="decode_loop")
+        per_note = (time.perf_counter() - t0) / probes
+
+        # Scenario wall-clock with the auditor disabled (the shipped
+        # default path).
+        wall = self._run_scenario()
+
+        # Note volume of the SAME scenario, counted by running it
+        # audited.
+        monkeypatch.setenv("BCG_TPU_HOSTSYNC", "1")
+        obs_hostsync.reset()
+        before = obs_counters.value("engine.hostsync.total")
+        try:
+            self._run_scenario()
+            notes = obs_counters.value("engine.hostsync.total") - before
+        finally:
+            obs_hostsync.reset()
+
+        assert notes > 0
+        overhead = notes * per_note
+        assert overhead < 0.05 * wall, (
+            f"disabled auditor overhead {overhead * 1e3:.2f}ms is not <5% "
+            f"of the {wall * 1e3:.0f}ms straggler scenario "
+            f"({notes} notes x {per_note * 1e9:.0f}ns)"
+        )

@@ -191,6 +191,54 @@ def test_report_renders_histogram_quantile_table(tmp_path):
     assert "histogram quantiles" not in proc2.stdout
 
 
+def test_report_renders_hostsync_attribution_table(tmp_path):
+    """engine.hostsync.* counters in an export render as the
+    host-syncs-by-span attribution table with a coverage footer, AND
+    stay out of the ranked top-counter list (the hlo/hbm crowding fix
+    applied to the audit namespace) — still with no bcg_tpu import."""
+    trace = {
+        "traceEvents": [],
+        "otherData": {"counters": {
+            "engine.hostsync.total": 12,
+            "engine.hostsync.attributed": 11,
+            "engine.hostsync.unattributed": 1,
+            "engine.hostsync.span.engine_decode": 6,
+            "engine.hostsync.span.jit_decode_loop": 4,
+            "engine.hostsync.span.engine_prefill": 1,
+            "engine.hostsync.span.unattributed": 1,
+            "engine.hostsync.site.decode_readback": 6,
+            "engine.hostsync.site.prefill_barrier": 6,
+            "serve.requests": 3,
+        }},
+    }
+    path = tmp_path / "hostsync_trace.json"
+    path.write_text(json.dumps(trace))
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, str(path)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "host syncs by span" in proc.stdout
+    # Hottest attribution first; coverage footer derived from totals.
+    section = proc.stdout.split("host syncs by span")[1]
+    assert section.index("engine_decode") < section.index("jit_decode_loop")
+    assert "total 12 sync(s), 11 attributed (91.7% attributed)" in section
+    # The audit namespace never crowds the ranked counter list.
+    top_section = proc.stdout.split("top counters")[1].split("\n==")[0]
+    assert "serve.requests" in top_section
+    assert "engine.hostsync" not in top_section
+    # No audit counters -> no section.
+    bare = tmp_path / "bare4.json"
+    bare.write_text(json.dumps(
+        {"traceEvents": [], "otherData": {"counters": {"serve.requests": 1}}}
+    ))
+    proc2 = subprocess.run(
+        [sys.executable, SCRIPT, str(bare)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert "host syncs by span" not in proc2.stdout
+
+
 def test_report_handles_empty_trace(tmp_path):
     empty = tmp_path / "empty.json"
     empty.write_text(json.dumps({"traceEvents": [], "otherData": {}}))
