@@ -48,6 +48,7 @@ from bcg_tpu.ops.guided_sampler import (
 )
 from bcg_tpu.config import env_flag
 from bcg_tpu.obs import (
+    compile as obs_compile,
     counters as obs_counters,
     hlo as obs_hlo,
     hostsync as obs_hostsync,
@@ -766,7 +767,7 @@ class JaxEngine(InferenceEngine):
         # engine.retrace.<entry> — a retrace in the steady-state decode
         # loop is the single most expensive silent regression this
         # engine has (tens of seconds per compile on a remote chip).
-        self._jit_shapes: Dict[str, set] = {}
+        self._jit_shapes: Dict[str, Dict] = {}
         # Pad the token-byte table to the MODEL vocab (embedding tables are
         # padded past the tokenizer vocab, e.g. Qwen3 151669 -> 151936);
         # padding entries are b'' = forbidden, so logits and masks agree.
@@ -1923,22 +1924,38 @@ class JaxEngine(InferenceEngine):
             )
         return _make_masked_sampler_impl(eos_id, top_p)
 
-    def _note_jit_shape(self, entry: str, sig: Tuple) -> None:
+    def _note_jit_shape(self, entry: str, sig: Tuple,
+                        names: Optional[Tuple[str, ...]] = None,
+                        timing: str = "pending") -> None:
         """Count a compile (and, beyond the first signature per entry
         point, a RETRACE) into the process-wide counter registry:
         ``engine.compile.<entry>`` / ``engine.retrace.<entry>``.  Keyed
         by (entry point, shape signature), incremented exactly once per
         NEW signature — steady-state serving must show zero retrace
         movement, and a test provoking one extra shape observes exactly
-        +1 (tests/test_obs.py)."""
-        seen = self._jit_shapes.setdefault(entry, set())
+        +1 (tests/test_obs.py).
+
+        The per-entry cache is an insertion-ordered dict, not a set:
+        when compile observability is on (``BCG_TPU_COMPILE_OBS``,
+        obs/compile.py), a retraced signature is diffed against the
+        NEAREST cached one — most recent on ties — to emit the
+        structured retrace-cause record, with ``names`` labelling the
+        signature positions (``max_new 32→48``, not ``arg1``)."""
+        seen = self._jit_shapes.setdefault(entry, {})
         if sig in seen:
             return
         first = not seen
-        seen.add(sig)
+        prior = list(seen)
+        seen[sig] = True
         obs_counters.inc(f"engine.compile.{entry}")
         if not first:
             obs_counters.inc(f"engine.retrace.{entry}")
+        # ``timing`` declares this seam's note/dispatch ordering for the
+        # compile-time handoff: the decode-loop builders note BEFORE the
+        # first invocation (default "pending"), the prefill site notes
+        # AFTER its timed dispatch ("stash") — see obs/compile.py.
+        obs_compile.note_signature(entry, sig, prior, names=names,
+                                   timing=timing)
 
     def _get_decode_loop(self, guided_sig: Tuple, max_new: int,
                          top_p: float = 1.0):
@@ -1960,7 +1977,11 @@ class JaxEngine(InferenceEngine):
                self._sampler_loop_impl)
         if key in self._decode_loops:
             return self._decode_loops[key]
-        self._note_jit_shape("decode_loop", key)
+        self._note_jit_shape(
+            "decode_loop", key,
+            names=("guided_sig", "max_new", "top_p", "attn_impl",
+                   "sampler_impl"),
+        )
         self._decode_ring_active = ring is not None
         compiled = self._build_decode_loop(impl, max_new, top_p, ring)
         self._decode_loops[key] = compiled
@@ -2130,7 +2151,11 @@ class JaxEngine(InferenceEngine):
                self._sampler_loop_impl)
         if key in self._decode_loops:
             return self._decode_loops[key]
-        self._note_jit_shape("ff_decode_loop", key)
+        self._note_jit_shape(
+            "ff_decode_loop", key,
+            names=("path", "guided_sig", "max_new", "top_p", "attn_impl",
+                   "sampler_impl"),
+        )
         self._decode_ring_active = ring is not None
         compiled = self._build_ff_decode_loop(chunk_impl, max_new, top_p, ring)
         self._decode_loops[key] = compiled
@@ -2256,7 +2281,11 @@ class JaxEngine(InferenceEngine):
                self._sampler_loop_impl)
         if key in self._decode_loops:
             return self._decode_loops[key]
-        self._note_jit_shape("spec_decode_loop", key)
+        self._note_jit_shape(
+            "spec_decode_loop", key,
+            names=("path", "guided_sig", "max_new", "top_p", "spec_k",
+                   "spec_ngram", "attn_impl", "sampler_impl"),
+        )
         self._decode_ring_active = ring is not None
         compiled = self._build_spec_decode_loop(chunk_impl, max_new, top_p,
                                                 ring)
@@ -2693,9 +2722,14 @@ class JaxEngine(InferenceEngine):
                     parts, budgets, decode_slots
                 )
                 self._paged_dirty = True
-                first_logits, cache = self._prefill_paged_possibly_chunked(
-                    tokens, valid, Ls, cache, prefix_valid, prefix_lens
-                )
+                # time_block: a NEW prefill signature's dispatch pays
+                # trace+compile synchronously inside this call; the
+                # _note_jit_shape("prefill", ...) below consumes the
+                # elapsed (obs/compile.py stash handoff, no-op off).
+                with obs_compile.time_block("prefill"):
+                    first_logits, cache = self._prefill_paged_possibly_chunked(
+                        tokens, valid, Ls, cache, prefix_valid, prefix_lens
+                    )
                 self._paged.adopt(cache)
                 self._paged_dirty = False
                 cache = self._paged.entries(_tbl)
@@ -2726,10 +2760,11 @@ class JaxEngine(InferenceEngine):
                 # uses for fresh caches).
                 (tokens, valid, Ls, cache, prefix_valid, prefix_lens,
                  prefix_toks, P, S) = prepped
-                first_logits, cache = self._prefill_possibly_chunked(
-                    tokens, valid, Ls, cache,
-                    prefix_valid=prefix_valid, prefix_lens=prefix_lens,
-                )
+                with obs_compile.time_block("prefill"):
+                    first_logits, cache = self._prefill_possibly_chunked(
+                        tokens, valid, Ls, cache,
+                        prefix_valid=prefix_valid, prefix_lens=prefix_lens,
+                    )
                 L = P + Ls
                 valid_mask = np.zeros((B, S), dtype=bool)
                 valid_mask[:, :P] = prefix_valid
@@ -2742,9 +2777,10 @@ class JaxEngine(InferenceEngine):
                 S = L + decode_slots
                 S += (-S) % self._kv_align  # see _kv_align
                 cache = self._init_cache_sharded(B, S)
-                first_logits, cache = self._prefill_possibly_chunked(
-                    tokens, valid, L, cache
-                )
+                with obs_compile.time_block("prefill"):
+                    first_logits, cache = self._prefill_possibly_chunked(
+                        tokens, valid, L, cache
+                    )
                 valid_mask = np.zeros((B, S), dtype=bool)
                 valid_mask[:, :L] = valid
                 prompt_lens = valid.sum(axis=1).astype(np.int32)
@@ -2792,6 +2828,13 @@ class JaxEngine(InferenceEngine):
                 (("paged", B, Ls, P, S) if paged
                  else ("suffix", B, Ls, P, S) if prepped is not None
                  else ("full", B, L, S)),
+                names=(
+                    ("path", "batch", "suffix_window", "prefix_len",
+                     "cache_len")
+                    if (paged or prepped is not None)
+                    else ("path", "batch", "prompt_window", "cache_len")
+                ),
+                timing="stash",
             )
             # Prefill-position counters, split real vs padded (pads cost
             # FLOPs but are not progress — cache-hit savings must be
@@ -2883,9 +2926,13 @@ class JaxEngine(InferenceEngine):
                     args={"rows": B, "k": self.spec_k,
                           "ngram": self.spec_ngram},
                 ):
-                    out, (_, steps), (drafted, accepted), _cache_out = loop(
-                        *loop_args
-                    )
+                    # time_block: _get_spec_decode_loop noted any new
+                    # signature moments ago (pending marker); the first
+                    # invocation below pays its compile (flushed here,
+                    # no-op off).
+                    with obs_compile.time_block("spec_decode_loop"):
+                        out, (_, steps), (drafted, accepted), _cache_out = \
+                            loop(*loop_args)
             elif use_ff:
                 loop = obs_hlo.wrap(
                     census_prefix + "ff_decode_loop",
@@ -2912,7 +2959,8 @@ class JaxEngine(InferenceEngine):
                         ),
                         loop_args,
                     )
-                out, (_, steps), _cache_out = loop(*loop_args)
+                with obs_compile.time_block("ff_decode_loop"):
+                    out, (_, steps), _cache_out = loop(*loop_args)
             else:
                 loop = obs_hlo.wrap(
                     census_prefix + "decode_loop",
@@ -2945,7 +2993,8 @@ class JaxEngine(InferenceEngine):
                         ),
                         loop_args,
                     )
-                out, (_, steps), _cache_out = loop(*loop_args)
+                with obs_compile.time_block("decode_loop"):
+                    out, (_, steps), _cache_out = loop(*loop_args)
             if paged:
                 # The loop wrote decode KV into private pool blocks
                 # through the donated carry: retain the returned pool

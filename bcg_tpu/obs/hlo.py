@@ -287,8 +287,16 @@ def maybe_record(entry: str, jitted, args: tuple, kwargs: Optional[dict] = None)
         try:
             import jax
 
-            lowered = jitted.lower(*args, **(kwargs or {}))
-            compiled = lowered.compile()
+            # Compile-cost accounting (BCG_TPU_COMPILE_OBS): the AOT
+            # lower+compile below is a REAL extra compile this process
+            # pays for the census — charged under the entry's
+            # engine.compile_ms histogram + the cumulative aot_ms
+            # counter (obs/compile.py; shared no-op when off).
+            from bcg_tpu.obs import compile as obs_compile
+
+            with obs_compile.measure_aot(entry):
+                lowered = jitted.lower(*args, **(kwargs or {}))
+                compiled = lowered.compile()
             census.update(census_from_text(compiled.as_text()))
             census.update(_cost_analysis(compiled))
             census["backend"] = jax.default_backend()

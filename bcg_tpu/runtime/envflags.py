@@ -186,6 +186,30 @@ _register(
 )
 
 _register(
+    "BCG_TPU_COMPILE_OBS", "str", None,
+    "Compile-cost observability (bcg_tpu/obs/compile.py): per-entry "
+    "compile-time histograms (engine.compile_ms.*), first-compile vs "
+    "retrace split, trace-cache population gauges, and a structured "
+    "retrace-cause record per retrace (engine.retrace_cause.* — which "
+    "argument changed, e.g. max_new 32->48).  '1' = counters only; any "
+    "other value = counters plus the retrace-cause JSONL stream "
+    "appended at that path (first line = run manifest).  Off: zero "
+    "surface — nothing registered, no threads.",
+)
+_register(
+    "BCG_TPU_PROFILE", "str", None,
+    "Profiler capture window: wrap the BCG_TPU_PROFILE_ROUNDS-selected "
+    "orchestrator rounds (or serve dispatches) in one bounded "
+    "jax.profiler trace written into this directory "
+    "(Perfetto-loadable; manifest.json stamps the fleet identity).",
+)
+_register(
+    "BCG_TPU_PROFILE_ROUNDS", "str", "1-2",
+    "Inclusive 1-based 'a-b' window of rounds/dispatches the "
+    "BCG_TPU_PROFILE capture wraps (a bare 'a' captures one); the "
+    "first stream to reach 'a' owns the window.",
+)
+_register(
     "BCG_TPU_HOSTSYNC", "bool", False,
     "Runtime host-sync auditor (bcg_tpu/obs/hostsync.py): count every "
     "device->host materialization at the instrumented decode-path "

@@ -109,6 +109,23 @@ def publish_hostsync(snapshot: Optional[Dict]) -> None:
     LAST_HOSTSYNC = snapshot
 
 
+# Latest compile-cost summary (obs/compile.summary: per-entry compile
+# milliseconds, first-compile vs retrace split, cache-entry population,
+# retrace-cause records) — published by the observer at every
+# trace-cache miss so bench.py can attach the compile profile on
+# success AND error paths, mirroring LAST_SERVE_STATS (a first-compile
+# death is exactly when this forensics matters most).  None until the
+# observer runs (i.e. always None unless BCG_TPU_COMPILE_OBS is set).
+LAST_COMPILE_OBS: Optional[Dict] = None
+
+
+def publish_compile_obs(snapshot: Optional[Dict]) -> None:
+    """Record the most recent compile-cost summary (called by
+    ``obs.compile.CompileObserver.publish``)."""
+    global LAST_COMPILE_OBS
+    LAST_COMPILE_OBS = snapshot
+
+
 def _device_memory():
     """(bytes_in_use, peak_bytes_in_use) as the MAX across all devices,
     or (None, None) where the backend exposes no allocator stats (CPU).

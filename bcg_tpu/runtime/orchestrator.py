@@ -39,6 +39,7 @@ from bcg_tpu.comm import (
 from bcg_tpu.config import BCGConfig
 from bcg_tpu.engine.interface import InferenceEngine, create_engine
 from bcg_tpu.game import ByzantineConsensusGame
+from bcg_tpu.obs import compile as obs_compile
 from bcg_tpu.obs import fleet as obs_fleet
 from bcg_tpu.obs import game_events as obs_game_events
 from bcg_tpu.obs import hostsync as obs_hostsync
@@ -509,11 +510,18 @@ class BCGSimulation:
         audit = obs_hostsync.auditor()
         window = audit.begin_round() if audit is not None else None
         try:
-            with obs_tracer.span(
-                "round",
-                args={"round": self.game.current_round, "sim": self._sim_uid},
-            ):
-                self._run_round()
+            # Profiler capture window (BCG_TPU_PROFILE +
+            # BCG_TPU_PROFILE_ROUNDS=a-b, obs/compile.py): rounds a..b
+            # run inside one bounded jax.profiler trace — the device
+            # timeline of exactly the rounds under study, next to the
+            # Chrome tracer's host-side spans.  Shared no-op when off.
+            with obs_compile.profile_span("round", self.game.current_round):
+                with obs_tracer.span(
+                    "round",
+                    args={"round": self.game.current_round,
+                          "sim": self._sim_uid},
+                ):
+                    self._run_round()
         except BaseException:
             # Discard without observing: a partial round's sync count
             # is not a round observation, but the window MUST come off

@@ -44,6 +44,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from bcg_tpu.obs import (
+    compile as obs_compile,
     counters as obs_counters,
     export as obs_export,
     fleet as obs_fleet,
@@ -334,6 +335,13 @@ class SchedulerStats:
                 }
                 if obs_hostsync.enabled() else None
             ),
+            # Compile-cost view (BCG_TPU_COMPILE_OBS, obs/compile.py):
+            # trace-cache population, retrace/cause totals, and the
+            # cumulative compile milliseconds this process has paid —
+            # the admission-side early warning that a sweep's per-tenant
+            # signatures are multiplying jit entries.  None when the
+            # observer is off (kv_pool idiom).
+            "compile": obs_compile.brief(),
         }
 
     def _spec_snapshot(self) -> Optional[Dict[str, Any]]:
@@ -593,7 +601,12 @@ class Scheduler:
                         queue_wait_ms=round(wait_s * 1e3, 3),
                         batch_requests=len(batch),
                     )
-            self._dispatch(batch)
+            # Profiler capture window (BCG_TPU_PROFILE, obs/compile.py):
+            # dispatches are the serve tier's "rounds" — the configured
+            # a-b window wraps them in one bounded jax.profiler trace.
+            # Shared no-op when capture is off.
+            with obs_compile.profile_dispatch():
+                self._dispatch(batch)
             # Fleet liveness: every dispatch advances this rank's
             # progress watermark (no-op when fleet stamping is off).
             # Peer ranks' lagging dispatch watermarks surface as the

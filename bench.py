@@ -160,9 +160,13 @@ _CONFIG_OVERRIDE_ENVS = (
     "BCG_TPU_GAME_EVENTS", "BCG_TPU_SERVE_SLO_MS",
     "BCG_TPU_FLEET", "BCG_TPU_METRICS_SHARD_DIR",
     "BCG_TPU_FLEET_STRAGGLER_FACTOR", "BCG_TPU_HOSTSYNC",
+    "BCG_TPU_COMPILE_OBS", "BCG_TPU_PROFILE", "BCG_TPU_PROFILE_ROUNDS",
     # BCG_TPU_RUN_ID / BCG_TPU_METRICS_SHARD_MS stay out: a run label
     # and a flush period are provenance/measurement knobs, not a change
-    # to the served configuration.
+    # to the served configuration.  BCG_TPU_PROFILE* are IN despite
+    # being measurement knobs: an in-window jax.profiler capture
+    # perturbs the measured wall-clock, so a profiled run must not be
+    # recorded as the default-config number.
 )
 
 
@@ -258,6 +262,22 @@ def _hostsync_stats_or_none():
         from bcg_tpu.runtime import metrics as _metrics
 
         return _metrics.LAST_HOSTSYNC
+    except Exception:
+        # Inside the never-rc=1 contract (see _obs_payload).
+        return None
+
+
+def _compile_stats_or_none():
+    """Compile-cost summary (per-entry compile_ms totals, first-compile
+    vs retrace split, cache-entry population, retrace-cause records)
+    when BCG_TPU_COMPILE_OBS observed the window; None otherwise.  Read
+    from runtime.metrics (not the observer object) so the ERROR path —
+    where no engine handle survives — keeps the compile profile; a
+    first-compile death is exactly when it matters."""
+    try:
+        from bcg_tpu.runtime import metrics as _metrics
+
+        return _metrics.LAST_COMPILE_OBS
     except Exception:
         # Inside the never-rc=1 contract (see _obs_payload).
         return None
@@ -370,6 +390,12 @@ def _error_result(exc: BaseException, retried: bool) -> dict:
     hostsync_stats = _hostsync_stats_or_none()
     if hostsync_stats:
         out["hostsync"] = hostsync_stats
+    # Compile-cost profile of the failed attempt (which entries
+    # compiled, how long, what retraced and WHY) — the forensics a
+    # first-compile OOM or a retrace storm otherwise loses.
+    compile_stats = _compile_stats_or_none()
+    if compile_stats:
+        out["compile"] = compile_stats
     # Fleet identity of the failed attempt (which rank, which shard
     # file, heartbeat age at death) — the line a multi-host sweep's
     # post-mortem greps for.
@@ -796,6 +822,11 @@ def _run_attempt(cfg, model: str, backend: str, concurrency: int,
             # attributed transfers, syncs per phase site, syncs/round,
             # top attribution spans); None when the auditor is off.
             "hostsync": _hostsync_stats_or_none(),
+            # BCG_TPU_COMPILE_OBS: compile-cost profile (per-entry
+            # compile_ms totals, first-compile vs retrace split,
+            # cache-entry population, retrace causes); None when the
+            # observer is off.
+            "compile": _compile_stats_or_none(),
             # Fleet identity (run id, rank, host, shard path, heartbeat
             # age, straggler count) when fleet stamping is on; None
             # single-process.

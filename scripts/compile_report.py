@@ -1,0 +1,254 @@
+#!/usr/bin/env python
+"""Compile-cost report: compile time by entry + retraces by cause.
+
+``python scripts/compile_report.py FILE [--events CAUSES.jsonl]``
+
+``FILE`` is anything that carries the flat counter registry the
+compile observer (``bcg_tpu/obs/compile.py``, ``BCG_TPU_COMPILE_OBS``)
+feeds: a Chrome trace export (``otherData.counters``), a bench JSON
+(``extra.counters`` on success, top-level ``counters`` on error, or the
+driver-wrapped ``parsed`` form the BENCH_r*.json records use), or a
+plain ``{name: value}`` snapshot dump.  ``--events`` additionally reads
+the retrace-cause JSONL stream (``BCG_TPU_COMPILE_OBS=<path>``) for the
+per-argument cause table the counters alone cannot carry.
+
+Printed hottest-first:
+
+* **compile time by entry** — compiles / retraces / total / p50 / p95
+  milliseconds per jit entry, rebuilt from the
+  ``engine.compile_ms.<entry>`` histogram flats and the
+  ``engine.compile.<entry>`` / ``engine.retrace.<entry>`` counters;
+* **retraces by cause** — the ``engine.retrace_cause.<kind>`` taxonomy
+  counts (shape / dtype / static_knob / path / arity), plus, with
+  ``--events``, the concrete ``entry: arg old→new`` lines;
+* a cumulative footer (first-compile vs retrace vs census-AOT
+  milliseconds, trace-cache population).
+
+Self-contained — no bcg_tpu import — so a bench JSON copied off a TPU
+host can be read anywhere; the in-process equivalent is
+``bcg_tpu.obs.compile.summary()``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import Counter as TallyCounter
+from typing import Dict, List, Optional, Tuple
+
+COMPILE_MS_PREFIX = "engine.compile_ms."
+CAUSE_PREFIX = "engine.retrace_cause."
+
+
+def extract_counters(data) -> Dict[str, float]:
+    """The flat counter dict inside any of the supported file shapes
+    (first match wins, searched shallowly so an unrelated nested
+    'counters' key cannot shadow the real one)."""
+    if not isinstance(data, dict):
+        return {}
+    for candidate in (
+        (data.get("otherData") or {}).get("counters"),   # trace export
+        (data.get("extra") or {}).get("counters"),       # bench success
+        data.get("counters"),                            # bench error
+        (data.get("parsed") or {}).get("counters"),      # driver wrap
+        ((data.get("parsed") or {}).get("extra") or {}).get("counters"),
+    ):
+        if isinstance(candidate, dict):
+            return candidate
+    # Plain snapshot dump: every value numeric, dotted names.
+    if data and all(
+        isinstance(v, (int, float)) and "." in k for k, v in data.items()
+    ):
+        return data
+    return {}
+
+
+def _parse_bound(label: str) -> float:
+    """``le_`` label -> float bound (``25`` -> 25.0, ``2_5`` -> 2.5 —
+    the registry's bound_label encoding, reimplemented to stay
+    import-free)."""
+    return float(label.replace("_", "."))
+
+
+def _quantile(buckets: List[Tuple[float, float]], total: float,
+              q: float) -> float:
+    """Prometheus histogram_quantile over cumulative (bound, count)
+    pairs (trace_report.py's form, kept import-free here too)."""
+    if total <= 0:
+        return 0.0
+    target = q * total
+    prev_bound, prev_cum = 0.0, 0.0
+    for bound, cum in buckets:
+        if cum >= target and cum > prev_cum:
+            frac = (target - prev_cum) / (cum - prev_cum)
+            return prev_bound + (bound - prev_bound) * max(0.0, min(1.0, frac))
+        prev_bound, prev_cum = bound, cum
+    return buckets[-1][0] if buckets else 0.0
+
+
+def compile_entries(counters: Dict[str, float]) -> Dict[str, Dict[str, float]]:
+    """{entry: {count, total_ms, p50_ms, p95_ms, compiles, retraces}}
+    rebuilt from the compile_ms histogram flats + compile/retrace
+    counters."""
+    out: Dict[str, Dict[str, float]] = {}
+    buckets: Dict[str, List[Tuple[float, float]]] = {}
+    for name, value in counters.items():
+        if not name.startswith(COMPILE_MS_PREFIX):
+            continue
+        rest = name[len(COMPILE_MS_PREFIX):]
+        if ".bucket.le_" in rest:
+            entry, label = rest.split(".bucket.le_", 1)
+            buckets.setdefault(entry, []).append((_parse_bound(label), value))
+        elif rest.endswith(".sum"):
+            out.setdefault(rest[:-len(".sum")], {})["total_ms"] = float(value)
+        elif rest.endswith(".count"):
+            out.setdefault(rest[:-len(".count")], {})["count"] = int(value)
+    for entry, row in out.items():
+        ordered = sorted(buckets.get(entry, []))
+        total = row.get("count", 0)
+        row["p50_ms"] = _quantile(ordered, total, 0.50)
+        row["p95_ms"] = _quantile(ordered, total, 0.95)
+        row["compiles"] = int(counters.get(f"engine.compile.{entry}", 0))
+        row["retraces"] = int(counters.get(f"engine.retrace.{entry}", 0))
+    return out
+
+
+def compile_time_table(counters: Dict[str, float]) -> str:
+    """'compile time by entry' table (hottest first by total ms), or ''
+    when the export carries no compile observability."""
+    rows = compile_entries(counters)
+    if not rows:
+        return ""
+    ordered = sorted(rows.items(), key=lambda kv: -kv[1].get("total_ms", 0.0))
+    name_w = max(len("jit entry"), max(len(e) for e in rows))
+    lines = ["== compile time by entry (engine.compile_ms.*) =="]
+    lines.append(
+        f"{'jit entry':<{name_w}}  {'compiles':>8}  {'retraces':>8}  "
+        f"{'total_ms':>10}  {'p50_ms':>9}  {'p95_ms':>9}"
+    )
+    for entry, row in ordered:
+        lines.append(
+            f"{entry:<{name_w}}  {row.get('compiles', 0):>8}  "
+            f"{row.get('retraces', 0):>8}  "
+            f"{row.get('total_ms', 0.0):>10.1f}  "
+            f"{row.get('p50_ms', 0.0):>9.1f}  {row.get('p95_ms', 0.0):>9.1f}"
+        )
+    return "\n".join(lines)
+
+
+def cause_table(counters: Dict[str, float],
+                events: Optional[List[dict]] = None) -> str:
+    """'retraces by cause' table (taxonomy counts, hottest first), with
+    the concrete per-argument lines when the JSONL event stream is
+    given; '' when the export carries neither."""
+    kinds = sorted(
+        ((k[len(CAUSE_PREFIX):], int(v)) for k, v in counters.items()
+         if k.startswith(CAUSE_PREFIX)),
+        key=lambda kv: (-kv[1], kv[0]),
+    )
+    details: TallyCounter = TallyCounter()
+    for rec in events or []:
+        if rec.get("event") != "retrace_cause":
+            continue
+        details[
+            f"{rec.get('entry', '?')}: {rec.get('arg', '?')} "
+            f"{rec.get('old')}→{rec.get('new')} "
+            f"({rec.get('cause', '?')})"
+        ] += 1
+    if not kinds and not details:
+        return ""
+    lines = ["== retraces by cause (engine.retrace_cause.*) =="]
+    if kinds:
+        name_w = max(len("cause"), max(len(k) for k, _ in kinds))
+        lines.append(f"{'cause':<{name_w}}  {'retraces':>8}")
+        for kind, count in kinds:
+            lines.append(f"{kind:<{name_w}}  {count:>8}")
+    if details:
+        lines.append("")
+        lines.append("-- cause records (from the JSONL stream) --")
+        for line, count in sorted(details.items(),
+                                  key=lambda kv: (-kv[1], kv[0])):
+            lines.append(f"{count:>4}x  {line}")
+    return "\n".join(lines)
+
+
+def footer(counters: Dict[str, float]) -> str:
+    first = counters.get("engine.compile_obs.first_compile_ms")
+    retrace = counters.get("engine.compile_obs.retrace_ms")
+    aot = counters.get("engine.compile_obs.aot_ms")
+    entries = counters.get("engine.compile_obs.cache_entries")
+    if first is None and entries is None:
+        return ""
+    return (
+        f"cumulative: {float(first or 0):.1f} ms first-compile, "
+        f"{float(retrace or 0):.1f} ms retrace, "
+        f"{float(aot or 0):.1f} ms census-AOT; "
+        f"{int(entries or 0)} trace-cache entr"
+        f"{'y' if int(entries or 0) == 1 else 'ies'}"
+    )
+
+
+def load_events(path: str) -> List[dict]:
+    """Parsed JSONL records (the manifest first line rides along and is
+    ignored by the tables); truncated tail lines are tolerated — a live
+    stream's last line may be mid-write."""
+    records = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return records
+
+
+def render_report(counters: Dict[str, float],
+                  events: Optional[List[dict]] = None) -> str:
+    sections = [
+        compile_time_table(counters),
+        cause_table(counters, events),
+        footer(counters),
+    ]
+    body = "\n\n".join(s for s in sections if s)
+    return body if body else (
+        "no compile observability in this export — run with "
+        "BCG_TPU_COMPILE_OBS=1 (bcg_tpu/obs/compile.py)"
+    )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Compile time by entry + retraces by cause from a "
+        "counters-bearing export (trace JSON, bench JSON, or a flat "
+        "snapshot)."
+    )
+    parser.add_argument("file", help="trace/bench/snapshot JSON path")
+    parser.add_argument("--events", default=None,
+                        help="retrace-cause JSONL stream "
+                        "(BCG_TPU_COMPILE_OBS=<path>)")
+    args = parser.parse_args(argv)
+    try:
+        with open(args.file) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError) as exc:
+        print(f"compile_report: cannot read {args.file}: {exc}",
+              file=sys.stderr)
+        return 1
+    events = None
+    if args.events:
+        try:
+            events = load_events(args.events)
+        except OSError as exc:
+            print(f"compile_report: cannot read {args.events}: {exc}",
+                  file=sys.stderr)
+            return 1
+    print(render_report(extract_counters(data), events))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

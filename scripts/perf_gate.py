@@ -52,9 +52,9 @@ from typing import Dict, List, Optional
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 SCENARIOS = ("serve", "engine", "paged", "sampler", "int4", "consensus",
-             "fleet", "hostsync", "hlo")
+             "fleet", "hostsync", "compile", "hlo")
 REGRESSIONS = ("none", "spec-off", "fail-rows", "events-off",
-               "straggler-off", "hostsync-off")
+               "straggler-off", "hostsync-off", "compile-off")
 
 DECISION = {
     "type": "object",
@@ -879,6 +879,120 @@ def run_hostsync_scenario(inject: str = "none") -> Dict[str, float]:
     }
 
 
+def run_compile_scenario(inject: str = "none") -> Dict[str, float]:
+    """Compile-cost observability (bcg_tpu/obs/compile.py) gates — the
+    drift baseline for ROADMAP item 2's mega-round and the sweep tier's
+    per-tenant signature multiplication, pinned the way hostsync pinned
+    the transfer structure:
+
+    * ``steady_state_retraces`` — compile + retrace counter movement
+      over an identical-shape warm repeat call (must be 0 EXACT: the
+      observer's seams are the SAME trace-cache-miss accounting the
+      engine already keys on, so enabling observability can never
+      provoke a compile).
+    * ``retrace_cause_coverage`` — structured cause records emitted per
+      counted retrace over a PROVOKED retrace (a new max_tokens on the
+      warm engine ⇒ new max_new/cache_len signatures).  Acceptance:
+      every counted retrace carries a cause (min 0.95).
+    * ``compile_cache_entries`` — distinct (entry, signature) pairs the
+      observer accounted over the whole scenario (banded: the tiny
+      engine's prefill + decode_loop signatures, cold + provoked).
+    * ``error_rows`` — every row parses as valid guided JSON (the
+      decision benchmark can't degrade to cover a compile regression).
+
+    ``compile-off`` injection unsets the flag — the observer accounts
+    nothing and the gate must FAIL naming retrace_cause_coverage /
+    compile_cache_entries rather than pass vacuously (zero-surface
+    means zero metrics, not green metrics)."""
+    _force_cpu()
+    from bcg_tpu.config import EngineConfig
+    from bcg_tpu.engine.jax_engine import JaxEngine
+    from bcg_tpu.obs import compile as obs_compile
+    from bcg_tpu.obs import counters as obs_counters
+
+    # Save/restore the RAW value (None vs "") — registry accessors
+    # cannot round-trip "was unset".
+    prior = os.environ.get("BCG_TPU_COMPILE_OBS")  # lint: ignore[BCG-ENV-RAW]
+    if inject == "compile-off":
+        os.environ.pop("BCG_TPU_COMPILE_OBS", None)
+    else:
+        os.environ["BCG_TPU_COMPILE_OBS"] = "1"
+    obs_compile.reset()
+    prompts = [
+        ("honest agent system prompt", "Round 3: propose a value", DECISION),
+        ("byzantine agent system prompt", "Round 3: vote now", VOTE),
+        ("honest agent system prompt", "Round 4: propose a value", DECISION),
+    ]
+    try:
+        eng = JaxEngine(EngineConfig(
+            backend="jax", model_name="bcg-tpu/tiny-test",
+            max_model_len=2048,
+        ))
+        try:
+            cold = eng.batch_generate_json(prompts, temperature=0.0,
+                                           max_tokens=64)
+            # Steady state: an identical-shape repeat compiles NOTHING.
+            before_warm = obs_counters.snapshot()
+            warm = eng.batch_generate_json(prompts, temperature=0.0,
+                                           max_tokens=64)
+            warm_moved = obs_counters.delta(before_warm)
+            # Provoked retrace: a new token budget on the warm engine is
+            # a new max_new (decode loop) and cache_len (prefill)
+            # signature — each must carry exactly one cause record.
+            before_provoke = obs_counters.snapshot()
+            provoked = eng.batch_generate_json(prompts, temperature=0.0,
+                                               max_tokens=96)
+            provoke_moved = obs_counters.delta(before_provoke)
+        finally:
+            eng.shutdown()
+        # Per-scenario population from THE OBSERVER OBJECT, not a gauge
+        # delta: the gauge holds absolute values, and an observer an
+        # earlier in-process scenario created (any note_signature under
+        # BCG_TPU_COMPILE_OBS) may have left it higher than this fresh
+        # observer's count — a delta would go negative and fail the
+        # band spuriously.  compile-off: no observer, 0.
+        obs_active = obs_compile.observer()
+        entries = (
+            obs_active.brief()["cache_entries"]
+            if obs_active is not None else 0
+        )
+    finally:
+        if prior is None:
+            os.environ.pop("BCG_TPU_COMPILE_OBS", None)
+        else:
+            os.environ["BCG_TPU_COMPILE_OBS"] = prior
+        obs_compile.reset()
+    # Prefix note: the observer's own families spell their segment with
+    # an underscore (engine.compile_ms / engine.compile_obs /
+    # engine.retrace_cause), so the dotted engine.compile. /
+    # engine.retrace. prefixes below match ONLY the per-entry
+    # trace-cache counters.
+    steady = sum(
+        v for k, v in warm_moved.items()
+        if k.startswith(("engine.retrace.", "engine.compile."))
+    )
+    retraces = sum(
+        v for k, v in provoke_moved.items()
+        if k.startswith("engine.retrace.")
+    )
+    causes = sum(
+        v for k, v in provoke_moved.items()
+        if k.startswith("engine.retrace_cause.")
+    )
+    bad = sum(
+        1 for r in cold + warm + provoked
+        if not isinstance(r, dict) or "error" in r
+    )
+    return {
+        "compile.steady_state_retraces": float(steady),
+        "compile.retrace_cause_coverage": (
+            causes / retraces if retraces else 0.0
+        ),
+        "compile.compile_cache_entries": float(entries),
+        "compile.error_rows": float(bad),
+    }
+
+
 def run_hlo_scenario(inject: str = "none") -> Dict[str, float]:
     """Kernel-census drift findings (scripts/hlo_census.py) as a gated
     metric — 0 findings = the lowered programs still match
@@ -906,6 +1020,7 @@ _RUNNERS = {
     "consensus": run_consensus_scenario,
     "fleet": run_fleet_scenario,
     "hostsync": run_hostsync_scenario,
+    "compile": run_compile_scenario,
     "hlo": run_hlo_scenario,
 }
 

@@ -107,16 +107,21 @@ def render_report(trace: dict, top: int = 20) -> str:
             f"(dropped {dropped} unbalanced event(s) at the ring edge)"
         )
     counters = (trace.get("otherData") or {}).get("counters") or {}
-    # engine.hlo.*, hbm.*, and engine.hostsync.* get their own sections
-    # below, and so do histogram families (the flat .bucket.le_* /
-    # .sum / .count entries) — ranked by raw value (op counts, FLOPs,
-    # byte totals, cumulative bucket counts, per-span sync tallies)
-    # they would crowd every actual event counter out of the top-N
-    # list.
+    # engine.hlo.*, hbm.*, engine.hostsync.*, and the compile-cost
+    # families (engine.compile_ms.* histograms, engine.retrace_cause.*
+    # taxonomy counters, engine.compile_obs.* cumulative totals) get
+    # their own sections below, and so do histogram families (the flat
+    # .bucket.le_* / .sum / .count entries) — ranked by raw value (op
+    # counts, FLOPs, byte totals, cumulative bucket counts, per-span
+    # sync tallies, millisecond totals) they would crowd every actual
+    # event counter out of the top-N list.
     hist_names = histogram_families(counters)
     ranked = sorted(
         ((k, v) for k, v in counters.items()
-         if not k.startswith(("engine.hlo.", "hbm.", "engine.hostsync."))
+         if not k.startswith(("engine.hlo.", "hbm.", "engine.hostsync.",
+                              "engine.compile_ms.",
+                              "engine.retrace_cause.",
+                              "engine.compile_obs."))
          and _histogram_owner(k, hist_names) is None),
         key=lambda kv: (-kv[1], kv[0]),
     )[:max(0, top)]
@@ -154,6 +159,14 @@ def render_report(trace: dict, top: int = 20) -> str:
     if hostsync:
         lines.append("")
         lines.append(hostsync)
+    compile_time = compile_time_section(counters)
+    if compile_time:
+        lines.append("")
+        lines.append(compile_time)
+    causes = retrace_cause_section(counters)
+    if causes:
+        lines.append("")
+        lines.append(causes)
     return "\n".join(lines)
 
 
@@ -350,6 +363,72 @@ def hostsync_section(counters: Dict[str, float]) -> str:
     lines.append(
         f"total {total:.0f} sync(s), {attributed:.0f} attributed{coverage}"
     )
+    return "\n".join(lines)
+
+
+def compile_time_section(counters: Dict[str, float]) -> str:
+    """'compile time by entry' table rebuilt from the exported
+    ``engine.compile_ms.<entry>`` histogram flats plus the
+    ``engine.compile.<entry>`` / ``engine.retrace.<entry>`` counters
+    (bcg_tpu/obs/compile.py), hottest first by total ms, or '' when the
+    export carries no compile observability.  Kept bcg_tpu-import-free
+    like the rest of this report: the counter names alone define the
+    schema (``scripts/compile_report.py`` is the standalone form)."""
+    prefix = "engine.compile_ms."
+    rows: Dict[str, Dict[str, float]] = {}
+    for name, value in counters.items():
+        if not name.startswith(prefix):
+            continue
+        rest = name[len(prefix):]
+        if rest.endswith(".sum"):
+            rows.setdefault(rest[:-len(".sum")], {})["total_ms"] = value
+        elif rest.endswith(".count"):
+            rows.setdefault(rest[:-len(".count")], {})["count"] = value
+    if not rows:
+        return ""
+    name_w = max(len("jit entry"), max(len(e) for e in rows))
+    lines = ["== compile time by entry (engine.compile_ms.*) =="]
+    lines.append(
+        f"{'jit entry':<{name_w}}  {'compiles':>8}  {'retraces':>8}  "
+        f"{'timed':>6}  {'total_ms':>10}"
+    )
+    for entry, row in sorted(rows.items(),
+                             key=lambda kv: -kv[1].get("total_ms", 0.0)):
+        compiles = counters.get(f"engine.compile.{entry}", 0)
+        retraces = counters.get(f"engine.retrace.{entry}", 0)
+        lines.append(
+            f"{entry:<{name_w}}  {compiles:>8.0f}  {retraces:>8.0f}  "
+            f"{row.get('count', 0):>6.0f}  {row.get('total_ms', 0.0):>10.1f}"
+        )
+    first = counters.get("engine.compile_obs.first_compile_ms", 0)
+    retrace_ms = counters.get("engine.compile_obs.retrace_ms", 0)
+    aot = counters.get("engine.compile_obs.aot_ms", 0)
+    lines.append(
+        f"cumulative: {first:.1f} ms first-compile, {retrace_ms:.1f} ms "
+        f"retrace, {aot:.1f} ms census-AOT; "
+        f"{counters.get('engine.compile_obs.cache_entries', 0):.0f} "
+        "trace-cache entries"
+    )
+    return "\n".join(lines)
+
+
+def retrace_cause_section(counters: Dict[str, float]) -> str:
+    """'retraces by cause' table from the exported
+    ``engine.retrace_cause.<kind>`` taxonomy counters, or '' when the
+    export carries none."""
+    prefix = "engine.retrace_cause."
+    rows = sorted(
+        ((k[len(prefix):], v) for k, v in counters.items()
+         if k.startswith(prefix)),
+        key=lambda kv: (-kv[1], kv[0]),
+    )
+    if not rows:
+        return ""
+    name_w = max(len("cause"), max(len(r[0]) for r in rows))
+    lines = ["== retraces by cause (engine.retrace_cause.*) =="]
+    lines.append(f"{'cause':<{name_w}}  {'retraces':>8}")
+    for name, value in rows:
+        lines.append(f"{name:<{name_w}}  {value:>8.0f}")
     return "\n".join(lines)
 
 

@@ -45,6 +45,7 @@ NAMESPACE_OWNERS = {
     "int4": "tests/test_int4_kv.py",
     "fleet": "tests/test_fleet.py",
     "hostsync": "tests/test_hostsync.py",
+    "compile": "tests/test_compile_obs.py",
 }
 # Namespaces owned elsewhere, as the prefix tuple the measurement-match
 # tests skip (derived, not hand-maintained).

@@ -239,6 +239,72 @@ def test_report_renders_hostsync_attribution_table(tmp_path):
     assert "host syncs by span" not in proc2.stdout
 
 
+def test_report_renders_compile_cost_tables(tmp_path):
+    """The compile-cost families (engine.compile_ms.* histograms,
+    engine.retrace_cause.* taxonomy counters, engine.compile_obs.*
+    cumulative totals — bcg_tpu/obs/compile.py) render as the
+    compile-time-by-entry and retraces-by-cause tables AND stay out of
+    the ranked top-counter list (the hlo/hbm/hostsync crowding fix
+    applied to the compile namespace) — still with no bcg_tpu
+    import."""
+    trace = {
+        "traceEvents": [],
+        "otherData": {"counters": {
+            "engine.compile.decode_loop": 2,
+            "engine.retrace.decode_loop": 1,
+            "engine.compile.prefill": 2,
+            "engine.retrace.prefill": 1,
+            "engine.compile_ms.decode_loop.bucket.le_250": 1,
+            "engine.compile_ms.decode_loop.bucket.le_500": 2,
+            "engine.compile_ms.decode_loop.sum": 600.0,
+            "engine.compile_ms.decode_loop.count": 2,
+            "engine.compile_ms.prefill.bucket.le_250": 2,
+            "engine.compile_ms.prefill.sum": 320.0,
+            "engine.compile_ms.prefill.count": 2,
+            "engine.retrace_cause.static_knob": 1,
+            "engine.retrace_cause.shape": 1,
+            "engine.compile_obs.first_compile_ms": 700.0,
+            "engine.compile_obs.retrace_ms": 220.0,
+            "engine.compile_obs.aot_ms": 0.0,
+            "engine.compile_obs.cache_entries": 4,
+            "serve.requests": 3,
+        }},
+    }
+    path = tmp_path / "compile_trace.json"
+    path.write_text(json.dumps(trace))
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, str(path)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "compile time by entry" in proc.stdout
+    section = proc.stdout.split("compile time by entry")[1]
+    # Hottest entry (decode_loop, 600 ms) first.
+    assert section.index("decode_loop") < section.index("prefill")
+    assert "4 trace-cache entries" in section
+    assert "700.0 ms first-compile" in section
+    assert "retraces by cause" in proc.stdout
+    cause = proc.stdout.split("retraces by cause")[1]
+    assert "static_knob" in cause and "shape" in cause
+    # The compile families never crowd the ranked counter list.
+    top_section = proc.stdout.split("top counters")[1].split("\n==")[0]
+    assert "serve.requests" in top_section
+    for family in ("engine.compile_ms", "engine.retrace_cause",
+                   "engine.compile_obs"):
+        assert family not in top_section, family
+    # No compile counters -> no sections.
+    bare = tmp_path / "bare5.json"
+    bare.write_text(json.dumps(
+        {"traceEvents": [], "otherData": {"counters": {"serve.requests": 1}}}
+    ))
+    proc2 = subprocess.run(
+        [sys.executable, SCRIPT, str(bare)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert "compile time by entry" not in proc2.stdout
+    assert "retraces by cause" not in proc2.stdout
+
+
 def test_report_handles_empty_trace(tmp_path):
     empty = tmp_path / "empty.json"
     empty.write_text(json.dumps({"traceEvents": [], "otherData": {}}))
