@@ -721,7 +721,7 @@ _OBS_PREFIX_RE = re.compile(r"^[a-z][a-z0-9_]*\.")
 # new subsystem is a deliberate registry decision, not a call-site
 # spelling.  Extend HERE (and the DESIGN.md table) when one is added.
 _OBS_SUBSYSTEMS = frozenset(
-    {"engine", "serve", "game", "hbm", "kvpool", "fleet"}
+    {"engine", "serve", "game", "hbm", "kvpool", "fleet", "sweep"}
 )
 _OBS_CALL_ATTRS = {
     "inc", "counter", "gauge", "set_gauge", "value", "histogram", "observe",
@@ -791,8 +791,8 @@ def rule_obs_name(ctx: ModuleContext) -> Iterable[Finding]:
     ("Serve.Requests", a bare "requests") fragments the namespace every
     dashboard and baseline keys on.  The leading segment must also be a
     REGISTERED subsystem (``_OBS_SUBSYSTEMS`` — engine/serve/game/hbm/
-    kvpool/fleet): an unknown subsystem is a namespace fork the fleet
-    shard merge and every dashboard would silently split on.  Literal
+    kvpool/fleet/sweep): an unknown subsystem is a namespace fork the
+    fleet shard merge and every dashboard would silently split on.  Literal
     names are checked whole; f-string names have their static fragments
     checked (the leading fragment must carry the subsystem prefix);
     variable names are trusted."""

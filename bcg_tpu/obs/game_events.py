@@ -164,6 +164,10 @@ class GameEventRecorder:
     def __init__(self, sim):
         cfg = sim.config
         self._game_id = f"{sim.run_number}_g{sim._sim_uid}"
+        # Sweep-tier job identity: rides game_start/game_end so resume
+        # logic and report merges can key on the JOB (stable across
+        # processes) instead of the per-process game id.
+        self._job_id = getattr(sim, "sweep_job_id", None)
         self._threshold = float(sim.game.consensus_threshold)
         self._honest_ids = tuple(
             aid for aid, st in sim.game.agents.items() if not st.is_byzantine
@@ -196,9 +200,11 @@ class GameEventRecorder:
         obs_counters.inc("game.games")
         with _agg_lock:
             _agg["games"] += 1
+        job_field = {"job": self._job_id} if self._job_id else {}
         self._emit(
             "game_start",
             round=None,
+            **job_field,
             num_honest=sim.game.num_honest,
             num_byzantine=sim.game.num_byzantine,
             value_range=list(sim.game.value_range),
@@ -345,9 +351,11 @@ class GameEventRecorder:
         obs_counters.inc("game.games.completed")
         if game.consensus_reached:
             obs_counters.inc("game.games.converged")
+        job_field = {"job": self._job_id} if self._job_id else {}
         self._emit(
             "game_end",
             round=len(game.rounds),
+            **job_field,
             converged=bool(game.consensus_reached),
             consensus_value=game.consensus_value,
             rounds=len(game.rounds),

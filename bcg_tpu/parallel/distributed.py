@@ -118,6 +118,17 @@ def build_hybrid_mesh(
     return build_mesh(dp=dp, tp=tp, sp=sp, devices=devices)
 
 
+def mesh_spans_processes(mesh: Mesh) -> bool:
+    """True when the mesh's devices live on more than one process —
+    the dp-across-hosts layout.  Callers use this to pick the
+    global-placement collective forms (``parallel/game_step.
+    exchange_values_global``): a single-device local array fed to a
+    cross-process mesh would make XLA stage an implicit inter-host
+    transfer (refused outright on CPU, silently DCN-expensive on TPU).
+    """
+    return len({d.process_index for d in mesh.devices.flat}) > 1
+
+
 def process_info() -> dict:
     """Cluster shape summary for logs/metrics."""
     return {

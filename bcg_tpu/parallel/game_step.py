@@ -49,6 +49,60 @@ def exchange_values(
     return f(values, neighbor_mask)
 
 
+def exchange_values_global(
+    values_np,         # [n] int32 host array, IDENTICAL on every process
+    neighbor_mask_np,  # [n, n] bool host array, identical on every process
+    mesh: Mesh,
+    axis_name: str = "dp",
+):
+    """Multi-process form of :func:`exchange_values` for meshes whose
+    ``dp`` axis spans hosts (the sweep tier's cooperative one-big-game
+    mode): inputs are plain host arrays — identical on every rank,
+    because every rank runs the same lockstep game — distributed over
+    the GLOBAL mesh via ``make_array_from_callback``, exchanged with
+    the same masked all-gather, then all-gathered once more over rows
+    so the output is REPLICATED: every host reads the full [n, n]
+    received matrix from its addressable shard.  (A local ``jnp.
+    asarray`` input would make XLA stage a cross-process transfer,
+    which the CPU backend refuses and DCN makes implicit — the
+    explicit global placement is the point.)  Returns a NumPy array.
+    """
+    import numpy as np
+
+    values_np = np.asarray(values_np, dtype=np.int32)
+    mask_np = np.asarray(neighbor_mask_np, dtype=bool)
+    values = jax.make_array_from_callback(
+        values_np.shape, NamedSharding(mesh, P(axis_name)),
+        lambda idx: values_np[idx],
+    )
+    mask = jax.make_array_from_callback(
+        mask_np.shape, NamedSharding(mesh, P(axis_name, None)),
+        lambda idx: mask_np[idx],
+    )
+
+    def body(local_vals, mask_rows):
+        all_vals = jax.lax.all_gather(local_vals, axis_name, tiled=True)
+        received = jnp.where(
+            mask_rows & (all_vals >= 0)[None, :], all_vals[None, :], -1
+        )
+        # Second gather: replicate the full matrix onto every device so
+        # each HOST can read the whole round locally.
+        return jax.lax.all_gather(received, axis_name, tiled=True)
+
+    # check_rep=False: the trailing all_gather DOES replicate the
+    # output over dp, but shard_map's static replication checker cannot
+    # see through a tiled gather to prove it.
+    f = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis_name), P(axis_name, None)),
+        out_specs=P(None, None),
+        check_rep=False,
+    )
+    out = f(values, mask)
+    return np.asarray(out.addressable_shards[0].data)
+
+
 def tally_votes(
     votes: jax.Array,   # [n] int32: 1 stop / 0 continue / -1 abstain
     mesh: Mesh,

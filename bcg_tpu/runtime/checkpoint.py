@@ -40,7 +40,8 @@ def load_checkpoint(path: str) -> dict:
         return json.load(f)
 
 
-def resume_simulation(path: str, config=None, engine=None):
+def resume_simulation(path: str, config=None, engine=None,
+                      sweep_job_id=None):
     """Rebuild a :class:`BCGSimulation` from a checkpoint.
 
     The restored game is authoritative: agents are re-created from ITS
@@ -48,6 +49,8 @@ def resume_simulation(path: str, config=None, engine=None):
     roll different roles than the checkpoint), then their memories are
     restored.  ``sim.run()`` continues from the next round under the
     original run number, appending to the original log.
+    ``sweep_job_id`` re-stamps the sweep tier's job identity on the
+    resumed game's event records (bcg_tpu/sweep resume path).
     """
     from bcg_tpu.config import BCGConfig
     from bcg_tpu.game import ByzantineConsensusGame
@@ -60,6 +63,7 @@ def resume_simulation(path: str, config=None, engine=None):
         engine=engine,
         run_number=blob["run_number"],
         log_mode="a",
+        sweep_job_id=sweep_job_id,
     )
     sim.game = ByzantineConsensusGame.from_snapshot(blob["game"])
     # Re-create agents against the restored game's roles (the initial

@@ -325,6 +325,28 @@ _register(
     "Write a resumable checkpoint every N game rounds (runtime/"
     "checkpoint.py), independent of --checkpoint-every-round; 0 = off.",
 )
+# BCG_TPU_SWEEP_* — multi-tenant sweep tier (bcg_tpu/sweep).
+_register(
+    "BCG_TPU_SWEEP_DIR", "str", None,
+    "Default output directory for `python -m bcg_tpu.sweep run` (the "
+    "sweep manifest, per-rank game-event files, and per-job round "
+    "checkpoints land here; --out overrides).  Unset = "
+    "./sweeps/<spec name>.",
+)
+_register(
+    "BCG_TPU_SWEEP_MAX_CONCURRENT", "int", 4,
+    "Games in flight at once per rank in a sweep (worker threads over "
+    "the rank's job partition); each game is a tenant of the shared "
+    "serving scheduler, so this bounds tenant concurrency, not batch "
+    "size.",
+)
+_register(
+    "BCG_TPU_SWEEP_TENANT_QUOTA_ROWS", "int", 0,
+    "Per-tenant queued-row quota on the sweep's shared scheduler: a "
+    "tenant submitting past it is deferred with an SLO-headroom-"
+    "derived retry-after (AdmissionDeferred) instead of hard-rejected; "
+    "0 = unlimited.",
+)
 _register(
     "BCG_TPU_COLLECTIVE_WATCHDOG_S", "int", 0,
     "Collective-barrier watchdog period in seconds: force-retire "

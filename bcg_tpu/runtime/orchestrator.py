@@ -95,8 +95,14 @@ class BCGSimulation:
         engine: Optional[InferenceEngine] = None,
         run_number: Optional[str] = None,
         log_mode: str = "w",
+        sweep_job_id: Optional[str] = None,
     ):
         self.config = config or BCGConfig()
+        # Sweep-tier job identity (bcg_tpu/sweep): stamped into the
+        # game-event stream's game_start/game_end records so sweep
+        # resume and cross-host report merging can account games by
+        # JOB, not by per-process game ids.  None outside a sweep.
+        self.sweep_job_id = sweep_job_id
         game_cfg = self.config.game
         metrics_cfg = self.config.metrics
 
@@ -184,6 +190,7 @@ class BCGSimulation:
         self._spmd_mesh = None
         self._spmd_mask = None
         self._spmd_mask_np = None
+        self._spmd_multiprocess = False
         self._spmd_message_count = 0
 
     @staticmethod
@@ -740,7 +747,10 @@ class BCGSimulation:
         import numpy as np
 
         from bcg_tpu.comm.a2a_sim import truncate_reasoning
-        from bcg_tpu.parallel.game_step import exchange_values
+        from bcg_tpu.parallel.game_step import (
+            exchange_values,
+            exchange_values_global,
+        )
         from bcg_tpu.parallel.mesh import build_mesh
 
         ids = sorted(self.agents)
@@ -759,20 +769,34 @@ class BCGSimulation:
             # delivery for asymmetric custom adjacency.
             self._spmd_mask_np = self.topology.neighbor_mask().T.copy()
             self._spmd_mask = jnp.asarray(self._spmd_mask_np)
+            # dp-across-hosts (the sweep tier's cooperative one-big-game
+            # mode): every rank runs this same lockstep loop, so the
+            # exchange must place inputs on the GLOBAL mesh explicitly
+            # and replicate the result back to every host.
+            from bcg_tpu.parallel.distributed import mesh_spans_processes
+
+            self._spmd_multiprocess = mesh_spans_processes(self._spmd_mesh)
 
         lo = self.config.game.value_range[0]
-        encoded = jnp.asarray(
+        encoded_np = np.asarray(
             [
                 (self.game.agents[a].proposed_value - lo)
                 if self.game.agents[a].proposed_value is not None
                 else -1
                 for a in ids
             ],
-            dtype=jnp.int32,
+            dtype=np.int32,
         )
-        received = np.asarray(
-            exchange_values(encoded, self._spmd_mask, self._spmd_mesh)
-        )
+        if self._spmd_multiprocess:
+            received = exchange_values_global(
+                encoded_np, self._spmd_mask_np, self._spmd_mesh
+            )
+        else:
+            received = np.asarray(
+                exchange_values(
+                    jnp.asarray(encoded_np), self._spmd_mask, self._spmd_mesh
+                )
+            )
 
         reasonings = {
             aid: truncate_reasoning(

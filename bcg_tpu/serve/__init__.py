@@ -15,23 +15,29 @@ fallback.
 
 from bcg_tpu.serve.engine import ServingEngine, run_serving_simulations
 from bcg_tpu.serve.scheduler import (
+    AdmissionDeferred,
     AdmissionRejected,
     Request,
     RequestCancelled,
     Scheduler,
     SchedulerClosed,
     SchedulerStats,
+    TenantState,
+    derive_retry_after_ms,
     derive_row_cap,
 )
 
 __all__ = [
+    "AdmissionDeferred",
     "AdmissionRejected",
     "Request",
     "RequestCancelled",
     "Scheduler",
     "SchedulerClosed",
     "SchedulerStats",
+    "TenantState",
     "ServingEngine",
+    "derive_retry_after_ms",
     "derive_row_cap",
     "run_serving_simulations",
 ]

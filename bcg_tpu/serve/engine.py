@@ -13,10 +13,11 @@ game simply stops submitting.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional
 
 from bcg_tpu.engine.interface import InferenceEngine, per_row_settings as _rows
-from bcg_tpu.serve.scheduler import Scheduler
+from bcg_tpu.serve.scheduler import AdmissionDeferred, Scheduler, SchedulerClosed
 
 
 class ServingEngine(InferenceEngine):
@@ -27,13 +28,41 @@ class ServingEngine(InferenceEngine):
     engine down (for callers that created the inner engine solely to
     wrap it); by default the inner engine stays caller-owned, matching
     the collective proxy's contract.
+
+    ``tenant`` attributes every call to a registered scheduler tenant
+    (the sweep tier hands each game its own proxy over ONE shared
+    scheduler): quota deferrals (:class:`AdmissionDeferred`) are
+    retried transparently after the scheduler's retry-after hint, so
+    the game thread experiences backpressure as latency, never as an
+    exception.
     """
 
     def __init__(self, engine: InferenceEngine, *, owns_inner: bool = False,
-                 scheduler: Optional[Scheduler] = None, **scheduler_kwargs):
+                 scheduler: Optional[Scheduler] = None,
+                 tenant: Optional[str] = None, **scheduler_kwargs):
         self._engine = engine
         self._owns_inner = owns_inner
+        self._tenant = tenant
         self.scheduler = scheduler or Scheduler(engine, **scheduler_kwargs)
+
+    def _submit_with_retry(self, sig, payload, temps, budgets) -> List:
+        """submit_and_wait, retrying tenant-quota deferrals after the
+        carried retry-after.  Progress is guaranteed while the
+        scheduler lives (the quota frees when one of this tenant's
+        queued batches dispatches); a dead scheduler surfaces as
+        :class:`SchedulerClosed` instead of an infinite backoff."""
+        while True:
+            try:
+                return self.scheduler.submit_and_wait(
+                    sig, payload, temps, budgets, tenant=self._tenant
+                )
+            except AdmissionDeferred as e:
+                if not self.scheduler._thread.is_alive():
+                    raise SchedulerClosed(
+                        "scheduler thread died while this tenant backed "
+                        "off a quota deferral"
+                    ) from e
+                time.sleep(e.retry_after_s)
 
     # --------------------------------------------------- InferenceEngine API
 
@@ -43,7 +72,7 @@ class ServingEngine(InferenceEngine):
         n = len(prompts)
         # One signature for ALL guided calls: temperature and budget ride
         # per-row, so a game mid-decide merges with a game mid-vote.
-        return self.scheduler.submit_and_wait(
+        return self._submit_with_retry(
             ("json",), list(prompts),
             _rows(temperature, n, float), _rows(max_tokens, n, int),
         )
@@ -58,7 +87,7 @@ class ServingEngine(InferenceEngine):
         if not prompts:
             return []
         n = len(prompts)
-        return self.scheduler.submit_and_wait(
+        return self._submit_with_retry(
             ("free", float(top_p)), list(prompts),
             _rows(temperature, n, float), _rows(max_tokens, n, int),
         )

@@ -89,6 +89,94 @@ class AdmissionRejected(RuntimeError):
     device bucket (strict mode) so queueing it would just stall it."""
 
 
+class AdmissionDeferred(RuntimeError):
+    """Request deferred at admission: its tenant's queued-row quota is
+    full RIGHT NOW, but the condition is transient — retry after
+    ``retry_after_s`` seconds (derived from the scheduler's live device
+    latency and SLO headroom, :func:`derive_retry_after_ms`) instead of
+    treating this as a hard failure.  :class:`~bcg_tpu.serve.engine.
+    ServingEngine` retries transparently; direct submitters decide."""
+
+    def __init__(self, message: str, retry_after_s: float):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+def derive_retry_after_ms(
+    device_p50_ms: float,
+    linger_ms: float,
+    slo_ms: int = 0,
+    headroom_p50_ms: Optional[float] = None,
+) -> float:
+    """Retry-after hint for a deferred admission, in milliseconds.
+
+    The base is one device dispatch worth of time (the median device
+    latency, floored by the linger window and 1 ms): a deferred tenant's
+    quota frees exactly when one of its queued batches dispatches, so
+    retrying sooner than a dispatch takes is pure spin.  Under a
+    configured SLO the base is scaled by admission PRESSURE read off the
+    ``serve.slo.headroom_ms`` histogram's median: full headroom
+    (p50 == objective) leaves the base untouched, exhausted headroom
+    (p50 at/under 0 — the le=0 violation bucket) quadruples it.  The
+    scale is monotone non-increasing in headroom by construction —
+    perf_gate's ``sweep.retry_after_monotonicity`` metric pins that
+    shape, so the backoff can never invert under load."""
+    base = max(float(linger_ms), float(device_p50_ms), 1.0)
+    if not slo_ms or headroom_p50_ms is None:
+        return base
+    frac = min(1.0, max(0.0, float(headroom_p50_ms) / float(slo_ms)))
+    return base * (4.0 - 3.0 * frac)
+
+
+class TenantState:
+    """Per-tenant accounting for multi-tenant scheduling (the sweep
+    tier's games-as-tenants model, :mod:`bcg_tpu.sweep`).
+
+    ``weight`` sets the tenant's fair share of dispatched rows
+    (weighted-fair ordering keys on ``served_rows / weight``);
+    ``priority`` orders strictly above fairness (higher first);
+    ``quota_rows`` bounds the tenant's QUEUED rows — a submit past it
+    is deferred with a retry-after, never hard-rejected.  A lone
+    request larger than the quota still admits once the tenant's queue
+    is empty (the admission watermark's oversize carve-out), so
+    ``max_queued_rows`` can exceed the quota only by way of such a
+    request's own rows."""
+
+    __slots__ = ("name", "weight", "priority", "quota_rows", "queued_rows",
+                 "served_rows", "deferrals", "max_queued_rows")
+
+    def __init__(self, name: str, weight: float = 1.0, priority: int = 0,
+                 quota_rows: Optional[int] = None):
+        if weight <= 0:
+            raise ValueError(f"tenant {name!r}: weight must be > 0")
+        self.name = name
+        self.weight = float(weight)
+        self.priority = int(priority)
+        self.quota_rows = quota_rows
+        self.queued_rows = 0
+        self.served_rows = 0
+        self.deferrals = 0
+        self.max_queued_rows = 0  # high-water: quota-exactness evidence
+
+    @property
+    def vtime(self) -> float:
+        """Weighted virtual time: the tenant with the SMALLEST vtime is
+        the most underserved and dispatches next (start-time fair
+        queueing over rows)."""
+        return self.served_rows / self.weight
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "weight": self.weight,
+            "priority": self.priority,
+            "quota_rows": self.quota_rows,
+            "queued_rows": self.queued_rows,
+            "served_rows": self.served_rows,
+            "deferrals": self.deferrals,
+            "max_queued_rows": self.max_queued_rows,
+        }
+
+
 class RequestCancelled(TimeoutError):
     """Request missed its deadline before dispatch (or the scheduler
     went away while it was queued)."""
@@ -103,13 +191,15 @@ class Request:
 
     __slots__ = ("sig", "payload", "n_rows", "temps", "budgets", "deadline",
                  "submitted_at", "enqueued_at", "done", "results", "error",
-                 "span", "req_id")
+                 "span", "req_id", "tenant")
 
     _ids = itertools.count(1)  # process-wide: ids stay unique across schedulers
 
     def __init__(self, sig: Tuple, payload: List, temps: List[float],
-                 budgets: List[int], deadline: Optional[float]):
+                 budgets: List[int], deadline: Optional[float],
+                 tenant: Optional[str] = None):
         self.req_id = next(Request._ids)
+        self.tenant = tenant
         self.sig = sig
         self.payload = payload
         self.n_rows = len(payload)
@@ -158,6 +248,7 @@ class SchedulerStats:
         self.failed = 0            # engine raised for the request's batch
         self.cancelled = 0         # deadline expiry / close while queued
         self.rejected = 0          # strict admission refusals
+        self.deferred = 0          # tenant-quota deferrals (retry-after)
         self.dispatches = 0
         self.dispatched_rows = 0
         self.merged_dispatches = 0  # dispatches that merged >1 request
@@ -237,8 +328,11 @@ class SchedulerStats:
 
     def snapshot(self, row_cap: Optional[int] = None,
                  queue_rows: int = 0,
-                 kv_pool: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
-        done = self.completed + self.failed + self.cancelled + self.rejected
+                 kv_pool: Optional[Dict[str, Any]] = None,
+                 tenants: Optional[Dict[str, "TenantState"]] = None,
+                 ) -> Dict[str, Any]:
+        done = (self.completed + self.failed + self.cancelled
+                + self.rejected + self.deferred)
         hist_keys = [f"<={b}ms" for b in _QUEUE_WAIT_BUCKETS_MS] + [
             f">{_QUEUE_WAIT_BUCKETS_MS[-1]}ms"
         ]
@@ -251,6 +345,7 @@ class SchedulerStats:
             "failed": self.failed,
             "cancelled": self.cancelled,
             "rejected": self.rejected,
+            "deferred": self.deferred,
             "pending": self.submitted - done,  # queued or mid-dispatch
             "queue_rows": queue_rows,
             "max_queue_rows": self.max_queue_rows,
@@ -335,6 +430,17 @@ class SchedulerStats:
                 }
                 if obs_hostsync.enabled() else None
             ),
+            # Multi-tenant view (the sweep tier's games-as-tenants
+            # model): per-tenant fair-share accounting — served rows,
+            # queued rows vs quota (max_queued_rows is the quota-
+            # exactness evidence: it can never exceed quota_rows), and
+            # retry-after deferrals.  None when no tenant ever
+            # registered (single-tenant schedulers carry no extra
+            # surface).
+            "tenants": (
+                {name: t.snapshot() for name, t in sorted(tenants.items())}
+                if tenants else None
+            ),
             # Compile-cost view (BCG_TPU_COMPILE_OBS, obs/compile.py):
             # trace-cache population, retrace/cause totals, and the
             # cumulative compile milliseconds this process has paid —
@@ -402,6 +508,7 @@ class Scheduler:
         deadline_ms: Optional[int] = None,
         strict_admission: Optional[bool] = None,
         slo_ms: Optional[int] = None,
+        fair: bool = True,
     ):
         self._engine = engine
         if linger_ms is None:
@@ -430,6 +537,20 @@ class Scheduler:
         self._queue: List[Request] = []
         self._queue_rows = 0
         self._closed = False
+        # Multi-tenant scheduling (games-as-tenants, bcg_tpu/sweep):
+        # empty = every request rides the anonymous default tenant and
+        # dispatch order is byte-identical to the single-tenant
+        # scheduler (FIFO within signature groups).  ``fair=False`` is
+        # the perf_gate fairness-off injection arm — tenants register
+        # and quotas enforce, but batch selection degrades to FIFO.
+        self._tenants: Dict[str, TenantState] = {}
+        self._fair = fair
+        # Shared fair-share account for UNTENANTED requests on a
+        # tenanted scheduler: without it they would carry a permanent
+        # virtual time of 0 and outrank every tenant with history —
+        # exactly the starvation fairness exists to prevent.  No quota,
+        # excluded from the snapshot's tenants block.
+        self._anon_tenant = TenantState("(untenanted)")
         # Serializes device access: held ONLY around the inner engine
         # call itself, never while holding self._cond and never while a
         # request waits for queue admission — so it cannot participate in
@@ -445,18 +566,81 @@ class Scheduler:
         obs_export.maybe_start_http_server()
         obs_fleet.maybe_start_shard_writer()
 
+    # -------------------------------------------------------------- tenancy
+
+    def register_tenant(self, name: str, *, weight: float = 1.0,
+                        priority: int = 0,
+                        quota_rows: Optional[int] = None) -> TenantState:
+        """Declare (or re-fetch) a tenant.  Idempotent per name — a
+        re-registration updates weight/priority/quota but keeps the
+        served-rows history, so a resumed sweep job re-registering its
+        tenant does not reset its fair-share position."""
+        with self._cond:
+            t = self._tenants.get(name)
+            if t is None:
+                t = self._tenants[name] = TenantState(
+                    name, weight=weight, priority=priority,
+                    quota_rows=quota_rows,
+                )
+            else:
+                if weight <= 0:
+                    raise ValueError(f"tenant {name!r}: weight must be > 0")
+                t.weight = float(weight)
+                t.priority = int(priority)
+                t.quota_rows = quota_rows
+            return t
+
+    def tenant_stats(self) -> Optional[Dict[str, Dict[str, Any]]]:
+        with self._cond:
+            if not self._tenants:
+                return None
+            return {n: t.snapshot() for n, t in sorted(self._tenants.items())}
+
+    def retry_after_ms(self) -> float:
+        """Live retry-after hint (see :func:`derive_retry_after_ms`):
+        median device latency scaled by SLO-headroom pressure."""
+        device_p50 = self.stats._hist_snapshot("device")["p50_ms"]
+        headroom = None
+        if self.stats.slo_ms:
+            h = self.stats._hist_snapshot("slo_headroom")
+            headroom = h["p50_ms"] if h["count"] else None
+        return derive_retry_after_ms(
+            device_p50, self._linger_s * 1e3, self.stats.slo_ms, headroom
+        )
+
+    def _fair_tenant(self, req: Request) -> TenantState:
+        """The fair-share account a request charges: its registered
+        tenant, or the shared untenanted account (unregistered tenant
+        names included — an unknown name must not mint a zero-history
+        queue-jumper)."""
+        t = self._tenants.get(req.tenant) if req.tenant else None
+        return t if t is not None else self._anon_tenant
+
+    def _fair_key(self, req: Request):
+        """Batch-selection order under tenancy: priority class strictly
+        first (higher dispatches sooner), then weighted virtual time
+        (most underserved tenant first), then arrival — which is the
+        whole ordering (pure FIFO) when no tenants exist or fairness is
+        disabled."""
+        t = self._fair_tenant(req)
+        return (-t.priority, t.vtime, req.enqueued_at, req.req_id)
+
     # ------------------------------------------------------------ submission
 
     def submit(self, sig: Tuple, payload: List, temps: List[float],
-               budgets: List[int]) -> Request:
+               budgets: List[int], tenant: Optional[str] = None) -> Request:
         """Enqueue one call; returns its :class:`Request` future.
 
         Blocks for queue admission (backpressure) when the queued row
         count would exceed ``max_queue_rows``; rejects oversize requests
-        under strict admission."""
+        under strict admission.  ``tenant`` attributes the request to a
+        registered tenant: its queued-row quota is enforced here (a
+        full quota fails the request with :class:`AdmissionDeferred`
+        carrying a retry-after — transient, unlike the strict-admission
+        reject) and its weight/priority order batch selection."""
         now = time.monotonic()
         deadline = now + self._deadline_s if self._deadline_s > 0 else None
-        req = Request(sig, payload, temps, budgets, deadline)
+        req = Request(sig, payload, temps, budgets, deadline, tenant=tenant)
         req.submitted_at = now
         # Cross-thread parent handoff: the dispatch thread parents its
         # queue_wait/batch_form/device spans to the submitter's
@@ -523,9 +707,44 @@ class Scheduler:
                 req.fail(SchedulerClosed("scheduler shut down during admission"))
                 self._emit(req, "cancelled", reason="closed_during_admission")
                 return req
+            # Tenant quota, checked AND charged under this same lock
+            # hold (checking before the backpressure wait would let a
+            # second same-tenant submit slip in while this one slept,
+            # overshooting the quota).  Quota full is TRANSIENT — it
+            # frees when one of the tenant's queued batches dispatches —
+            # so defer with a retry-after instead of hard-rejecting: a
+            # sweep tenant under pressure backs off instead of dying.
+            t = self._tenants.get(tenant) if tenant else None
+            # A lone request LARGER than the quota must still admit once
+            # the tenant's queue drains (compare against max(quota, n):
+            # deferring it unconditionally would livelock the
+            # ServingEngine retry loop forever — the admission
+            # watermark's oversize carve-out, applied to quotas).
+            quota = (
+                max(t.quota_rows, req.n_rows)
+                if t is not None and t.quota_rows is not None else None
+            )
+            if quota is not None and t.queued_rows + req.n_rows > quota:
+                self.stats.deferred += 1
+                t.deferrals += 1
+                retry_s = self.retry_after_ms() / 1e3
+                req.fail(AdmissionDeferred(
+                    f"tenant {tenant!r} quota of {t.quota_rows} rows is "
+                    f"full ({t.queued_rows} queued); retry after "
+                    f"{retry_s * 1e3:.1f} ms",
+                    retry_after_s=retry_s,
+                ))
+                obs_counters.inc("serve.deferrals")
+                self._emit(req, "deferred", tenant=tenant,
+                           quota_rows=t.quota_rows,
+                           retry_after_ms=round(retry_s * 1e3, 3))
+                return req
             req.enqueued_at = time.monotonic()
             self._queue.append(req)
             self._queue_rows += req.n_rows
+            if t is not None:
+                t.queued_rows += req.n_rows
+                t.max_queued_rows = max(t.max_queued_rows, t.queued_rows)
             self.stats.max_queue_rows = max(
                 self.stats.max_queue_rows, self._queue_rows
             )
@@ -543,7 +762,8 @@ class Scheduler:
         )
 
     def submit_and_wait(self, sig: Tuple, payload: List, temps: List[float],
-                        budgets: List[int]) -> List:
+                        budgets: List[int],
+                        tenant: Optional[str] = None) -> List:
         """Enqueue and block until completion; raises the request's error.
 
         The whole submit→complete lifetime is one ``serve.request`` span
@@ -552,7 +772,7 @@ class Scheduler:
         """
         with obs_tracer.span("serve.request",
                              args={"rows": len(payload), "sig": str(sig)}):
-            req = self.submit(sig, payload, temps, budgets)
+            req = self.submit(sig, payload, temps, budgets, tenant=tenant)
             while not req.done.wait(timeout=5.0):
                 # Lost-wakeup / dead-scheduler safety net, not a timer: a
                 # request can wait arbitrarily long behind real traffic,
@@ -624,6 +844,7 @@ class Scheduler:
             return
         for r in expired:
             self.stats.cancelled += 1
+            self._uncharge_tenant_locked(r)
             r.fail(RequestCancelled(
                 f"deadline expired after {now - r.enqueued_at:.3f}s in queue"
             ))
@@ -633,15 +854,36 @@ class Scheduler:
         self._queue_rows = sum(r.n_rows for r in self._queue)
         self._cond.notify_all()
 
+    def _uncharge_tenant_locked(self, req: Request) -> None:
+        """Release one request's queued-row quota charge (called under
+        the condition for every path that removes it from the queue)."""
+        t = self._tenants.get(req.tenant) if req.tenant else None
+        if t is not None:
+            t.queued_rows = max(0, t.queued_rows - req.n_rows)
+
     def _form_batch_locked(self, now: float) -> Optional[List[Request]]:
         """Oldest-first over signature groups: dispatch a group when its
         bucket is full (>= row cap) or its oldest member has lingered past
         the linger deadline.  Returns the chosen requests, removed from
-        the queue, or None when nothing is ripe yet."""
+        the queue, or None when nothing is ripe yet.
+
+        Under tenancy (any registered tenant, ``fair=True``), both the
+        group-scan order and the within-group fill order follow
+        :meth:`_fair_key` — priority class, then weighted virtual time,
+        then arrival — so a tenant flooding the queue with rows cannot
+        push another tenant's requests behind its whole backlog
+        (weighted-fair queueing over dispatched rows).  Ripeness itself
+        stays arrival-based (a group's OLDEST member starts the linger
+        clock), so fairness reorders who rides a capped batch, never
+        when a batch becomes due."""
         if not self._queue:
             return None
+        fair = bool(self._tenants) and self._fair
+        heads = (
+            sorted(self._queue, key=self._fair_key) if fair else self._queue
+        )
         seen: List[Tuple] = []
-        for head in self._queue:
+        for head in heads:
             if head.sig in seen:
                 continue
             seen.append(head.sig)
@@ -651,9 +893,10 @@ class Scheduler:
             lingered = now - group[0].enqueued_at >= self._linger_s
             if not (full or lingered):
                 continue
+            order = sorted(group, key=self._fair_key) if fair else group
             batch: List[Request] = []
             taken = 0
-            for r in group:
+            for r in order:
                 if (batch and self._row_cap is not None
                         and taken + r.n_rows > self._row_cap):
                     break
@@ -662,6 +905,13 @@ class Scheduler:
             chosen = set(map(id, batch))
             self._queue = [r for r in self._queue if id(r) not in chosen]
             self._queue_rows -= taken
+            for r in batch:
+                self._uncharge_tenant_locked(r)
+                # Fair-share charge lands at SELECTION (start-time
+                # fairness): the next batch formation already sees this
+                # account's advanced virtual time (untenanted requests
+                # charge the shared anonymous account).
+                self._fair_tenant(r).served_rows += r.n_rows
             self._cond.notify_all()  # backpressure waiters may now fit
             return batch
         return None
@@ -807,7 +1057,8 @@ class Scheduler:
         kv_pool = pool_stats() if callable(pool_stats) else None
         with self._cond:
             return self.stats.snapshot(
-                self._row_cap, self._queue_rows, kv_pool=kv_pool
+                self._row_cap, self._queue_rows, kv_pool=kv_pool,
+                tenants=self._tenants,
             )
 
     def _publish_stats(self) -> None:
@@ -822,6 +1073,7 @@ class Scheduler:
                 self._closed = True
                 for r in self._queue:
                     self.stats.cancelled += 1
+                    self._uncharge_tenant_locked(r)
                     r.fail(SchedulerClosed("scheduler shut down"))
                     self._emit(r, "cancelled", reason="scheduler_shutdown")
                 self._queue = []

@@ -161,9 +161,13 @@ _CONFIG_OVERRIDE_ENVS = (
     "BCG_TPU_FLEET", "BCG_TPU_METRICS_SHARD_DIR",
     "BCG_TPU_FLEET_STRAGGLER_FACTOR", "BCG_TPU_HOSTSYNC",
     "BCG_TPU_COMPILE_OBS", "BCG_TPU_PROFILE", "BCG_TPU_PROFILE_ROUNDS",
+    "BCG_TPU_SWEEP_MAX_CONCURRENT", "BCG_TPU_SWEEP_TENANT_QUOTA_ROWS",
     # BCG_TPU_RUN_ID / BCG_TPU_METRICS_SHARD_MS stay out: a run label
     # and a flush period are provenance/measurement knobs, not a change
-    # to the served configuration.  BCG_TPU_PROFILE* are IN despite
+    # to the served configuration.  BCG_TPU_SWEEP_DIR stays out for the
+    # same reason (an output path); the two sweep knobs above are IN —
+    # tenant concurrency and quotas change how a measured serving
+    # window batches.  BCG_TPU_PROFILE* are IN despite
     # being measurement knobs: an in-window jax.profiler capture
     # perturbs the measured wall-clock, so a profiled run must not be
     # recorded as the default-config number.

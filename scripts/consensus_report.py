@@ -60,11 +60,16 @@ class GameAgg:
 
     __slots__ = ("config_key", "run_id", "rank", "started", "ended",
                  "converged", "rounds_to_consensus", "influence",
-                 "round_ms", "decisions", "fallbacks", "invalids")
+                 "round_ms", "decisions", "fallbacks", "invalids", "job")
 
     def __init__(self, config_key: str, run_id: str = "-",
                  rank: str = "-"):
         self.config_key = config_key
+        # Sweep-tier job id (bcg_tpu/sweep stamps it on game_start/
+        # game_end): stable across processes, so a job that ran twice —
+        # the resume bug class — is detectable as two ENDED games
+        # sharing one job id (duplicate_job_problems).
+        self.job: Optional[str] = None
         # Run identity from the stamped manifest: every rank of one
         # multi-process run shares run_id (BCG_TPU_RUN_ID), so its
         # files merge into ONE run row instead of reading as N
@@ -154,6 +159,8 @@ def parse_file(path: str, problems: List[str]) -> List[GameAgg]:
                 )
                 agg.config_key = _config_key(manifest, rec)
                 agg.started = True
+                if rec.get("job"):
+                    agg.job = str(rec["job"])
                 games[gid] = agg
                 continue
             agg = games.get(gid)
@@ -180,6 +187,8 @@ def parse_file(path: str, problems: List[str]) -> List[GameAgg]:
             elif event == "game_end":
                 agg.ended = True
                 agg.converged = bool(rec.get("converged"))
+                if rec.get("job"):
+                    agg.job = str(rec["job"])
                 # game_end's cumulative count is authoritative when
                 # round_end records were dropped.
                 agg.influence = max(
@@ -188,6 +197,23 @@ def parse_file(path: str, problems: List[str]) -> List[GameAgg]:
     if bad_lines:
         problems.append(f"{path}: skipped {bad_lines} unparseable line(s)")
     return list(games.values())
+
+
+def duplicate_job_problems(games: List[GameAgg]) -> List[str]:
+    """Sweep-integrity check: a job id (bcg_tpu/sweep) with MORE THAN
+    ONE ended game across the merged files means a job ran twice — the
+    exact resume bug the sweep manifest exists to prevent, and a silent
+    corruption of every per-config denominator.  Reported as a WARNING
+    line (the tables still render; the duplicate rows are visible)."""
+    counts: Dict[str, int] = defaultdict(int)
+    for g in games:
+        if g.ended and g.job:
+            counts[g.job] += 1
+    return [
+        f"job {job!r} has {n} game_end records across the merged files "
+        "— a sweep job ran to completion twice (resume bug)"
+        for job, n in sorted(counts.items()) if n > 1
+    ]
 
 
 def _median(ordered: List[float]) -> float:
@@ -313,6 +339,7 @@ def main(argv=None) -> int:
         for problem in problems:
             print(f"WARNING: {problem}", file=sys.stderr)
         return 1
+    problems.extend(duplicate_job_problems(games))
     print(render_report(games, problems))
     if args.rounds:
         print()

@@ -52,9 +52,10 @@ from typing import Dict, List, Optional
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 SCENARIOS = ("serve", "engine", "paged", "sampler", "int4", "consensus",
-             "fleet", "hostsync", "compile", "hlo")
+             "fleet", "hostsync", "compile", "sweep", "hlo")
 REGRESSIONS = ("none", "spec-off", "fail-rows", "events-off",
-               "straggler-off", "hostsync-off", "compile-off")
+               "straggler-off", "hostsync-off", "compile-off",
+               "fairness-off")
 
 DECISION = {
     "type": "object",
@@ -993,6 +994,168 @@ def run_compile_scenario(inject: str = "none") -> Dict[str, float]:
     }
 
 
+def run_sweep_scenario(inject: str = "none") -> Dict[str, float]:
+    """Multi-tenant scheduling gates (the sweep tier's games-as-tenants
+    contract, bcg_tpu/sweep + serve/scheduler.py tenancy), all
+    deterministic: the device is PLUGGED (run_exclusive holds the
+    device lock) while requests queue, so batch formation order is a
+    pure function of the queue content.
+
+    * ``starvation_ratio`` — 2 tenants through a FakeEngine scheduler
+      (bucket 8 rows, linger 0): "heavy" floods 16 x 4-row requests,
+      "light" submits 2.  The metric is the mean normalized batch
+      position of the light tenant's rows: weighted-fair selection
+      rides them in the FIRST post-plug batch (~0.1); FIFO drowns them
+      behind the heavy backlog (~1.0).  ``--inject-regression
+      fairness-off`` (Scheduler(fair=False)) must fail naming this
+      metric.
+    * ``fairness_batches`` — dispatch-count floor so the ratio can
+      never pass vacuously on a degenerate single-batch run.
+    * ``quota_overrun_rows`` / ``quota_deferrals`` — a tenant with an
+      8-row quota: its queued-row high-water can NEVER exceed the quota
+      (exactness, 0 exact) and the over-quota submit defers (>= 1)
+      with a positive retry-after (``retry_after_live_ms``).
+    * ``retry_after_monotonicity`` — the retry-after derivation
+      (derive_retry_after_ms) over a headroom grid at a fixed SLO:
+      1.0 iff non-increasing in headroom AND the zero-headroom backoff
+      is >= 2x the full-headroom base (the serve.slo.headroom_ms
+      histogram actually steers admission, monotonically).
+    * ``error_rows`` — every scheduled row parses as valid guided JSON.
+    """
+    from bcg_tpu.engine.fake import FakeEngine
+    from bcg_tpu.serve.scheduler import (
+        AdmissionDeferred, Scheduler, derive_retry_after_ms,
+    )
+
+    class RecordingEngine:
+        """FakeEngine proxy: records each dispatched batch's row
+        markers (the first character of every user prompt) and adds a
+        small device latency so dispatches are distinct batches."""
+
+        def __init__(self):
+            self.inner = FakeEngine(seed=0, policy="consensus")
+            self.batches: List[List[str]] = []
+
+        def batch_generate_json(self, prompts, temperature=0.8,
+                                max_tokens=512):
+            self.batches.append([p[1][0] for p in prompts])
+            import time as _time
+
+            _time.sleep(0.002)
+            return self.inner.batch_generate_json(
+                prompts, temperature=temperature, max_tokens=max_tokens
+            )
+
+    def _plug(sched):
+        """Hold the device lock until released — dispatches form but
+        cannot run, so queued work accumulates deterministically."""
+        release = threading.Event()
+        plugged = threading.Event()
+
+        def hold():
+            plugged.set()
+            release.wait()
+
+        t = threading.Thread(target=lambda: sched.run_exclusive(hold))
+        t.start()
+        plugged.wait(10)
+        return release, t
+
+    def _row(marker: str):
+        return ("agent system prompt",
+                f"{marker} Round 2. agent_1 value: 17. Your current "
+                "value: 17. Decide.", DECISION)
+
+    def _drain_queue(sched, deadline_s: float = 10.0) -> None:
+        import time as _time
+
+        t0 = _time.monotonic()
+        while sched.queue_depth_rows() > 0:
+            if _time.monotonic() - t0 > deadline_s:
+                raise RuntimeError("scheduler never picked up the seed batch")
+            _time.sleep(0.001)
+
+    # --- fairness arm -------------------------------------------------
+    eng = RecordingEngine()
+    sched = Scheduler(
+        eng, linger_ms=0, bucket_rows=8, max_queue_rows=4096,
+        deadline_ms=0, strict_admission=False,
+        fair=(inject != "fairness-off"),
+    )
+    sched.register_tenant("heavy", weight=1.0)
+    sched.register_tenant("light", weight=1.0)
+    release, plug_thread = _plug(sched)
+    try:
+        reqs = [sched.submit(("json",), [_row("H")] * 4, [0.0] * 4,
+                             [64] * 4, tenant="heavy")]
+        _drain_queue(sched)  # seed batch in flight, blocked on the plug
+        for _ in range(15):
+            reqs.append(sched.submit(("json",), [_row("H")] * 4,
+                                     [0.0] * 4, [64] * 4, tenant="heavy"))
+        for _ in range(2):
+            reqs.append(sched.submit(("json",), [_row("L")] * 4,
+                                     [0.0] * 4, [64] * 4, tenant="light"))
+    finally:
+        release.set()
+        plug_thread.join(10)
+    for r in reqs:
+        r.done.wait(30)
+    sched.close()
+    bad = sum(
+        1 for r in reqs for row in (r.results or [])
+        if not isinstance(row, dict) or "error" in row
+    )
+    n_batches = len(eng.batches)
+    light_idx = [i for i, b in enumerate(eng.batches) if "L" in b]
+    starvation = (
+        sum(light_idx) / len(light_idx) / max(1, n_batches - 1)
+        if light_idx else 1.0
+    )
+
+    # --- quota arm ----------------------------------------------------
+    eng2 = FakeEngine(seed=0, policy="consensus")
+    sched2 = Scheduler(eng2, linger_ms=0, max_queue_rows=4096,
+                       deadline_ms=0, strict_admission=False)
+    q = sched2.register_tenant("quotatenant", quota_rows=8)
+    release2, plug2 = _plug(sched2)
+    retry_ms = 0.0
+    try:
+        first = sched2.submit(("json",), [_row("Q")] * 4, [0.0] * 4,
+                              [64] * 4, tenant="quotatenant")
+        _drain_queue(sched2)
+        fills = [sched2.submit(("json",), [_row("Q")] * 4, [0.0] * 4,
+                               [64] * 4, tenant="quotatenant")
+                 for _ in range(2)]
+        over = sched2.submit(("json",), [_row("Q")] * 4, [0.0] * 4,
+                             [64] * 4, tenant="quotatenant")
+        if isinstance(over.error, AdmissionDeferred):
+            retry_ms = over.error.retry_after_s * 1e3
+    finally:
+        release2.set()
+        plug2.join(10)
+    for r in [first] + fills:
+        r.done.wait(30)
+    sched2.close()
+    overrun = max(0, q.max_queued_rows - 8)
+
+    # --- retry-after shape (pure) ------------------------------------
+    slo = 50
+    grid = [derive_retry_after_ms(20.0, 10.0, slo_ms=slo,
+                                  headroom_p50_ms=float(h))
+            for h in range(0, slo + 1, 5)]
+    monotone = all(a >= b for a, b in zip(grid, grid[1:]))
+    responsive = grid[0] >= 2.0 * grid[-1]
+    return {
+        "sweep.starvation_ratio": starvation,
+        "sweep.fairness_batches": float(n_batches),
+        "sweep.quota_overrun_rows": float(overrun),
+        "sweep.quota_deferrals": float(q.deferrals),
+        "sweep.retry_after_live_ms": retry_ms,
+        "sweep.retry_after_monotonicity": float(monotone and responsive),
+        "sweep.error_rows": float(bad),
+    }
+
+
 def run_hlo_scenario(inject: str = "none") -> Dict[str, float]:
     """Kernel-census drift findings (scripts/hlo_census.py) as a gated
     metric — 0 findings = the lowered programs still match
@@ -1021,6 +1184,7 @@ _RUNNERS = {
     "fleet": run_fleet_scenario,
     "hostsync": run_hostsync_scenario,
     "compile": run_compile_scenario,
+    "sweep": run_sweep_scenario,
     "hlo": run_hlo_scenario,
 }
 

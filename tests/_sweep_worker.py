@@ -1,0 +1,67 @@
+"""Worker process for the hermetic multi-host sweep tests (not a
+pytest module; launched by tests/test_sweep.py).
+
+Runs as one rank of a REAL 2-process JAX CPU cluster (the
+tests/_fleet_worker.py coordinator-handshake idiom): joins the process
+group through bcg_tpu.parallel.distributed.initialize — which hands the
+sweep controller its process identity — then runs the launcher's spec
+through :func:`bcg_tpu.sweep.run_sweep` into the shared sweep dir.
+
+* Multi-job spec: this rank runs the strided partition
+  ``jobs[rank::world]``; completion lands in
+  ``sweep-manifest-r<rank>.jsonl`` and per-rank game-event files.  The
+  launcher may SIGKILL the cluster mid-sweep and relaunch with the same
+  out_dir — the controller must then finish exactly the remaining job
+  set (resume from manifests + game_end records + round checkpoints).
+* Single-job spec: cooperative mode — both ranks play the SAME game and
+  the SPMD exchange rides the dp-across-hosts mesh (only rank 0
+  records events/manifest).
+
+Usage: python tests/_sweep_worker.py <coordinator> <num_procs> <pid>
+       <out_dir> <spec.json>
+"""
+
+import json
+import sys
+
+
+def main() -> None:
+    coord, nproc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    out_dir, spec_path = sys.argv[4], sys.argv[5]
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from bcg_tpu.parallel import distributed
+
+    distributed.initialize(
+        coordinator_address=coord, num_processes=nproc, process_id=pid
+    )
+
+    from bcg_tpu.obs import fleet
+    from bcg_tpu.sweep import run_sweep
+
+    assert fleet.process_index() == pid, fleet.identity()
+    assert fleet.process_count() == nproc, fleet.identity()
+
+    with open(spec_path) as f:
+        spec = json.load(f)
+    summary = run_sweep(spec, out_dir, max_concurrent=2, linger_ms=0)
+    print(
+        "SWEEP-OK "
+        + json.dumps({
+            "rank": summary["rank"],
+            "world": summary["world"],
+            "cooperative": summary["cooperative"],
+            "partition": summary["partition"],
+            "completed": summary["completed"],
+            "failed": summary["failed"],
+            "skipped": summary["skipped"],
+        }),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

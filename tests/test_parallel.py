@@ -482,6 +482,23 @@ class TestSPMDGameStep:
         assert received[4, 5] == -1  # agent 5 abstained
         assert received[3, 3] == -1  # no self-delivery
 
+    def test_exchange_values_global_matches_sharded_form(self):
+        """The sweep tier's cooperative (dp-across-hosts) exchange
+        (exchange_values_global: host inputs -> global placement ->
+        masked gather -> replicated output) must be value-identical to
+        the sharded single-host form on the same mesh — the hermetic
+        pin for the arm a multi-process backend runs across DCN."""
+        from bcg_tpu.parallel.game_step import exchange_values_global
+
+        topo = NetworkTopology.ring(8)
+        mask_np = np.asarray(topo.neighbor_mask())
+        values_np = np.asarray([10, 11, 12, 13, 14, -1, 16, 17], np.int32)
+        sharded = np.asarray(exchange_values(
+            jnp.asarray(values_np), jnp.asarray(mask_np), self.mesh
+        ))
+        replicated = exchange_values_global(values_np, mask_np, self.mesh)
+        np.testing.assert_array_equal(sharded, replicated)
+
     def test_tally_matches_host_game(self):
         game = ByzantineConsensusGame(num_honest=8, num_byzantine=0, seed=0)
         votes_py = {f"agent_{i}": (True if i < 6 else (None if i == 6 else False))

@@ -46,6 +46,7 @@ NAMESPACE_OWNERS = {
     "fleet": "tests/test_fleet.py",
     "hostsync": "tests/test_hostsync.py",
     "compile": "tests/test_compile_obs.py",
+    "sweep": "tests/test_sweep.py",
 }
 # Namespaces owned elsewhere, as the prefix tuple the measurement-match
 # tests skip (derived, not hand-maintained).

@@ -1,0 +1,174 @@
+"""Real-checkpoint readiness (VERDICT round-5 item 9): the day real
+Qwen/Llama/Mistral safetensors appear on a host, nothing else must be
+missing — the whole ``models/loader.py`` boot path (discovery ->
+shard inventory -> tensor layout -> tokenizer byte table) is verified
+here END TO END, mirroring the reference's checkpoint boot
+(``vllm_agent.py:100-157``).
+
+Two arms over ONE shared readiness routine:
+
+* The HERMETIC arm runs the routine against the genuine-HF-layout
+  ``bcg-hf/tiny`` fixture (models/hf_fixture.py — real tokenizer.json,
+  real safetensors shards, real config.json), so the readiness check
+  itself is exercised green on every CI run.
+* The GATED arm discovers a REAL checkpoint for any registered model
+  preset (``BCG_TPU_CHECKPOINT_DIR`` / HF cache, the exact
+  ``find_checkpoint_dir`` walk the engine boots through) and is
+  SKIPPED when none exists — on a weights-bearing host it runs the
+  same routine, plus a full ``load_checkpoint_params`` when the model
+  is small enough for host RAM (or ``BCG_TPU_SKIP_SLOW`` is unset and
+  the operator opts in by pointing the env at the weights).
+"""
+
+import os
+
+import pytest
+
+from bcg_tpu.config import MODEL_PRESETS
+from bcg_tpu.models.configs import spec_for_model
+from bcg_tpu.models.loader import find_checkpoint_dir
+
+# Layer tensors every supported family must ship (bias/q_norm tensors
+# are family-optional — the loader probes them by presence).
+_REQUIRED_LAYER_KEYS = (
+    "input_layernorm.weight",
+    "self_attn.q_proj.weight", "self_attn.k_proj.weight",
+    "self_attn.v_proj.weight", "self_attn.o_proj.weight",
+    "post_attention_layernorm.weight",
+    "mlp.gate_proj.weight", "mlp.up_proj.weight", "mlp.down_proj.weight",
+)
+_REQUIRED_TOP_KEYS = ("model.embed_tokens.weight", "model.norm.weight")
+
+# Full-tree load ceiling for the gated arm: a tiny/7B-int8-class
+# checkpoint loads on a test host; a 32B bf16 tree must not OOM CI.
+_FULL_LOAD_CEILING_BYTES = 4 << 30
+
+
+def _shard_tensor_index(ckpt_dir):
+    """tensor name -> shape over every safetensors shard in the dir —
+    the same index the loader builds before streaming."""
+    from safetensors import safe_open
+
+    index = {}
+    for fname in sorted(os.listdir(ckpt_dir)):
+        if not fname.endswith(".safetensors"):
+            continue
+        with safe_open(os.path.join(ckpt_dir, fname),
+                       framework="numpy") as f:
+            for name in f.keys():
+                index[name] = tuple(f.get_slice(name).get_shape())
+    return index
+
+
+def _readiness_check(model_name: str, ckpt_dir: str, full_load: bool):
+    """The boot-path contract, checkpoint-agnostic:
+
+    1. discovery resolves the dir the engine would use;
+    2. the shard inventory covers EVERY tensor the loader fetches, at
+       the shapes ``spec_for_model`` predicts (HF stores projections
+       [out, in]; loader transposes);
+    3. the tokenizer loads with an intact byte table (the DFA
+       invariant: per-token bytes concatenate back to the text);
+    4. (full_load) ``load_checkpoint_params`` streams the whole tree
+       and the resulting pytree matches the spec's layer count.
+    """
+    from bcg_tpu.engine.tokenizer import tokenizer_for_model
+
+    spec = spec_for_model(model_name)
+    found = find_checkpoint_dir(model_name)
+    assert found is not None, (
+        f"discovery lost {model_name!r} although the caller found "
+        f"{ckpt_dir!r}"
+    )
+    index = _shard_tensor_index(found)
+
+    for key in _REQUIRED_TOP_KEYS:
+        assert key in index, f"{model_name}: missing {key}"
+    assert index["model.embed_tokens.weight"] == (
+        spec.vocab_size, spec.hidden_size
+    )
+    for i in range(spec.num_layers):
+        for key in _REQUIRED_LAYER_KEYS:
+            full = f"model.layers.{i}.{key}"
+            assert full in index, f"{model_name}: missing {full}"
+    q_out = spec.num_heads * spec.head_dim
+    kv_out = spec.num_kv_heads * spec.head_dim
+    assert index["model.layers.0.self_attn.q_proj.weight"] == (
+        q_out, spec.hidden_size
+    )
+    assert index["model.layers.0.self_attn.k_proj.weight"] == (
+        kv_out, spec.hidden_size
+    )
+    # Tied-embedding families may omit lm_head; untied ones must have it.
+    if "lm_head.weight" in index:
+        assert index["lm_head.weight"] == (spec.vocab_size, spec.hidden_size)
+
+    tok = tokenizer_for_model(model_name)
+    tb = tok.token_bytes()
+    sample = '{"value": 17, "public_reasoning": "readiness probe"}'
+    ids = tok.encode(sample)
+    assert ids, "tokenizer produced no ids"
+    assert b"".join(tb[i] for i in ids) == sample.encode("utf-8")
+
+    if full_load:
+        import jax.numpy as jnp
+
+        from bcg_tpu.models.loader import load_checkpoint_params
+
+        params = load_checkpoint_params(spec, model_name, ckpt_dir=found)
+        assert len(params["layers"]) == spec.num_layers
+        assert params["embed"].shape == (spec.vocab_size, spec.hidden_size)
+        assert params["embed"].dtype == jnp.bfloat16
+
+
+# ------------------------------------------------------------- hermetic
+
+
+def test_readiness_routine_green_on_hf_fixture(tmp_path, monkeypatch):
+    """The readiness check itself, proven against the genuine HF
+    artifact layout — so the gated real-weights arm below can never rot
+    unexercised."""
+    from bcg_tpu.models.hf_fixture import build_checkpoint
+
+    name = "bcg-hf/tiny"
+    out = build_checkpoint(
+        name, out_dir=str(tmp_path / "bcg-hf--tiny")
+    )
+    monkeypatch.setenv("BCG_TPU_CHECKPOINT_DIR", os.path.dirname(out))
+    _readiness_check(name, out, full_load=True)
+
+
+# ---------------------------------------------------------------- gated
+
+
+def _discover_real_checkpoint():
+    """(model_name, dir) for the first registered REAL model preset
+    with local safetensors — the bcg-tpu/bcg-hf synthetic families
+    don't count as real weights."""
+    for preset, name in sorted(MODEL_PRESETS.items()):
+        if name.startswith("bcg-"):
+            continue
+        found = find_checkpoint_dir(name)
+        if found is not None:
+            return name, found
+    return None, None
+
+
+def test_real_checkpoint_boots_loader_end_to_end():
+    """GATED: skipped unless a real local checkpoint exists (set
+    BCG_TPU_CHECKPOINT_DIR on a weights-bearing host).  Runs the full
+    readiness routine on the real safetensors; the whole-tree load
+    engages below the RAM ceiling, inventory/tokenizer checks always."""
+    name, ckpt_dir = _discover_real_checkpoint()
+    if name is None:
+        pytest.skip(
+            "no local real-model checkpoint (set BCG_TPU_CHECKPOINT_DIR "
+            "to a dir of HF safetensors to enable)"
+        )
+    total_bytes = sum(
+        os.path.getsize(os.path.join(ckpt_dir, f))
+        for f in os.listdir(ckpt_dir) if f.endswith(".safetensors")
+    )
+    _readiness_check(
+        name, ckpt_dir, full_load=total_bytes <= _FULL_LOAD_CEILING_BYTES
+    )

@@ -8,8 +8,16 @@ Runs the real script as a subprocess on a virtual 8-CPU-device mesh
 model/window, and pins the emitted JSON schema: every advertised key
 present, throughput fields populated (> 0), and the mesh layout fields
 consistent with the requested agent count.
+
+Since the script became a thin wrapper over a one-job bcg_tpu.sweep
+run, this file is the byte-compat pin for the conversion: the KEY SET
+is asserted EXACTLY (not just as a subset — a wrapper that silently
+grew or renamed fields would break downstream harnesses), and the
+sweep manifest it now writes must carry the fleet identity exactly
+like the serve/game JSONL sinks.
 """
 
+import glob
 import json
 import os
 import subprocess
@@ -18,7 +26,8 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCRIPT = os.path.join(REPO, "scripts", "scale_sweep.py")
 
-# Every key the script's docstring + BASELINE config 4 harnesses rely on.
+# Every key the script's docstring + BASELINE config 4 harnesses rely
+# on — pinned as the EXACT emitted set (wrapper byte-compat contract).
 EXPECTED_KEYS = {
     "agents", "devices", "dp", "model", "rounds", "rounds_per_sec",
     "decisions_per_sec", "dp_batches", "dp_bypasses", "sp_bypasses",
@@ -26,7 +35,8 @@ EXPECTED_KEYS = {
 }
 
 
-def test_scale_sweep_emits_schema_on_virtual_devices():
+def test_scale_sweep_emits_schema_on_virtual_devices(tmp_path):
+    sweep_dir = str(tmp_path / "scale")
     env = dict(
         os.environ,
         JAX_PLATFORMS="cpu",
@@ -36,7 +46,7 @@ def test_scale_sweep_emits_schema_on_virtual_devices():
     proc = subprocess.run(
         [sys.executable, SCRIPT, "--agents", "8", "--rounds", "2",
          "--max-model-len", "256", "--decide-tokens", "24",
-         "--vote-tokens", "16"],
+         "--vote-tokens", "16", "--sweep-dir", sweep_dir],
         capture_output=True, text=True, timeout=420, env=env, cwd=REPO,
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
@@ -46,7 +56,7 @@ def test_scale_sweep_emits_schema_on_virtual_devices():
     ]
     assert json_lines, proc.stdout
     row = json.loads(json_lines[-1])
-    assert EXPECTED_KEYS <= set(row), sorted(row)
+    assert set(row) == EXPECTED_KEYS, sorted(row)  # exact: no drift
     assert row["agents"] == 8
     assert row["devices"] == 8
     # dp is the largest divisor of the agent count that fits the mesh.
@@ -57,3 +67,18 @@ def test_scale_sweep_emits_schema_on_virtual_devices():
     assert row["decisions_per_sec"] > 0
     assert row["dp_batches"] >= 1            # batches actually sharded
     assert isinstance(row["consensus"], bool)
+
+    # Wrapper conversion: the run went through the sweep tier — its
+    # manifest exists in --sweep-dir and the header carries the fleet
+    # identity (run id / host / process rank / flag overrides), the
+    # same stamping contract as the serve/game event sinks.
+    manifests = glob.glob(os.path.join(sweep_dir, "sweep-manifest-r*.jsonl"))
+    assert len(manifests) == 1, manifests
+    records = [json.loads(l) for l in open(manifests[0])]
+    header = next(r for r in records if r["event"] == "manifest")
+    for key in ("run_id", "host", "process_index", "process_count",
+                "flags", "schema_version"):
+        assert key in header, sorted(header)
+    assert header["kind"] == "sweep"
+    ends = [r for r in records if r["event"] == "job_end"]
+    assert len(ends) == 1 and ends[0]["status"] == "completed"
