@@ -704,6 +704,69 @@ def rule_time_wall(ctx: ModuleContext) -> Iterable[Finding]:
             )
 
 
+# ------------------------------------------------- rule: fixed-cadence retry
+@_rule("BCG-RETRY-SLEEP")
+def rule_retry_sleep(ctx: ModuleContext) -> Iterable[Finding]:
+    """``time.sleep(<literal constant>)`` inside a ``while``/``for``
+    loop body — a fixed-cadence retry/poll loop.  Constant-interval
+    retries herd (every waiter comes back in the same window, re-losing
+    the same race) and never adapt to how long the condition actually
+    takes; derive the delay instead — exponential backoff with jitter
+    (:func:`bcg_tpu.runtime.resilience.backoff_s`), a server-supplied
+    retry-after, or any computed expression.  A sleep whose argument is
+    derived (a variable, arithmetic, a call) is legal, as is a constant
+    sleep outside any loop; park deliberate fixed-cadence polls in the
+    baseline with a reason."""
+    imported_sleep = any(
+        isinstance(node, ast.ImportFrom)
+        and node.module == "time"
+        and any(alias.name == "sleep" for alias in node.names)
+        for node in ast.walk(ctx.tree)
+    )
+
+    def is_sleep_name(name: Optional[str]) -> bool:
+        if not name:
+            return False
+        if name == "sleep":
+            return imported_sleep
+        base, _, attr = name.rpartition(".")
+        # `time.sleep` plus aliased forms (`import time as _time`).
+        return attr == "sleep" and base.lstrip("_").lower() == "time"
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not is_sleep_name(_call_name(node.func)):
+            continue
+        if not (
+            len(node.args) == 1
+            and not node.keywords
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, (int, float))
+        ):
+            continue
+        cur = ctx.parent(node)
+        in_loop = False
+        while cur is not None:
+            if isinstance(cur, (ast.While, ast.For, ast.AsyncFor)):
+                in_loop = True
+                break
+            if isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                break  # the loop must enclose the sleep in THIS scope
+            cur = ctx.parent(cur)
+        if in_loop:
+            yield ctx.finding(
+                "BCG-RETRY-SLEEP",
+                node,
+                f"time.sleep({node.args[0].value!r}) inside a retry/poll "
+                "loop — fixed-cadence retries herd and never adapt; "
+                "derive the delay (backoff + jitter, e.g. "
+                "runtime/resilience.backoff_s, or a carried retry-after)",
+            )
+
+
 # ------------------------------------------------ rule: metric name taxonomy
 # <subsystem>.<noun>[.<detail>[.<detail>]] — lowercase dotted identifiers,
 # 2-4 segments (DESIGN.md "Observability": the registry name is the
@@ -721,7 +784,7 @@ _OBS_PREFIX_RE = re.compile(r"^[a-z][a-z0-9_]*\.")
 # new subsystem is a deliberate registry decision, not a call-site
 # spelling.  Extend HERE (and the DESIGN.md table) when one is added.
 _OBS_SUBSYSTEMS = frozenset(
-    {"engine", "serve", "game", "hbm", "kvpool", "fleet", "sweep"}
+    {"engine", "serve", "game", "hbm", "kvpool", "fleet", "sweep", "chaos"}
 )
 _OBS_CALL_ATTRS = {
     "inc", "counter", "gauge", "set_gauge", "value", "histogram", "observe",
@@ -791,7 +854,7 @@ def rule_obs_name(ctx: ModuleContext) -> Iterable[Finding]:
     ("Serve.Requests", a bare "requests") fragments the namespace every
     dashboard and baseline keys on.  The leading segment must also be a
     REGISTERED subsystem (``_OBS_SUBSYSTEMS`` — engine/serve/game/hbm/
-    kvpool/fleet/sweep): an unknown subsystem is a namespace fork the
+    kvpool/fleet/sweep/chaos): an unknown subsystem is a namespace fork the
     fleet shard merge and every dashboard would silently split on.  Literal
     names are checked whole; f-string names have their static fragments
     checked (the leading fragment must carry the subsystem prefix);
@@ -914,6 +977,7 @@ ALL_RULES: Sequence = (
     rule_mut_default,
     rule_lock_call,
     rule_time_wall,
+    rule_retry_sleep,
     rule_obs_name,
     rule_obs_bucket,
 )

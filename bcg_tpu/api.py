@@ -71,7 +71,13 @@ def run_simulation(
             from bcg_tpu.engine.interface import create_engine
             from bcg_tpu.serve import ServingEngine
 
-            run_engine = ServingEngine(create_engine(engine_cfg), owns_inner=True)
+            run_engine = ServingEngine(
+                create_engine(engine_cfg), owns_inner=True,
+                # Supervisor rebuild hook (BCG_TPU_SERVE_WATCHDOG_S):
+                # the wrap site owns the config, so a hung engine can
+                # be rebooted from it.
+                engine_factory=lambda: create_engine(engine_cfg),
+            )
 
     try:
         sim = BCGSimulation(

@@ -247,9 +247,16 @@ def main(argv: Optional[list] = None) -> int:
     if args.serve or envflags.get_bool("BCG_TPU_SERVE"):
         from bcg_tpu.serve import ServingEngine
 
+        from bcg_tpu.engine.interface import create_engine
+
         # Front the engine with the continuous-batching scheduler; it
-        # owns the inner engine so one shutdown() releases both.
-        serving = ServingEngine(sim.engine, owns_inner=True)
+        # owns the inner engine so one shutdown() releases both.  The
+        # factory lets the supervisor reboot a hung engine from the
+        # same config (BCG_TPU_SERVE_WATCHDOG_S).
+        serving = ServingEngine(
+            sim.engine, owns_inner=True,
+            engine_factory=lambda: create_engine(config.engine),
+        )
         sim.set_engine(serving)
     try:
         from bcg_tpu.runtime.profiler import jax_trace

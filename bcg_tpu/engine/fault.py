@@ -19,6 +19,7 @@ import random
 from typing import Any, Dict
 
 from bcg_tpu.engine.interface import InferenceEngine
+from bcg_tpu.obs import counters as obs_counters
 
 # Corruption modes, mirroring real LLM failure classes the validity
 # predicates screen for (orchestrator._is_valid_*): error dicts (engine
@@ -48,6 +49,11 @@ class FaultInjectingEngine(InferenceEngine):
 
     def _corrupt(self, result: Dict[str, Any]) -> Dict[str, Any]:
         self.injected += 1
+        # Registry twin of the instance attribute: `self.injected` is
+        # invisible to /metrics, the fleet shard merge, and bench JSON —
+        # the counter makes every corrupted response a first-class
+        # observable like the chaos injector's chaos.injected.
+        obs_counters.inc("engine.faults.injected")
         mode = self.rng.choice(_MODES)
         if mode == "error_dict" or not isinstance(result, dict) or not result:
             return {"error": "injected_fault"}

@@ -137,11 +137,30 @@ class InferenceEngine(ABC):
 
 def create_engine(engine_config, llm_config=None) -> InferenceEngine:
     """Build an engine from :class:`bcg_tpu.config.EngineConfig`."""
-    if not 0.0 <= engine_config.fault_rate <= 1.0:
+    from bcg_tpu.runtime import envflags
+
+    # Env overrides (BCG_TPU_FAULT_RATE / BCG_TPU_FAULT_SEED) win over
+    # the config fields — the bench/sweep convention every other
+    # experimental axis follows (BCG_TPU_SPEC, BCG_TPU_PAGED_KV, ...).
+    fault_rate = engine_config.fault_rate
+    raw_rate = envflags.get_str("BCG_TPU_FAULT_RATE")
+    if raw_rate:
+        try:
+            fault_rate = float(raw_rate)
+        except ValueError:
+            raise ValueError(
+                f"BCG_TPU_FAULT_RATE={raw_rate!r} is not a float"
+            ) from None
+    fault_seed = (
+        envflags.get_int("BCG_TPU_FAULT_SEED")
+        if envflags.is_set("BCG_TPU_FAULT_SEED")
+        else engine_config.fault_seed
+    )
+    if not 0.0 <= fault_rate <= 1.0:
         # Fail BEFORE any engine boot: a config typo must not cost a
         # multi-GB weight load first.
         raise ValueError(
-            f"fault_rate={engine_config.fault_rate} outside [0, 1]"
+            f"fault_rate={fault_rate} outside [0, 1]"
         )
     engine: InferenceEngine
     if engine_config.backend == "fake":
@@ -167,10 +186,8 @@ def create_engine(engine_config, llm_config=None) -> InferenceEngine:
         engine = JaxEngine(engine_config, mesh=mesh)
     else:
         raise ValueError(f"Unknown engine backend: {engine_config.backend!r}")
-    if engine_config.fault_rate > 0.0:
+    if fault_rate > 0.0:
         from bcg_tpu.engine.fault import FaultInjectingEngine
 
-        engine = FaultInjectingEngine(
-            engine, engine_config.fault_rate, engine_config.fault_seed
-        )
+        engine = FaultInjectingEngine(engine, fault_rate, fault_seed)
     return engine

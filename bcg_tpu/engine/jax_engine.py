@@ -60,6 +60,7 @@ from bcg_tpu.models.configs import (
     ModelSpec,
     spec_for_model,
 )
+from bcg_tpu.runtime import resilience
 from bcg_tpu.models.transformer import (
     decode_chunk,
     decode_step,
@@ -3363,6 +3364,12 @@ class JaxEngine(InferenceEngine):
         cached KV prefix and only the tail prefills per row."""
         if not prompts:
             return []
+        # Chaos seam (BCG_TPU_CHAOS `crash|hang|exhaust@engine.generate`):
+        # an injected engine failure surfaces exactly where a compiler/
+        # runtime crash would — BEFORE the guided run, so no partial
+        # cache state is left behind — and reaches the caller's retry
+        # ladder (serve dispatch recovery, orchestrator fallback).
+        resilience.inject("engine.generate")
         parts = []
         for system_prompt, user_prompt, _ in prompts:
             if isinstance(user_prompt, tuple):

@@ -44,6 +44,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from bcg_tpu.obs import counters as obs_counters, ledger as obs_ledger
+from bcg_tpu.runtime import resilience
 
 
 class PoolExhausted(RuntimeError):
@@ -210,6 +211,10 @@ class PagedKV:
         :class:`PoolExhausted` when the pinned resident set leaves no
         room — admission (``cap_for`` on free blocks) exists to make
         that unreachable in correctly-sized deployments."""
+        # Chaos seam (BCG_TPU_CHAOS `exhaust@kvpool.alloc`): injected
+        # pool exhaustion exercises the same PoolExhausted path a
+        # mis-sized pool would, upstream of any state mutation.
+        resilience.inject("kvpool.alloc")
         if n > len(self._free):
             self.evict(n - len(self._free))
         if n > len(self._free):

@@ -168,6 +168,10 @@ def run_preset(
                 outs = run_serving_simulations(
                     engine, [make_run(r) for r in range(runs)],
                     max_concurrent=concurrency,
+                    # Supervisor rebuild hook: a hang past the (env-
+                    # gated) watchdog reboots the engine from the same
+                    # config instead of killing the whole sweep.
+                    engine_factory=lambda: create_engine(engine_cfg),
                 )
             else:
                 from bcg_tpu.engine.collective import run_concurrent_simulations

@@ -38,7 +38,7 @@ from collections import deque
 from typing import Any, Dict, Optional, Tuple
 
 from bcg_tpu.obs import counters as obs_counters, fleet as obs_fleet
-from bcg_tpu.runtime import envflags
+from bcg_tpu.runtime import envflags, resilience
 
 _NAME_PREFIX = "bcg_"
 _INVALID_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
@@ -204,6 +204,14 @@ class EventSink:
         with self._cond:
             if self._closed:
                 return
+            if self._write_failed:
+                # Dead disk: the drainer can never land this record —
+                # count the loss HERE and skip the queue entirely
+                # (records already queued when the disk died are
+                # counted by the drainer as it discards them, so every
+                # lost record is accounted exactly once).
+                obs_counters.inc(self._drop_counter)
+                return
             if len(self._queue) == self._queue.maxlen:
                 # deque(maxlen) evicts the oldest on append — count it.
                 obs_counters.inc(self._drop_counter)
@@ -220,24 +228,52 @@ class EventSink:
                 self._queue.clear()
                 closed = self._closed
                 self._cond.notify_all()  # close() waits for empty queue
-            if batch and not self._write_failed:
+            if batch and self._write_failed:
+                # Queue residue from before the disk died (or from the
+                # emit-side race window): discarded, and counted — a
+                # dead disk must show up as events_dropped accounting,
+                # not as a silently thinner event file.
+                obs_counters.inc(self._drop_counter, len(batch))
+            elif batch:
+                written = 0
                 try:
                     if fh is None:
                         fh = open(self.path, "a", encoding="utf-8")
+                    # Chaos seam (BCG_TPU_CHAOS `diskfail@sink.write`):
+                    # the injected OSError takes exactly the dead-disk
+                    # path below — warn once, drop-and-count after.
+                    resilience.inject("sink.write")
                     for record in batch:
                         fh.write(json.dumps(record, default=str) + "\n")
+                        written += 1
                     fh.flush()
                 except OSError as exc:
                     import sys
 
-                    # One warning, then drop silently: retrying a dead
+                    # One warning, then drop-and-count: retrying a dead
                     # disk per batch would just spin this thread.
                     print(
                         f"obs.export: event sink write failed "
-                        f"({self.path}): {exc} — further events dropped",
+                        f"({self.path}): {exc} — further events dropped "
+                        f"(counted in {self._drop_counter})",
                         file=sys.stderr,
                     )
                     self._write_failed = True
+                    # Exactly-once accounting on a MID-BATCH failure:
+                    # records that never reached fh.write are lost —
+                    # count them now.  Records already buffered are
+                    # decided by the close below: flushed to disk =
+                    # written (not dropped), close also failing = lost
+                    # (counted) — never both on disk AND in the drop
+                    # counter.
+                    obs_counters.inc(self._drop_counter,
+                                     len(batch) - written)
+                    if fh is not None:
+                        try:
+                            fh.close()
+                        except OSError:
+                            obs_counters.inc(self._drop_counter, written)
+                        fh = None
             if closed:
                 break
         if fh is not None:

@@ -194,6 +194,13 @@ def heartbeat() -> float:
     Epoch time, not monotonic, deliberately: heartbeats are compared
     ACROSS processes, where each rank's monotonic clock is meaningless
     to its peers."""
+    # Chaos seam (BCG_TPU_CHAOS `freeze@fleet.heartbeat`): the injected
+    # rank-freeze generalizes freeze_watermark() — the rank keeps
+    # heartbeating and flushing shards, but its progress watermark
+    # stops, so peers must flag it by lag (the straggler rule's prey).
+    from bcg_tpu.runtime import resilience
+
+    resilience.inject("fleet.heartbeat")
     now_ms = time.time() * 1e3
     obs_counters.set_gauge("fleet.heartbeat_ms", now_ms)
     return now_ms

@@ -28,7 +28,13 @@ def save_checkpoint(sim, path: str) -> str:
     snap = getattr(sim.network.protocol, "snapshot", None)
     if snap is not None:
         blob["protocol"] = snap()
-    tmp = path + ".tmp"
+    # Writer-unique tmp name: a cooperative sweep's ranks (and any
+    # other concurrent writers of the same lockstep game) checkpoint
+    # the same FINAL path — a shared "<path>.tmp" let one rank's
+    # os.replace steal the other's half-written file out from under it
+    # (FileNotFoundError on the loser's rename).  Per-pid tmps never
+    # collide; the last atomic rename wins with identical content.
+    tmp = f"{path}.{os.getpid()}.tmp"
     with open(tmp, "w") as f:
         json.dump(blob, f)
     os.replace(tmp, path)  # atomic

@@ -325,6 +325,42 @@ _register(
     "Write a resumable checkpoint every N game rounds (runtime/"
     "checkpoint.py), independent of --checkpoint-every-round; 0 = off.",
 )
+# BCG_TPU_CHAOS / *_RETRIES / *_WATCHDOG — chaos injection + recovery
+# tier (runtime/resilience.py, DESIGN.md "Failure model & recovery").
+_register(
+    "BCG_TPU_CHAOS", "str", None,
+    "Seeded chaos plan over the instrumented fault seams "
+    "(runtime/resilience.py): ';'-separated "
+    "'<kind>@<site>:<when>[:<arg>]' directives (kinds crash/hang/"
+    "exhaust/diskfail/freeze; sites serve.dispatch, engine.generate, "
+    "kvpool.alloc, sink.write, sweep.job, fleet.heartbeat; when = "
+    "occurrence list, 'n+', or 'p<rate>') plus an optional 'seed=<n>'. "
+    "Unset = zero surface.",
+)
+_register(
+    "BCG_TPU_SERVE_MAX_DISPATCH_RETRIES", "int", 0,
+    "Serving-scheduler dispatch retry budget: a failed device batch is "
+    "retried up to N times with capped exponential backoff + jitter, "
+    "then bisected to isolate poison requests before per-request "
+    "failure (serve.dispatch_retries / serve.batch_splits / "
+    "serve.recoveries counters; 0 = fail the batch on first error, the "
+    "pre-recovery behaviour).",
+)
+_register(
+    "BCG_TPU_SERVE_WATCHDOG_S", "int", 0,
+    "Device-call hang watchdog for the serving scheduler, in seconds: "
+    "a dispatch exceeding it is declared hung and the engine supervisor "
+    "rebuilds the engine ONCE (when the scheduler was given an "
+    "engine_factory) before declaring the scheduler dead; 0 = off "
+    "(dispatches run inline with no timeout).",
+)
+_register(
+    "BCG_TPU_SERVE_DEFER_WAIT_S", "int", 600,
+    "Total-wait ceiling for a tenant's quota-deferral backoff loop "
+    "(serve/engine.py): cumulative jittered retry-after sleeps past it "
+    "surface SchedulerClosed instead of spinning on a wedged scheduler "
+    "forever; 0 = no ceiling.",
+)
 # BCG_TPU_SWEEP_* — multi-tenant sweep tier (bcg_tpu/sweep).
 _register(
     "BCG_TPU_SWEEP_DIR", "str", None,
@@ -346,6 +382,29 @@ _register(
     "tenant submitting past it is deferred with an SLO-headroom-"
     "derived retry-after (AdmissionDeferred) instead of hard-rejected; "
     "0 = unlimited.",
+)
+_register(
+    "BCG_TPU_SWEEP_MAX_JOB_RETRIES", "int", 0,
+    "Sweep job retry budget: a job whose failure classifies as "
+    "TRANSIENT (runtime/resilience.classify_failure — injected chaos, "
+    "pool exhaustion, timeouts, I/O flakes) is requeued up to N times "
+    "with backoff, resuming from its newest round checkpoint "
+    "(sweep.jobs.retried counter; permanent failures never retry; "
+    "0 = every failure is terminal, the pre-recovery behaviour).",
+)
+_register(
+    "BCG_TPU_FAULT_RATE", "str", "",
+    "Seeded response-corruption rate for FaultInjectingEngine "
+    "(engine/fault.py), overriding EngineConfig.fault_rate / "
+    "--fault-rate: a float in [0, 1]; ''/unset = the config field. "
+    "Injections count in engine.faults.injected and land in bench "
+    "JSON as the 'faults' block.",
+)
+_register(
+    "BCG_TPU_FAULT_SEED", "int", 0,
+    "Seed for FaultInjectingEngine's corruption RNG, overriding "
+    "EngineConfig.fault_seed / --fault-seed (only read when a fault "
+    "rate is in effect).",
 )
 _register(
     "BCG_TPU_COLLECTIVE_WATCHDOG_S", "int", 0,

@@ -12,11 +12,14 @@ game simply stops submitting.
 
 from __future__ import annotations
 
+import itertools
+import random
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
 from bcg_tpu.engine.interface import InferenceEngine, per_row_settings as _rows
+from bcg_tpu.runtime import envflags
 from bcg_tpu.serve.scheduler import AdmissionDeferred, Scheduler, SchedulerClosed
 
 
@@ -37,20 +40,37 @@ class ServingEngine(InferenceEngine):
     exception.
     """
 
+    _proxy_seeds = itertools.count(1)
+
     def __init__(self, engine: InferenceEngine, *, owns_inner: bool = False,
                  scheduler: Optional[Scheduler] = None,
-                 tenant: Optional[str] = None, **scheduler_kwargs):
+                 tenant: Optional[str] = None,
+                 defer_wait_ceiling_s: Optional[float] = None,
+                 **scheduler_kwargs):
         self._engine = engine
         self._owns_inner = owns_inner
         self._tenant = tenant
+        if defer_wait_ceiling_s is None:
+            defer_wait_ceiling_s = envflags.get_int("BCG_TPU_SERVE_DEFER_WAIT_S")
+        self._defer_ceiling_s = max(0.0, float(defer_wait_ceiling_s))
+        # Seeded per proxy from a process-wide counter: jitter must
+        # decorrelate TENANTS, so each proxy draws its own sequence —
+        # and the counter (unlike id(self), whose freed addresses
+        # CPython reuses) can never hand two proxies the same seed.
+        self._defer_rng = random.Random(next(ServingEngine._proxy_seeds))
         self.scheduler = scheduler or Scheduler(engine, **scheduler_kwargs)
 
     def _submit_with_retry(self, sig, payload, temps, budgets) -> List:
         """submit_and_wait, retrying tenant-quota deferrals after the
-        carried retry-after.  Progress is guaranteed while the
-        scheduler lives (the quota frees when one of this tenant's
-        queued batches dispatches); a dead scheduler surfaces as
-        :class:`SchedulerClosed` instead of an infinite backoff."""
+        carried retry-after — JITTERED (0.75x-1.25x) so deferred
+        tenants spread over the dispatch window instead of herding back
+        at the same instant, and CEILINGED: cumulative backoff past
+        ``BCG_TPU_SERVE_DEFER_WAIT_S`` surfaces :class:`SchedulerClosed`
+        (a scheduler that defers one tenant for minutes is wedged from
+        that tenant's point of view, and an unbounded fixed-sleep loop
+        would spin on it forever).  A dead scheduler thread surfaces
+        the same way immediately."""
+        waited = 0.0
         while True:
             try:
                 return self.scheduler.submit_and_wait(
@@ -62,7 +82,18 @@ class ServingEngine(InferenceEngine):
                         "scheduler thread died while this tenant backed "
                         "off a quota deferral"
                     ) from e
-                time.sleep(e.retry_after_s)
+                delay = e.retry_after_s * self._defer_rng.uniform(0.75, 1.25)
+                if (self._defer_ceiling_s > 0
+                        and waited + delay > self._defer_ceiling_s):
+                    raise SchedulerClosed(
+                        f"tenant {self._tenant!r} spent "
+                        f"{waited + delay:.1f}s in quota-deferral backoff "
+                        f"(ceiling {self._defer_ceiling_s:g}s, "
+                        "BCG_TPU_SERVE_DEFER_WAIT_S) — scheduler is not "
+                        "draining this tenant's queue"
+                    ) from e
+                time.sleep(delay)
+                waited += delay
 
     # --------------------------------------------------- InferenceEngine API
 
@@ -99,8 +130,11 @@ class ServingEngine(InferenceEngine):
             # engine — delegate directly (generate() is off the game's
             # hot path), serialized against in-flight device batches via
             # the scheduler's device lock.
+            # Through the scheduler's CURRENT engine handle, not the
+            # construction-time one — the supervisor may have rebuilt
+            # the engine after a hang.
             return self.scheduler.run_exclusive(
-                lambda: self._engine.generate(
+                lambda: self.scheduler._engine.generate(
                     prompt, temperature, max_tokens, top_p,
                     system_prompt=system_prompt,
                 )
@@ -110,7 +144,12 @@ class ServingEngine(InferenceEngine):
     def shutdown(self) -> None:
         self.scheduler.close()
         if self._owns_inner:
-            self._engine.shutdown()
+            # The scheduler's CURRENT engine, not the construction-time
+            # handle: the supervisor may have swapped in a rebuilt
+            # engine after a hang (the hung original is deliberately
+            # abandoned — a shutdown() on a wedged device can hang
+            # exactly like the call that condemned it).
+            self.scheduler._engine.shutdown()
 
     # -------------------------------------------------------------- stats
 

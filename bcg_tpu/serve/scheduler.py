@@ -39,6 +39,7 @@ deadlock class this module exists to retire.
 from __future__ import annotations
 
 import itertools
+import random
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
@@ -53,7 +54,8 @@ from bcg_tpu.obs import (
     tracer as obs_tracer,
 )
 from bcg_tpu.obs.tracer import SpanAggregator
-from bcg_tpu.runtime import envflags
+from bcg_tpu.runtime import envflags, resilience
+from bcg_tpu.runtime.resilience import EngineDead, EngineHung
 
 # Serving-latency histogram bucket bounds in milliseconds (the +Inf
 # overflow bucket is implicit).  These are first-class
@@ -75,6 +77,11 @@ _QUEUE_WAIT_BUCKETS_MS = (1, 2, 5, 10, 20, 50, 100)
 _E2E_BUCKETS_MS = (5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 15000)
 _DEVICE_BUCKETS_MS = (1, 5, 10, 25, 50, 100, 250, 1000, 5000, 15000)
 _SLO_HEADROOM_BUCKETS_MS = (0, 1, 5, 10, 25, 50, 100, 250, 1000, 5000)
+# Recovery latency (first dispatch failure -> the batch's eventual
+# completion): spans one backoff (~tens of ms) through a watchdog
+# timeout + engine rebuild (seconds).
+_RECOVERY_BUCKETS_MS = (5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000,
+                        15000)
 # Speculative-decoding counters the inner engine publishes
 # (engine/speculative.py); snapshotted per scheduler with the same
 # construction-time-baseline idiom as the linger buckets, so
@@ -254,6 +261,14 @@ class SchedulerStats:
         self.merged_dispatches = 0  # dispatches that merged >1 request
         self.oversize_dispatches = 0
         self.engine_errors = 0
+        # Recovery tier (BCG_TPU_SERVE_MAX_DISPATCH_RETRIES /
+        # BCG_TPU_SERVE_WATCHDOG_S): retried attempts, bisecting batch
+        # splits, dispatches that completed after >=1 failure, and
+        # supervisor engine rebuilds.
+        self.dispatch_retries = 0
+        self.batch_splits = 0
+        self.recoveries = 0
+        self.engine_rebuilds = 0
         self.backpressure_blocks = 0
         self.max_queue_rows = 0
         self.slo_ms = max(0, slo_ms)
@@ -271,6 +286,8 @@ class SchedulerStats:
             "e2e": obs_counters.histogram("serve.e2e_ms", _E2E_BUCKETS_MS),
             "device": obs_counters.histogram(
                 "serve.device_ms", _DEVICE_BUCKETS_MS),
+            "recovery": obs_counters.histogram(
+                "serve.recovery_ms", _RECOVERY_BUCKETS_MS),
         }
         if self.slo_ms:
             # Headroom = slo - e2e per completed request; negative
@@ -302,6 +319,12 @@ class SchedulerStats:
 
     def record_device_time(self, seconds: float) -> None:
         self._hists["device"].observe(seconds * 1e3)
+
+    def record_recovery(self, seconds: float) -> None:
+        """Observe one recovered dispatch's first-failure -> completion
+        latency (retries, backoff, splits, and any engine rebuild all
+        inside the window)."""
+        self._hists["recovery"].observe(seconds * 1e3)
 
     def _hist_delta(self, key: str):
         """(per-bucket counts incl. overflow, sum, count) movement since
@@ -397,6 +420,23 @@ class SchedulerStats:
                 name.split(".", 1)[-1]: row
                 for name, row in lat_table.items()
             },
+            # Recovery view (BCG_TPU_SERVE_MAX_DISPATCH_RETRIES /
+            # BCG_TPU_SERVE_WATCHDOG_S): retried attempts, bisecting
+            # batch splits, dispatches completed after >=1 failure with
+            # their failure->completion latency, and supervisor engine
+            # rebuilds.  None while nothing ever failed (the kv_pool
+            # idiom — a clean run carries no extra surface).
+            "recovery": (
+                {
+                    "dispatch_retries": self.dispatch_retries,
+                    "batch_splits": self.batch_splits,
+                    "recoveries": self.recoveries,
+                    "engine_rebuilds": self.engine_rebuilds,
+                    "recovery_ms": self._hist_snapshot("recovery"),
+                }
+                if (self.dispatch_retries or self.batch_splits
+                    or self.recoveries or self.engine_rebuilds) else None
+            ),
             # Speculative-decoding acceptance under THIS scheduler
             # (None when the inner engine drafted nothing — spec off or
             # fake backend without the mirror).
@@ -496,6 +536,16 @@ class Scheduler:
     ``bucket_rows``: target device-batch rows.  0 (default) derives the
     cap from the engine's KV budget (:func:`derive_row_cap`); an explicit
     value also enables ``strict_admission`` unless overridden.
+
+    Recovery tier (DESIGN.md "Failure model & recovery"):
+    ``max_dispatch_retries`` (``BCG_TPU_SERVE_MAX_DISPATCH_RETRIES``)
+    retries a failed device batch with capped exponential backoff +
+    jitter, then bisects it to isolate poison requests;
+    ``watchdog_s`` (``BCG_TPU_SERVE_WATCHDOG_S``) bounds each device
+    call — a hung call triggers the engine supervisor, which rebuilds
+    the engine ONCE via ``engine_factory`` (abandoning the hung call's
+    thread and device lock) before declaring the scheduler dead.  All
+    three default to off, preserving fail-on-first-error semantics.
     """
 
     def __init__(
@@ -509,6 +559,9 @@ class Scheduler:
         strict_admission: Optional[bool] = None,
         slo_ms: Optional[int] = None,
         fair: bool = True,
+        max_dispatch_retries: Optional[int] = None,
+        watchdog_s: Optional[float] = None,
+        engine_factory=None,
     ):
         self._engine = engine
         if linger_ms is None:
@@ -531,6 +584,22 @@ class Scheduler:
         self._strict = explicit_cap if strict_admission is None else strict_admission
         self._max_queue_rows = max(1, max_queue_rows)
         self._deadline_s = max(0, deadline_ms) / 1e3
+        if max_dispatch_retries is None:
+            max_dispatch_retries = envflags.get_int(
+                "BCG_TPU_SERVE_MAX_DISPATCH_RETRIES"
+            )
+        if watchdog_s is None:
+            watchdog_s = envflags.get_int("BCG_TPU_SERVE_WATCHDOG_S")
+        self._max_retries = max(0, int(max_dispatch_retries))
+        self._watchdog_s = max(0.0, float(watchdog_s))
+        self._engine_factory = engine_factory
+        # Supervisor budget: ONE rebuild per scheduler lifetime — a
+        # second hang means the fault is not transient and the
+        # scheduler declares itself dead instead of cycling engines.
+        self._rebuilds_left = 1 if engine_factory is not None else 0
+        # Seeded: backoff jitter must not depend on global RNG state
+        # (hermetic chaos tests assert recovery counters exactly).
+        self._retry_rng = random.Random(0x5EED)
         self.stats = SchedulerStats(slo_ms=slo_ms)
 
         self._cond = threading.Condition()
@@ -926,12 +995,40 @@ class Scheduler:
             wake = min(wake, min(deadlines))
         return max(0.001, wake - now)
 
-    def _dispatch(self, batch: List[Request]) -> None:
+    def _dispatch(self, batch: List[Request],
+                  _fail_t0: Optional[float] = None,
+                  _retries_left: Optional[int] = None) -> None:
         """Run one merged inner-engine call and scatter results.
 
         Runs on the scheduler thread with NO scheduler lock held; an
         engine failure reaches only this batch's futures — the loop and
         every other queued request keep going (crash-isolated completion).
+
+        Recovery ladder (``max_dispatch_retries`` > 0): a failed engine
+        call is retried with capped exponential backoff + jitter; when
+        the budget is exhausted and the batch merged more than one
+        request, it is BISECTED and each half re-dispatched (recursing
+        down to per-request granularity — the split isolates poison
+        requests so one bad row cannot take a whole merged batch's
+        futures down).  A hang past the watchdog raises
+        :class:`EngineHung` after the supervisor rebuilds the engine
+        (retried without consuming the retry budget — the one-rebuild
+        budget already bounds it) or :class:`EngineDead` when the
+        rebuild budget is gone, which fails the batch AND declares the
+        scheduler dead.  ``_fail_t0`` threads the FIRST failure time
+        through split recursion so ``serve.recovery_ms`` measures
+        failure -> eventual completion, not per-leaf retry time.
+
+        Bounds: the retry budget is spent ONCE, at the top level —
+        split children run with ``_retries_left=0`` (one attempt each,
+        splitting further on failure), so a deterministic failure on an
+        N-request batch costs at most ``retries + 2N-1`` engine calls,
+        not a fresh ladder per tree node.  A failure classified
+        PERMANENT (:func:`resilience.classify_failure` — value/config
+        errors that deterministically recur) skips the remaining
+        retries and their backoff sleeps entirely and goes straight to
+        isolation: retrying it would stall the single dispatch thread
+        re-running the same crash.
         """
         sig = batch[0].sig
         # Dispatch-side spans parent to the OLDEST request in the batch
@@ -954,93 +1051,302 @@ class Scheduler:
             # (collective.py idiom).
             temperature = temps[0] if len(set(temps)) == 1 else temps
             max_tokens = budgets[0] if len(set(budgets)) == 1 else budgets
+        first_fail = _fail_t0
+        retries_left = (
+            self._max_retries if _retries_left is None else _retries_left
+        )
+        attempt = 0
+        while True:
+            try:
+                out, device_s, dispatch_syncs = self._device_call(
+                    sig, merged, temperature, max_tokens, len(batch), anchor
+                )
+                break
+            except BaseException as e:
+                if first_fail is None:
+                    first_fail = time.monotonic()
+                with self._cond:
+                    self.stats.engine_errors += 1
+                obs_counters.inc("serve.engine_errors")
+                if isinstance(e, EngineDead):
+                    # Unrecoverable: fail this batch, then take the
+                    # scheduler down cleanly (queued futures fail with
+                    # SchedulerClosed instead of waiting forever).
+                    self._fail_batch(batch, merged, e)
+                    self._declare_dead(e)
+                    return
+                if isinstance(e, EngineHung):
+                    # The supervisor already rebuilt the engine: retry
+                    # on the fresh one WITHOUT consuming the retry
+                    # budget (the one-rebuild budget bounds this loop).
+                    with self._cond:
+                        self.stats.dispatch_retries += 1
+                    obs_counters.inc("serve.dispatch_retries")
+                    continue
+                if (attempt >= retries_left
+                        or resilience.classify_failure(e) == "permanent"):
+                    if self._max_retries > 0 and len(batch) > 1:
+                        # Bisect: isolate the poison request(s); the
+                        # halves inherit the first-failure time so the
+                        # recovery histogram spans the whole episode,
+                        # and run with a SPENT retry budget — the top
+                        # level already retried the union.
+                        with self._cond:
+                            self.stats.batch_splits += 1
+                        obs_counters.inc("serve.batch_splits")
+                        mid = len(batch) // 2
+                        self._dispatch(batch[:mid], _fail_t0=first_fail,
+                                       _retries_left=0)
+                        self._dispatch(batch[mid:], _fail_t0=first_fail,
+                                       _retries_left=0)
+                    else:
+                        self._fail_batch(batch, merged, e)
+                    return
+                attempt += 1
+                with self._cond:
+                    self.stats.dispatch_retries += 1
+                obs_counters.inc("serve.dispatch_retries")
+                for r in batch:
+                    self._emit(r, "retrying", attempt=attempt,
+                               error=f"{type(e).__name__}: {e}")
+                time.sleep(resilience.backoff_s(
+                    attempt - 1, rng=self._retry_rng
+                ))
+        device_ms = round(device_s * 1e3, 3)
+        self.stats.record_device_time(device_s)
+        slo_violations = 0
+        with obs_tracer.span("serve.scatter", parent=anchor,
+                             aggregate=self.stats.lat,
+                             args={"requests": len(batch)}):
+            pos = 0
+            done_t = time.monotonic()
+            for r in batch:
+                r.complete(out[pos: pos + r.n_rows])
+                pos += r.n_rows
+                violated = self.stats.record_completion(
+                    done_t - r.submitted_at
+                )
+                slo_violations += violated
+                self._emit(r, "completed", device_ms=device_ms,
+                           batch_rows=len(merged),
+                           e2e_ms=round((done_t - r.submitted_at) * 1e3, 3))
+        recovered = first_fail is not None
+        with self._cond:
+            self.stats.completed += len(batch)
+            self.stats.dispatches += 1
+            self.stats.dispatched_rows += len(merged)
+            self.stats.slo_violations += slo_violations
+            self.stats.dispatch_syncs += dispatch_syncs
+            if recovered:
+                self.stats.recoveries += 1
+        obs_counters.inc("serve.dispatches")
+        obs_counters.inc("serve.dispatched_rows", len(merged))
+        if recovered:
+            obs_counters.inc("serve.recoveries")
+            self.stats.record_recovery(time.monotonic() - first_fail)
+        if slo_violations:
+            obs_counters.inc("serve.slo.violations", slo_violations)
+
+    def _fail_batch(self, batch: List[Request], merged: List,
+                    err: BaseException) -> None:
+        """Terminal failure for one (possibly split) batch: fail its
+        futures, account the dispatch, and REFUND the fair-share charge
+        its rows took at selection — the engine never served them, and
+        leaving the charge would permanently deflate a crashing
+        tenant's own virtual time (its future requests would dispatch
+        ahead of healthy tenants exactly because it keeps crashing)."""
+        for r in batch:
+            r.fail(err)
+            self._emit(r, "failed", error=f"{type(err).__name__}: {err}")
+        with self._cond:
+            self.stats.failed += len(batch)
+            self.stats.dispatches += 1
+            self.stats.dispatched_rows += len(merged)
+            # A failed dispatch's partial host-sync delta is not
+            # charged (the engine call died mid-window).
+            for r in batch:
+                t = self._fair_tenant(r)
+                t.served_rows = max(0, t.served_rows - r.n_rows)
+        obs_counters.inc("serve.dispatches")
+        obs_counters.inc("serve.dispatched_rows", len(merged))
+
+    def _declare_dead(self, err: BaseException) -> None:
+        """Engine supervisor verdict: the engine is unrecoverable.
+        Close the scheduler from its own dispatch thread — queued
+        requests fail with :class:`SchedulerClosed` NOW instead of
+        their submitters discovering a dead thread one liveness probe
+        at a time.  (``close()`` can still be called later; it joins a
+        thread that has already exited.)"""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            for r in self._queue:
+                self.stats.cancelled += 1
+                self._uncharge_tenant_locked(r)
+                r.fail(SchedulerClosed(f"engine declared dead: {err}"))
+                self._emit(r, "cancelled", reason="engine_dead")
+            self._queue = []
+            self._queue_rows = 0
+            self._cond.notify_all()
+
+    def _device_call(self, sig: Tuple, merged: List, temperature, max_tokens,
+                     n_requests: int, anchor):
+        """One timed engine call under the device lock, optionally
+        bounded by the hang watchdog.  Returns ``(rows, device_seconds,
+        dispatch_syncs)``; raises whatever the engine raised, or
+        :class:`EngineHung` / :class:`EngineDead` on a watchdog trip."""
+        device_t0 = time.monotonic()
+        with obs_tracer.span("serve.device", parent=anchor,
+                             aggregate=self.stats.lat,
+                             args={"rows": len(merged),
+                                   "requests": n_requests}):
+            if self._watchdog_s > 0:
+                out, dispatch_syncs = self._watched_engine_call(
+                    sig, merged, temperature, max_tokens
+                )
+            else:
+                out, dispatch_syncs = self._engine_call(
+                    sig, merged, temperature, max_tokens
+                )
+        return out, time.monotonic() - device_t0, dispatch_syncs
+
+    def _engine_call(self, sig: Tuple, merged: List, temperature, max_tokens):
         audit = obs_hostsync.auditor()
         dispatch_syncs = 0
-        try:
-            device_t0 = time.monotonic()
-            with obs_tracer.span("serve.device", parent=anchor,
-                                 aggregate=self.stats.lat,
-                                 args={"rows": len(merged),
-                                       "requests": len(batch)}):
-                with self._device_lock:
-                    # Host-sync delta over the engine call only, read
-                    # inside the lock so other dispatches through THIS
-                    # scheduler can never land in the window.  Still a
-                    # process-wide total: a direct-engine thread or a
-                    # second scheduler auditing concurrently is counted
-                    # here too (the can't-split-a-shared-total caveat
-                    # the round path resolves with rounds_overlapped).
-                    syncs_before = audit.total() if audit is not None else 0
-                    if sig[0] == "json":
-                        # The device lock guards ONLY the engine call; it
-                        # is never held together with the queue cond nor
-                        # across game progress, so the BCG-LOCK-CALL
-                        # deadlock shape (queue state pinned during a
-                        # device call) cannot occur here.
-                        # lint: ignore[BCG-LOCK-CALL]
-                        out = self._engine.batch_generate_json(
-                            merged, temperature=temperature,
-                            max_tokens=max_tokens,
-                        )
-                    else:
-                        # lint: ignore[BCG-LOCK-CALL]  (same device-gate-only discipline)
-                        out = self._engine.batch_generate(
-                            merged, temperature=temperature,
-                            max_tokens=max_tokens, top_p=sig[1],
-                        )
-                    if audit is not None:
-                        dispatch_syncs = audit.total() - syncs_before
-            device_s = time.monotonic() - device_t0
-            device_ms = round(device_s * 1e3, 3)
-            self.stats.record_device_time(device_s)
-            slo_violations = 0
-            with obs_tracer.span("serve.scatter", parent=anchor,
-                                 aggregate=self.stats.lat,
-                                 args={"requests": len(batch)}):
-                pos = 0
-                done_t = time.monotonic()
-                for r in batch:
-                    r.complete(out[pos: pos + r.n_rows])
-                    pos += r.n_rows
-                    violated = self.stats.record_completion(
-                        done_t - r.submitted_at
-                    )
-                    slo_violations += violated
-                    self._emit(r, "completed", device_ms=device_ms,
-                               batch_rows=len(merged),
-                               e2e_ms=round((done_t - r.submitted_at) * 1e3, 3))
-            with self._cond:
-                self.stats.completed += len(batch)
-                self.stats.dispatches += 1
-                self.stats.dispatched_rows += len(merged)
-                self.stats.slo_violations += slo_violations
-                self.stats.dispatch_syncs += dispatch_syncs
-            obs_counters.inc("serve.dispatches")
-            obs_counters.inc("serve.dispatched_rows", len(merged))
-            if slo_violations:
-                obs_counters.inc("serve.slo.violations", slo_violations)
-        except BaseException as e:
-            for r in batch:
-                r.fail(e)
-                self._emit(r, "failed", error=f"{type(e).__name__}: {e}")
-            with self._cond:
-                self.stats.failed += len(batch)
-                self.stats.engine_errors += 1
-                self.stats.dispatches += 1
-                self.stats.dispatched_rows += len(merged)
-                # 0 when the engine call itself died mid-window — a
-                # failed dispatch's partial delta is not charged.
-                self.stats.dispatch_syncs += dispatch_syncs
-            obs_counters.inc("serve.dispatches")
-            obs_counters.inc("serve.dispatched_rows", len(merged))
-            obs_counters.inc("serve.engine_errors")
+        # Snapshot engine + lock into LOCALS before any fault can fire:
+        # a watchdog-abandoned worker thread that wakes mid-call must
+        # finish against the CONDEMNED engine under the OLD lock — if it
+        # re-read self._engine after a supervisor rebuild it would run
+        # unserialized against the fresh engine's device state.
+        engine = self._engine
+        lock = self._device_lock
+        with lock:
+            # Host-sync delta over the engine call only, read
+            # inside the lock so other dispatches through THIS
+            # scheduler can never land in the window.  Still a
+            # process-wide total: a direct-engine thread or a
+            # second scheduler auditing concurrently is counted
+            # here too (the can't-split-a-shared-total caveat
+            # the round path resolves with rounds_overlapped).
+            syncs_before = audit.total() if audit is not None else 0
+            # Chaos seam (BCG_TPU_CHAOS, runtime/resilience.py): the
+            # injected engine crash / device hang / pool exhaustion
+            # land exactly where a real one would — inside the device
+            # lock, visible to the watchdog and the retry ladder.
+            resilience.inject("serve.dispatch")
+            if sig[0] == "json":
+                # The device lock guards ONLY the engine call; it
+                # is never held together with the queue cond nor
+                # across game progress, so the BCG-LOCK-CALL
+                # deadlock shape (queue state pinned during a
+                # device call) cannot occur here.
+                # lint: ignore[BCG-LOCK-CALL]
+                out = engine.batch_generate_json(
+                    merged, temperature=temperature,
+                    max_tokens=max_tokens,
+                )
+            else:
+                # lint: ignore[BCG-LOCK-CALL]  (same device-gate-only discipline)
+                out = engine.batch_generate(
+                    merged, temperature=temperature,
+                    max_tokens=max_tokens, top_p=sig[1],
+                )
+            if audit is not None:
+                dispatch_syncs = audit.total() - syncs_before
+        return out, dispatch_syncs
+
+    def _watched_engine_call(self, sig: Tuple, merged: List, temperature,
+                             max_tokens):
+        """Run the engine call on a watchdog-bounded worker thread (the
+        collective-watchdog idiom applied to the device call itself): a
+        call that exceeds ``watchdog_s`` is declared hung — its worker
+        thread is abandoned (daemon, still holding the OLD device lock)
+        and the supervisor decides between a one-time engine rebuild
+        (:class:`EngineHung`, retryable) and scheduler death
+        (:class:`EngineDead`)."""
+        result: Dict[str, Any] = {}
+        done = threading.Event()
+
+        def run():
+            try:
+                result["out"] = self._engine_call(
+                    sig, merged, temperature, max_tokens
+                )
+            except BaseException as e:
+                result["err"] = e
+            finally:
+                done.set()
+
+        worker = threading.Thread(
+            target=run, name="bcg-serve-device", daemon=True
+        )
+        worker.start()
+        if not done.wait(self._watchdog_s):
+            raise self._supervise_hang()
+        if "err" in result:
+            raise result["err"]
+        return result["out"]
+
+    def _supervise_hang(self) -> BaseException:
+        """Engine supervisor: a device call hung past the watchdog.
+        With rebuild budget (one per scheduler lifetime) and a factory,
+        swap in a FRESH device lock (the hung thread still holds the
+        old one and may never release it) and a freshly built engine,
+        and hand the dispatch loop a retryable :class:`EngineHung`;
+        otherwise the engine is unrecoverable — :class:`EngineDead`."""
+        with self._cond:
+            can_rebuild = (
+                self._rebuilds_left > 0 and self._engine_factory is not None
+            )
+            if can_rebuild:
+                self._rebuilds_left -= 1
+                self.stats.engine_rebuilds += 1
+        if not can_rebuild:
+            return EngineDead(
+                f"device call exceeded the {self._watchdog_s:g}s watchdog "
+                "and no rebuild budget remains"
+            )
+        # The hung call's engine (and its lock) are abandoned, not shut
+        # down: a shutdown() on a wedged device can hang exactly like
+        # the call did.  The replacement lock keeps run_exclusive and
+        # later dispatches from queueing behind a thread that may never
+        # return.
+        self._device_lock = threading.Lock()
+        self._engine = self._engine_factory()
+        obs_counters.inc("serve.engine_rebuilds")
+        return EngineHung(
+            f"device call exceeded the {self._watchdog_s:g}s watchdog; "
+            "engine rebuilt, dispatch will be retried"
+        )
 
     def run_exclusive(self, fn):
         """Run ``fn()`` holding the device lock — for proxy paths that
         must call the inner engine directly (e.g. chat-formatted
-        ``generate``) without overlapping an in-flight device batch."""
-        with self._device_lock:
-            return fn()
+        ``generate``) without overlapping an in-flight device batch.
+
+        Acquires with a short timeout in a loop that re-reads
+        ``self._device_lock``: the engine supervisor swaps the lock
+        when it abandons a hung device call, and a caller queued on the
+        OLD lock would otherwise wait forever behind a thread that
+        never releases it.  A long legitimate device call just loops
+        (same lock each pass); a swapped lock is picked up within one
+        timeout; a CLOSED scheduler (incl. one _declare_dead took down
+        while its wedged lock was never swapped) surfaces
+        :class:`SchedulerClosed` instead of spinning on a lock that
+        will never be released."""
+        while True:
+            if self._closed:
+                raise SchedulerClosed(
+                    "scheduler is shut down; exclusive device access is "
+                    "no longer available"
+                )
+            lock = self._device_lock
+            if lock.acquire(timeout=0.1):
+                try:
+                    return fn()
+                finally:
+                    lock.release()
 
     # ------------------------------------------------------------- lifecycle
 

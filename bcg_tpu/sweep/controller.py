@@ -41,6 +41,7 @@ import dataclasses
 import glob
 import json
 import os
+import random as _random
 import statistics
 import threading
 import time
@@ -50,7 +51,7 @@ from bcg_tpu.obs import counters as obs_counters
 from bcg_tpu.obs import export as obs_export
 from bcg_tpu.obs import fleet as obs_fleet
 from bcg_tpu.obs import game_events as obs_game_events
-from bcg_tpu.runtime import envflags
+from bcg_tpu.runtime import envflags, resilience
 from bcg_tpu.sweep.spec import JobSpec, expand, load_spec, spec_name
 
 
@@ -134,6 +135,7 @@ class SweepController:
         slo_ms: Optional[int] = None,
         linger_ms: Optional[int] = None,
         engine=None,
+        max_job_retries: Optional[int] = None,
     ):
         self.spec = spec
         self.name = spec_name(spec)
@@ -142,6 +144,9 @@ class SweepController:
         if max_concurrent is None:
             max_concurrent = envflags.get_int("BCG_TPU_SWEEP_MAX_CONCURRENT")
         self.max_concurrent = max(1, max_concurrent)
+        if max_job_retries is None:
+            max_job_retries = envflags.get_int("BCG_TPU_SWEEP_MAX_JOB_RETRIES")
+        self.max_job_retries = max(0, max_job_retries)
         if tenant_quota_rows is None:
             tenant_quota_rows = envflags.get_int(
                 "BCG_TPU_SWEEP_TENANT_QUOTA_ROWS"
@@ -299,6 +304,7 @@ class SweepController:
         my_run = obs_fleet.run_id()
         shared = envflags.is_set("BCG_TPU_RUN_ID")
         t0 = time.monotonic()
+        poll_s = 0.005
         while time.monotonic() - t0 < deadline_s:
             try:
                 with open(self._coop_plan_path()) as f:
@@ -309,7 +315,11 @@ class SweepController:
                     return list(plan.get("pending", []))
             except (OSError, json.JSONDecodeError):
                 pass
-            time.sleep(0.02)
+            # Backoff, not a fixed cadence (BCG-RETRY-SLEEP): fast while
+            # rank 0 is typically milliseconds away, capped so a slow
+            # rank-0 boot costs at most 4 polls/second of waiting.
+            time.sleep(poll_s)
+            poll_s = min(poll_s * 2, 0.25)
         raise RuntimeError(
             "cooperative sweep: rank 0 never published its job plan "
             f"({self._coop_plan_path()}) — cannot safely guess which "
@@ -363,16 +373,39 @@ class SweepController:
         obs_counters.set_gauge("sweep.jobs.total", len(self.jobs))
         results: List[Dict[str, Any]] = []
         res_lock = threading.Lock()
-        work = list(pending)
+        # Work items are (job, attempt): a TRANSIENT failure with retry
+        # budget left (BCG_TPU_SWEEP_MAX_JOB_RETRIES) requeues the job
+        # at the back of this rank's partition — it re-enters the same
+        # strided work list, resumes from its newest round checkpoint,
+        # and only its TERMINAL attempt lands in `results`, so the
+        # summary (and every report keyed on the last job_end per job)
+        # counts it exactly once.
+        work: List[Tuple[JobSpec, int]] = [(j, 0) for j in pending]
         work_lock = threading.Lock()
+        retry_rng = threading.local()
 
         def worker():
             while True:
                 with work_lock:
                     if not work:
                         return
-                    job = work.pop(0)
-                out = self._run_job(job)
+                    job, attempt = work.pop(0)
+                out = self._run_job(job, attempt=attempt)
+                if (out["status"] == "failed"
+                        and out.get("failure") == "transient"
+                        and attempt < self.max_job_retries):
+                    obs_counters.inc("sweep.jobs.retried")
+                    rng = getattr(retry_rng, "rng", None)
+                    if rng is None:
+                        rng = retry_rng.rng = _random.Random(
+                            hash((job.job_id, self.rank)) & 0xFFFFFFFF
+                        )
+                    time.sleep(resilience.backoff_s(
+                        attempt, base_s=0.05, cap_s=2.0, rng=rng
+                    ))
+                    with work_lock:
+                        work.append((job, attempt + 1))
+                    continue
                 with res_lock:
                     results.append(out)
 
@@ -410,7 +443,7 @@ class SweepController:
 
     # ------------------------------------------------------------ one job
 
-    def _run_job(self, job: JobSpec) -> Dict[str, Any]:
+    def _run_job(self, job: JobSpec, attempt: int = 0) -> Dict[str, Any]:
         from bcg_tpu.runtime.checkpoint import resume_simulation
         from bcg_tpu.runtime.orchestrator import BCGSimulation
         from bcg_tpu.serve.engine import ServingEngine
@@ -424,9 +457,14 @@ class SweepController:
         obs_counters.inc("sweep.jobs")
         self._append_manifest({
             "event": "job_start", "job": jid, "params": dict(job.params),
+            "attempt": attempt,
         })
         t0 = time.perf_counter()
         try:
+            # Chaos seam (BCG_TPU_CHAOS `crash@sweep.job`): the injected
+            # job crash fires BEFORE any game state exists, so a retried
+            # attempt replays a clean job (no spurious half-game events).
+            resilience.inject("sweep.job")
             engine, scheduler = self._group_for(job)
             scheduler.register_tenant(
                 jid,
@@ -487,6 +525,8 @@ class SweepController:
             }
             if resumed_round is not None:
                 record["resumed_from_round"] = resumed_round
+            if attempt:
+                record["attempt"] = attempt
             self._append_manifest(record)
             obs_counters.inc("sweep.jobs.completed")
             result = dict(record, params=dict(job.params))
@@ -496,13 +536,19 @@ class SweepController:
             # (KeyboardInterrupt/SystemExit propagate: an interrupted
             # job is NOT a failed job, and Ctrl-C must stop the sweep,
             # not burn one job per press.)
+            # transient vs permanent drives the requeue policy in run()
+            # AND lands in the manifest: a sweep report can then
+            # separate lost-work-from-flakes (retryable) from genuinely
+            # broken configs (never retried).
+            failure = resilience.classify_failure(e)
             self._append_manifest({
                 "event": "job_end", "job": jid, "status": "failed",
+                "failure": failure, "attempt": attempt,
                 "error": f"{type(e).__name__}: {e}",
             })
             obs_counters.inc("sweep.jobs.failed")
             return {
-                "job": jid, "status": "failed",
+                "job": jid, "status": "failed", "failure": failure,
                 "error": f"{type(e).__name__}: {e}",
                 "params": dict(job.params),
             }
@@ -517,6 +563,7 @@ def run_sweep(
     slo_ms: Optional[int] = None,
     linger_ms: Optional[int] = None,
     engine=None,
+    max_job_retries: Optional[int] = None,
 ) -> Dict[str, Any]:
     """Programmatic entry: run ``source`` (preset name, spec-file path,
     or spec mapping) into ``out_dir``; returns this rank's summary.
@@ -527,6 +574,7 @@ def run_sweep(
         spec, out_dir, max_concurrent=max_concurrent,
         tenant_quota_rows=tenant_quota_rows, slo_ms=slo_ms,
         linger_ms=linger_ms, engine=engine,
+        max_job_retries=max_job_retries,
     )
     return controller.run()
 

@@ -162,6 +162,12 @@ _CONFIG_OVERRIDE_ENVS = (
     "BCG_TPU_FLEET_STRAGGLER_FACTOR", "BCG_TPU_HOSTSYNC",
     "BCG_TPU_COMPILE_OBS", "BCG_TPU_PROFILE", "BCG_TPU_PROFILE_ROUNDS",
     "BCG_TPU_SWEEP_MAX_CONCURRENT", "BCG_TPU_SWEEP_TENANT_QUOTA_ROWS",
+    # Resilience tier: injected faults corrupt/crash the measured
+    # window, and retry/watchdog budgets change how (and whether) it
+    # recovers — none of these may be recorded as default-config runs.
+    "BCG_TPU_CHAOS", "BCG_TPU_FAULT_RATE", "BCG_TPU_FAULT_SEED",
+    "BCG_TPU_SERVE_MAX_DISPATCH_RETRIES", "BCG_TPU_SERVE_WATCHDOG_S",
+    "BCG_TPU_SERVE_DEFER_WAIT_S", "BCG_TPU_SWEEP_MAX_JOB_RETRIES",
     # BCG_TPU_RUN_ID / BCG_TPU_METRICS_SHARD_MS stay out: a run label
     # and a flush period are provenance/measurement knobs, not a change
     # to the served configuration.  BCG_TPU_SWEEP_DIR stays out for the
@@ -287,6 +293,36 @@ def _compile_stats_or_none():
         return None
 
 
+def _fault_stats_or_none():
+    """Fault-injection self-description: FaultInjectingEngine's
+    corruption count (engine.faults.injected — the registry twin of its
+    `.injected` attribute, which alone is invisible to /metrics and
+    this JSON) with the rate/seed in effect, plus the chaos injector's
+    per-seam counts when BCG_TPU_CHAOS ran (runtime/resilience.py).
+    Attached on success AND error paths — a resilience experiment's
+    result line must say which faults actually fired, especially when
+    the run died."""
+    try:
+        from bcg_tpu.obs import counters as _counters
+        from bcg_tpu.runtime import resilience as _resilience
+
+        injected = _counters.value("engine.faults.injected")
+        chaos = _resilience.stats()
+        if not injected and not chaos:
+            return None
+        out = {"injected": injected}
+        raw_rate = envflags.get_str("BCG_TPU_FAULT_RATE")
+        if raw_rate:
+            out["rate"] = float(raw_rate)
+            out["seed"] = envflags.get_int("BCG_TPU_FAULT_SEED")
+        if chaos:
+            out["chaos"] = chaos
+        return out
+    except Exception:
+        # Inside the never-rc=1 contract (see _obs_payload).
+        return None
+
+
 def _fleet_stats_or_none():
     """Fleet identity block (run id, rank, host, shard path, heartbeat
     age, straggler count) when fleet stamping is on (BCG_TPU_FLEET /
@@ -406,6 +442,12 @@ def _error_result(exc: BaseException, retried: bool) -> dict:
     fleet_stats = _fleet_stats_or_none()
     if fleet_stats:
         out["fleet"] = fleet_stats
+    # Fault-injection profile of the failed attempt (corrupted
+    # responses, chaos seams fired): a resilience experiment that died
+    # must still say which faults it had injected by then.
+    fault_stats = _fault_stats_or_none()
+    if fault_stats:
+        out["faults"] = fault_stats
     # Boot-phase breakdown of the failed attempt (engine boots record
     # into runtime.metrics.LAST_BOOT_PHASES even when construction
     # dies mid-phase): a RESOURCE_EXHAUSTED error line now names the
@@ -835,6 +877,10 @@ def _run_attempt(cfg, model: str, backend: str, concurrency: int,
             # age, straggler count) when fleet stamping is on; None
             # single-process.
             "fleet": _fleet_stats_or_none(),
+            # BCG_TPU_FAULT_RATE / BCG_TPU_CHAOS: fault-injection
+            # profile (corrupted responses + chaos seams fired); None
+            # when neither injector ran.
+            "faults": _fault_stats_or_none(),
             "window_decode_steps": window_steps,
             "window_failed_row_fraction": round(failed_fraction, 4),
             "baseline_denominator_dec_per_sec": (

@@ -52,10 +52,10 @@ from typing import Dict, List, Optional
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 SCENARIOS = ("serve", "engine", "paged", "sampler", "int4", "consensus",
-             "fleet", "hostsync", "compile", "sweep", "hlo")
+             "fleet", "hostsync", "compile", "sweep", "chaos", "hlo")
 REGRESSIONS = ("none", "spec-off", "fail-rows", "events-off",
                "straggler-off", "hostsync-off", "compile-off",
-               "fairness-off")
+               "fairness-off", "chaos-off")
 
 DECISION = {
     "type": "object",
@@ -1070,10 +1070,12 @@ def run_sweep_scenario(inject: str = "none") -> Dict[str, float]:
         import time as _time
 
         t0 = _time.monotonic()
+        poll_s = 0.0005
         while sched.queue_depth_rows() > 0:
             if _time.monotonic() - t0 > deadline_s:
                 raise RuntimeError("scheduler never picked up the seed batch")
-            _time.sleep(0.001)
+            _time.sleep(poll_s)  # backoff, not fixed-cadence (BCG-RETRY-SLEEP)
+            poll_s = min(poll_s * 2, 0.01)
 
     # --- fairness arm -------------------------------------------------
     eng = RecordingEngine()
@@ -1156,6 +1158,179 @@ def run_sweep_scenario(inject: str = "none") -> Dict[str, float]:
     }
 
 
+def run_chaos_scenario(inject: str = "none") -> Dict[str, float]:
+    """Chaos seam injection + recovery tier gates (runtime/resilience.py
+    + the serve dispatch retry/supervisor ladder + the sweep job-requeue
+    policy), all hermetic and deterministic — the scheduler's single
+    dispatch thread makes seam occurrences strictly sequential, so an
+    occurrence-indexed chaos spec fires the same faults at the same
+    passes on every run:
+
+    * serve arm — a seeded FakeEngine serving run (2 waves x 8 threads
+      x 2-row guided requests, 4-row bucket, retries=2, watchdog 1.5s +
+      engine_factory) under an injected engine CRASH (dispatch pass 2),
+      device-call HANG (pass 4, 4s > watchdog), and PoolExhausted
+      (pass 6).  Every fault must recover: completed_fraction 1.0,
+      lost_futures/failed_requests/error_rows 0, and the recovery
+      counters (dispatch_retries, recoveries, engine_rebuilds,
+      batch_splits) land EXACTLY where the spec puts them — plus the
+      serve.recovery_ms histogram's quantile sanity.
+    * sweep arm — a 3-job FakeEngine sweep with a transient job crash
+      injected at job pass 2 and a retry budget: the job must requeue,
+      complete, and report exactly once (sweep_jobs_retried >= 1,
+      completed_fraction 1.0, duplicate-job problems EMPTY via the real
+      consensus_report parser).
+
+    ``chaos-off`` injection unsets BCG_TPU_CHAOS: nothing fires, nothing
+    recovers, and the gate must FAIL naming the retry/recovery/rebuild
+    metrics rather than pass vacuously (zero faults means zero recovery
+    evidence, not green recovery)."""
+    import importlib.util
+    import tempfile
+
+    from bcg_tpu.engine.fake import FakeEngine
+    from bcg_tpu.obs import counters as obs_counters
+    from bcg_tpu.runtime import resilience
+    from bcg_tpu.serve.scheduler import Scheduler
+    from bcg_tpu.sweep.controller import run_sweep
+
+    chaos_on = inject != "chaos-off"
+    # Save/restore the RAW value (None vs "") — registry accessors
+    # cannot round-trip "was unset".
+    prior = os.environ.get("BCG_TPU_CHAOS")  # lint: ignore[BCG-ENV-RAW]
+    before = obs_counters.snapshot()
+
+    # --- serve arm: crash + hang + exhaust, all recovered -------------
+    if chaos_on:
+        os.environ["BCG_TPU_CHAOS"] = (
+            "seed=7;crash@serve.dispatch:2;hang@serve.dispatch:4:4.0;"
+            "exhaust@serve.dispatch:6"
+        )
+    else:
+        os.environ.pop("BCG_TPU_CHAOS", None)
+    resilience.reset()
+    try:
+        sched = Scheduler(
+            FakeEngine(seed=0, policy="consensus"),
+            linger_ms=0, bucket_rows=4, max_queue_rows=4096, deadline_ms=0,
+            strict_admission=False, max_dispatch_retries=2,
+            watchdog_s=1.5,
+            engine_factory=lambda: FakeEngine(seed=0, policy="consensus"),
+        )
+        payload = [
+            ("agent system prompt",
+             "Round 2. agent_1 value: 17. agent_2 value: 17. "
+             "Your current value: 17. Decide.",
+             DECISION),
+        ] * 2
+        errors: List[BaseException] = []
+        row_counts = {"rows": 0, "error_rows": 0}
+        count_lock = threading.Lock()
+
+        def one_request():
+            try:
+                out = sched.submit_and_wait(
+                    ("json",), list(payload), [0.0] * 2, [64] * 2
+                )
+                bad = sum(
+                    1 for r in out if not isinstance(r, dict) or "error" in r
+                )
+                with count_lock:
+                    row_counts["rows"] += len(out)
+                    row_counts["error_rows"] += bad
+            except BaseException as e:  # lost futures surface as metrics
+                errors.append(e)
+
+        for _wave in range(2):
+            threads = [
+                threading.Thread(target=one_request) for _ in range(8)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        snap = sched.snapshot()
+        sched.close()
+
+        # --- sweep arm: transient job crash, requeued, reported once --
+        if chaos_on:
+            os.environ["BCG_TPU_CHAOS"] = "seed=7;crash@sweep.job:2"
+        resilience.reset()
+        sweep_dir = os.path.join(
+            tempfile.mkdtemp(prefix="bcg-chaos-gate-"), "sweep"
+        )
+        spec = {
+            "name": "chaos-sweep",
+            "base": {"agents": 3, "byzantine": 0, "max_rounds": 3,
+                     "backend": "fake"},
+            "axes": {"seed": [1, 2, 3]},
+        }
+        summary = run_sweep(
+            spec, sweep_dir, max_concurrent=1,
+            engine=FakeEngine(seed=0, policy="consensus"),
+            max_job_retries=2,
+        )
+    finally:
+        if prior is None:
+            os.environ.pop("BCG_TPU_CHAOS", None)
+        else:
+            os.environ["BCG_TPU_CHAOS"] = prior
+        resilience.reset()
+    moved = obs_counters.delta(before)
+
+    # Duplicate-job detection over the sweep's event files, through the
+    # REAL merge consumer (scripts/consensus_report.py) — a requeued job
+    # must never double its game_end.
+    cr_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "consensus_report.py"
+    )
+    cr_spec = importlib.util.spec_from_file_location("consensus_report", cr_path)
+    cr = importlib.util.module_from_spec(cr_spec)
+    cr_spec.loader.exec_module(cr)
+    import glob as _glob
+
+    games, problems = [], []
+    for path in sorted(_glob.glob(os.path.join(sweep_dir, "events-*.jsonl"))):
+        games.extend(cr.parse_file(path, problems))
+    dup_problems = cr.duplicate_job_problems(games)
+
+    # serve.recovery_ms quantile sanity (the structural histogram gate —
+    # wall-clock quantile VALUES are not banded, ordering is).  The
+    # count guard reads the SCENARIO's movement, not the process
+    # absolute: an earlier in-process recovery (another test) must not
+    # let the chaos-off arm pass this vacuously.
+    try:
+        hist = obs_counters.histogram("serve.recovery_ms")
+        q = hist.quantiles()
+        hist_sane = float(
+            moved.get("serve.recovery_ms.count", 0) > 0
+            and 0.0 <= q["p50"] <= q["p95"] <= q["p99"] <= hist.bounds[-1]
+        )
+    except KeyError:
+        hist_sane = 0.0
+    if errors:
+        raise errors[0]
+    return {
+        "chaos.completed_fraction": (
+            snap["completed"] / max(1, snap["submitted"])
+        ),
+        "chaos.lost_futures": float(snap["pending"]),
+        "chaos.failed_requests": float(snap["failed"]),
+        "chaos.error_rows": float(row_counts["error_rows"]),
+        "chaos.dispatch_retries": moved.get("serve.dispatch_retries", 0),
+        "chaos.batch_splits": moved.get("serve.batch_splits", 0),
+        "chaos.recoveries": moved.get("serve.recoveries", 0),
+        "chaos.engine_rebuilds": moved.get("serve.engine_rebuilds", 0),
+        "chaos.faults_injected": moved.get("chaos.injected", 0),
+        "chaos.recovery_hist_sanity": hist_sane,
+        "chaos.sweep_completed_fraction": (
+            summary["completed"] / max(1, len(summary["results"]))
+        ),
+        "chaos.sweep_jobs_retried": moved.get("sweep.jobs.retried", 0),
+        "chaos.sweep_duplicate_job_problems": float(len(dup_problems)),
+    }
+
+
 def run_hlo_scenario(inject: str = "none") -> Dict[str, float]:
     """Kernel-census drift findings (scripts/hlo_census.py) as a gated
     metric — 0 findings = the lowered programs still match
@@ -1185,6 +1360,7 @@ _RUNNERS = {
     "hostsync": run_hostsync_scenario,
     "compile": run_compile_scenario,
     "sweep": run_sweep_scenario,
+    "chaos": run_chaos_scenario,
     "hlo": run_hlo_scenario,
 }
 

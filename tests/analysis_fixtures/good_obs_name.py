@@ -13,3 +13,4 @@ def record(entry, name, account):
     obs_counters.observe("game.round_ms", 3)                # module observe
     obs_counters.set_gauge("fleet.heartbeat_ms", 0)         # fleet subsystem
     obs_counters.inc("sweep.jobs.completed")                # sweep subsystem
+    obs_counters.inc("chaos.injected")                      # chaos subsystem

@@ -48,6 +48,7 @@ RULE_FIXTURES = {
     "BCG-MUT-DEFAULT": ("bad_mut_default.py", "good_mut_default.py"),
     "BCG-LOCK-CALL": ("bad_lock_call.py", "good_lock_call.py"),
     "BCG-TIME-WALL": ("bad_time_wall.py", "good_time_wall.py"),
+    "BCG-RETRY-SLEEP": ("bad_retry_sleep.py", "good_retry_sleep.py"),
     "BCG-OBS-NAME": ("bad_obs_name.py", "good_obs_name.py"),
     "BCG-OBS-BUCKET": ("bad_obs_bucket.py", "good_obs_bucket.py"),
 }
@@ -96,6 +97,7 @@ class TestRuleFixtures:
             "BCG-JIT-DONATE": 1,
             "BCG-LOCK-CALL": 3,
             "BCG-TIME-WALL": 3,
+            "BCG-RETRY-SLEEP": 3,
             "BCG-OBS-NAME": 5,
             "BCG-OBS-BUCKET": 3,
         }

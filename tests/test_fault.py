@@ -87,3 +87,35 @@ class TestGameUnderFaults:
         # and CONTINUE votes (reference main.py:348-351,451-454 semantics).
         assert "consensus_reached" in m
         assert m["total_rounds"] >= 1
+
+
+class TestObservability:
+    def test_corruptions_count_in_registry(self):
+        """`self.injected` alone is invisible to /metrics, the fleet
+        shard merge, and bench JSON — every corruption must move the
+        engine.faults.injected counter too (ISSUE 15 satellite)."""
+        from bcg_tpu.obs import counters as obs_counters
+
+        before = obs_counters.value("engine.faults.injected")
+        faulty = FaultInjectingEngine(FakeEngine(seed=0), rate=1.0, seed=3)
+        faulty.batch_generate_json([("sys", "u", SCHEMA)] * 5)
+        assert obs_counters.value("engine.faults.injected") - before == 5
+        assert faulty.injected == 5
+
+    def test_env_flags_override_config(self, monkeypatch):
+        """BCG_TPU_FAULT_RATE / _SEED wrap the created engine even when
+        the config fields are zero (the bench/sweep A/B convention)."""
+        monkeypatch.setenv("BCG_TPU_FAULT_RATE", "0.5")
+        monkeypatch.setenv("BCG_TPU_FAULT_SEED", "13")
+        engine = create_engine(EngineConfig(backend="fake"))
+        assert isinstance(engine, FaultInjectingEngine)
+        assert engine.rate == 0.5
+        assert engine.rng.random() == __import__("random").Random(13).random()
+
+    def test_env_rate_validates_before_boot(self, monkeypatch):
+        monkeypatch.setenv("BCG_TPU_FAULT_RATE", "1.5")
+        with pytest.raises(ValueError, match="outside"):
+            create_engine(EngineConfig(backend="fake"))
+        monkeypatch.setenv("BCG_TPU_FAULT_RATE", "not-a-float")
+        with pytest.raises(ValueError, match="not a float"):
+            create_engine(EngineConfig(backend="fake"))

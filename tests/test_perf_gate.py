@@ -47,6 +47,7 @@ NAMESPACE_OWNERS = {
     "hostsync": "tests/test_hostsync.py",
     "compile": "tests/test_compile_obs.py",
     "sweep": "tests/test_sweep.py",
+    "chaos": "tests/test_resilience.py",
 }
 # Namespaces owned elsewhere, as the prefix tuple the measurement-match
 # tests skip (derived, not hand-maintained).

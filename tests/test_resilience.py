@@ -1,0 +1,638 @@
+"""Chaos seam injection + recovery tier (runtime/resilience.py, the
+serve dispatch retry/supervisor ladder, the sweep job-requeue policy,
+and the EventSink dead-disk path).
+
+Owns the perf-gate ``chaos.*`` namespace (tests/test_perf_gate.py
+NAMESPACE_OWNERS): the gate-backed classes below pin the scenario green
+at HEAD, the resurface contract (removing a baseline entry fails as
+unbaselined, never silently), and the ``chaos-off`` injection failing
+loudly by name — the never-vacuously-green contract.
+"""
+
+import glob
+import importlib.util
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from bcg_tpu.api import run_simulation
+from bcg_tpu.engine.fake import FakeEngine
+from bcg_tpu.engine.paged_kv import PoolExhausted
+from bcg_tpu.obs import counters as obs_counters, export as obs_export
+from bcg_tpu.runtime import resilience
+from bcg_tpu.runtime.resilience import (
+    ChaosError,
+    EngineDead,
+    EngineHung,
+    FaultPlan,
+)
+from bcg_tpu.serve.engine import ServingEngine, run_serving_simulations
+from bcg_tpu.serve.scheduler import Scheduler, SchedulerClosed
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GATE = os.path.join(REPO, "scripts", "perf_gate.py")
+
+DECIDE = {
+    "type": "object",
+    "properties": {"value": {"type": "integer", "minimum": 0, "maximum": 50}},
+}
+
+
+@pytest.fixture
+def chaos(monkeypatch):
+    """Set a chaos spec for one test; plan cache reset both sides."""
+
+    def arm(spec: str):
+        monkeypatch.setenv("BCG_TPU_CHAOS", spec)
+        resilience.reset()
+
+    yield arm
+    resilience.reset()
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    resilience.reset()
+    yield
+    resilience.reset()
+
+
+# ------------------------------------------------------------- plan units
+
+
+class TestFaultPlan:
+    def test_parse_kinds_sites_occurrences(self):
+        p = FaultPlan.parse(
+            "seed=9;crash@serve.dispatch:2,5;hang@engine.generate:4:1.5;"
+            "exhaust@kvpool.alloc:3+;diskfail@sink.write:1;"
+            "freeze@fleet.heartbeat:1"
+        )
+        assert p.seed == 9
+        kinds = [(d.kind, d.site) for d in p.directives]
+        assert kinds == [
+            ("crash", "serve.dispatch"), ("hang", "engine.generate"),
+            ("exhaust", "kvpool.alloc"), ("diskfail", "sink.write"),
+            ("freeze", "fleet.heartbeat"),
+        ]
+        assert p.directives[0].occurrences == {2, 5}
+        assert p.directives[1].arg == 1.5
+        assert p.directives[2].from_n == 3
+
+    def test_occurrence_semantics(self):
+        p = FaultPlan.parse("crash@serve.dispatch:2,4")
+        fired = [p.fire("serve.dispatch") is not None for _ in range(5)]
+        assert fired == [False, True, False, True, False]
+        assert p.injected == {"crash@serve.dispatch": 2}
+
+    def test_open_range_fires_from_n(self):
+        p = FaultPlan.parse("exhaust@kvpool.alloc:3+")
+        fired = [p.fire("kvpool.alloc") is not None for _ in range(5)]
+        assert fired == [False, False, True, True, True]
+
+    def test_seeded_probability_mode_is_reproducible(self):
+        fires = []
+        for _ in range(2):
+            p = FaultPlan.parse("seed=11;crash@serve.dispatch:p0.5")
+            fires.append(
+                [p.fire("serve.dispatch") is not None for _ in range(20)]
+            )
+        assert fires[0] == fires[1]
+        assert any(fires[0]) and not all(fires[0])
+
+    @pytest.mark.parametrize("bad", [
+        "boom@serve.dispatch:1",          # unknown kind
+        "crash@serve.nowhere:1",          # unknown seam
+        "crash@sink.write:1",             # kind/seam mismatch
+        "crash@serve.dispatch",           # missing when
+        "crash@serve.dispatch:",          # empty when
+        "crash@serve.dispatch:p1.5",      # rate out of range
+    ])
+    def test_bad_specs_fail_at_parse(self, bad):
+        with pytest.raises(ValueError):
+            FaultPlan.parse(bad)
+
+    def test_inject_noop_when_unset(self, monkeypatch):
+        monkeypatch.delenv("BCG_TPU_CHAOS", raising=False)
+        resilience.reset()
+        for _ in range(3):
+            resilience.inject("serve.dispatch")  # must not raise
+        assert resilience.plan() is None
+        assert resilience.stats() is None
+
+    def test_inject_counts_and_raises(self, chaos):
+        chaos("crash@serve.dispatch:1")
+        before = obs_counters.snapshot()
+        with pytest.raises(ChaosError):
+            resilience.inject("serve.dispatch")
+        moved = obs_counters.delta(before)
+        assert moved.get("chaos.injected") == 1
+        assert moved.get("chaos.injected.crash") == 1
+        assert resilience.stats() == {"crash@serve.dispatch": 1}
+
+    def test_classify_failure(self):
+        assert resilience.classify_failure(ChaosError("x")) == "transient"
+        assert resilience.classify_failure(PoolExhausted("x")) == "transient"
+        assert resilience.classify_failure(EngineHung("x")) == "transient"
+        assert resilience.classify_failure(TimeoutError()) == "transient"
+        assert resilience.classify_failure(OSError()) == "transient"
+        assert resilience.classify_failure(EngineDead("x")) == "permanent"
+        assert resilience.classify_failure(ValueError("x")) == "permanent"
+        # Deterministic path/permission errors recur identically per
+        # attempt — they must never burn retry budget.
+        assert resilience.classify_failure(
+            FileNotFoundError("gone")) == "permanent"
+        assert resilience.classify_failure(
+            PermissionError("denied")) == "permanent"
+
+    def test_backoff_caps_and_jitters(self):
+        import random
+
+        rng = random.Random(0)
+        delays = [
+            resilience.backoff_s(a, base_s=0.02, cap_s=0.5, rng=rng)
+            for a in range(10)
+        ]
+        assert all(d <= 0.5 * 1.25 for d in delays)
+        assert delays[1] != delays[2] or delays[2] != delays[3]  # jittered
+        # exponential shape before the cap dominates
+        assert resilience.backoff_s(4, base_s=0.02, cap_s=10.0, jitter=0.0) \
+            == pytest.approx(0.32)
+
+
+# -------------------------------------------------------- dispatch recovery
+
+
+class TestDispatchRecovery:
+    def test_crash_retried_and_recovered(self, chaos):
+        chaos("crash@serve.dispatch:1")
+        before = obs_counters.snapshot()
+        sched = Scheduler(FakeEngine(seed=0), linger_ms=1,
+                          max_dispatch_retries=2)
+        out = sched.submit_and_wait(
+            ("json",),
+            [("s", "agent_1 value: 7. Your current value: 7.", DECIDE)],
+            [0.0], [64],
+        )
+        snap = sched.snapshot()
+        sched.close()
+        moved = obs_counters.delta(before)
+        assert out[0]["value"] == 7
+        assert snap["failed"] == 0 and snap["completed"] == 1
+        assert snap["engine_errors"] == 1
+        rec = snap["recovery"]
+        assert rec["dispatch_retries"] == 1
+        assert rec["recoveries"] == 1
+        assert rec["recovery_ms"]["count"] == 1
+        assert moved.get("serve.dispatch_retries") == 1
+        assert moved.get("serve.recoveries") == 1
+
+    def test_pool_exhaustion_is_retryable(self, chaos):
+        chaos("exhaust@serve.dispatch:1")
+        sched = Scheduler(FakeEngine(seed=0), linger_ms=1,
+                          max_dispatch_retries=1)
+        out = sched.submit_and_wait(
+            ("json",),
+            [("s", "agent_1 value: 9. Your current value: 9.", DECIDE)],
+            [0.0], [64],
+        )
+        sched.close()
+        assert out[0]["value"] == 9
+        assert sched.stats.recoveries == 1
+
+    def test_bisecting_split_isolates_poison_request(self):
+        class PoisonEngine(FakeEngine):
+            def batch_generate_json(self, prompts, temperature=0.8,
+                                    max_tokens=512):
+                if any("POISON" in p[1] for p in prompts):
+                    raise RuntimeError("poison row")
+                return super().batch_generate_json(
+                    prompts, temperature, max_tokens
+                )
+
+        sched = Scheduler(PoisonEngine(seed=0), linger_ms=150,
+                          max_dispatch_retries=1)
+        outs = {}
+        barrier = threading.Barrier(3)
+
+        def worker(name, text):
+            barrier.wait()
+            try:
+                outs[name] = sched.submit_and_wait(
+                    ("json",), [("s", text, DECIDE)], [0.0], [64]
+                )
+            except BaseException as e:
+                outs[name] = e
+
+        rows = [("a", "agent_1 value: 3. Your current value: 3."),
+                ("b", "POISON"),
+                ("c", "agent_1 value: 4. Your current value: 4.")]
+        threads = [threading.Thread(target=worker, args=r) for r in rows]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = sched.snapshot()
+        sched.close()
+        # The poison request fails ALONE; its merge partners complete
+        # with real results after the bisection isolates it.
+        assert isinstance(outs["b"], RuntimeError)
+        assert outs["a"][0]["value"] == 3
+        assert outs["c"][0]["value"] == 4
+        assert snap["completed"] == 2 and snap["failed"] == 1
+        assert snap["recovery"]["batch_splits"] >= 1
+
+    def test_zero_retries_preserves_fail_fast(self, chaos):
+        """Default budget (0): first error fails the batch — the
+        pre-recovery contract, byte-for-byte."""
+        chaos("crash@serve.dispatch:1")
+        sched = Scheduler(FakeEngine(seed=0), linger_ms=1)
+        with pytest.raises(ChaosError):
+            sched.submit_and_wait(("json",), [("s", "u", DECIDE)],
+                                  [0.0], [64])
+        snap = sched.snapshot()
+        sched.close()
+        assert snap["failed"] == 1
+        assert snap["recovery"] is None  # no recovery surface when inert
+
+
+# ------------------------------------------------------- engine supervisor
+
+
+class TestEngineSupervisor:
+    def test_hang_rebuilds_once_and_recovers(self, chaos):
+        chaos("hang@serve.dispatch:1:5.0")
+        built = []
+
+        def factory():
+            built.append(1)
+            return FakeEngine(seed=0)
+
+        sched = Scheduler(FakeEngine(seed=0), linger_ms=1, watchdog_s=1,
+                          engine_factory=factory)
+        t0 = time.monotonic()
+        out = sched.submit_and_wait(
+            ("json",),
+            [("s", "agent_1 value: 5. Your current value: 5.", DECIDE)],
+            [0.0], [64],
+        )
+        wall = time.monotonic() - t0
+        snap = sched.snapshot()
+        sched.close()
+        assert out[0]["value"] == 5
+        assert built == [1]
+        assert snap["recovery"]["engine_rebuilds"] == 1
+        assert snap["recovery"]["recoveries"] == 1
+        # The watchdog cut the 5s hang at ~1s; recovery is bounded by
+        # the watchdog, not the hang.
+        assert wall < 4.0
+
+    def test_second_hang_declares_scheduler_dead(self, chaos):
+        chaos("hang@serve.dispatch:1,2:5.0")
+        sched = Scheduler(FakeEngine(seed=0), linger_ms=1, watchdog_s=1,
+                          engine_factory=lambda: FakeEngine(seed=0))
+        with pytest.raises(EngineDead):
+            sched.submit_and_wait(("json",), [("s", "u", DECIDE)],
+                                  [0.0], [64])
+        # The scheduler declared itself dead: later submitters fail
+        # fast with SchedulerClosed instead of queueing forever.
+        with pytest.raises(SchedulerClosed):
+            sched.submit_and_wait(("json",), [("s", "v", DECIDE)],
+                                  [0.0], [64])
+        sched.close()
+
+    def test_no_factory_hang_is_terminal(self, chaos):
+        chaos("hang@serve.dispatch:1:5.0")
+        sched = Scheduler(FakeEngine(seed=0), linger_ms=1, watchdog_s=1)
+        with pytest.raises(EngineDead):
+            sched.submit_and_wait(("json",), [("s", "u", DECIDE)],
+                                  [0.0], [64])
+        sched.close()
+
+
+# ------------------------------------------------------------ kvpool seam
+
+
+class TestKvPoolSeam:
+    def test_alloc_seam_raises_then_recovers(self, chaos):
+        from bcg_tpu.engine.paged_kv import PagedKV
+        from bcg_tpu.models.configs import spec_for_model
+
+        chaos("exhaust@kvpool.alloc:1")
+        pool = PagedKV(spec_for_model("bcg-tpu/tiny-test"), num_blocks=8,
+                       block_size=4)
+        with pytest.raises(PoolExhausted, match="chaos"):
+            pool.alloc(2)
+        # Single-occurrence fault: the pool itself is untouched and the
+        # next allocation succeeds — exactly the transient shape the
+        # serve retry ladder absorbs.
+        blocks = pool.alloc(2)
+        assert len(blocks) == 2
+        pool.close()
+
+
+# ------------------------------------------------------ sink dead-disk path
+
+
+class TestEventSinkDeadDisk:
+    def _drain_until(self, predicate, timeout_s=5.0):
+        t0 = time.monotonic()
+        delay = 0.002
+        while not predicate():
+            if time.monotonic() - t0 > timeout_s:
+                raise AssertionError("sink never hit the dead-disk path")
+            time.sleep(delay)
+            delay = min(delay * 2, 0.1)
+
+    def test_serve_sink_counts_drops_after_disk_death(self, tmp_path,
+                                                      chaos, capfd):
+        chaos("diskfail@sink.write:1")
+        before = obs_counters.value("serve.events_dropped")
+        sink = obs_export.EventSink(str(tmp_path / "events.jsonl"))
+        for i in range(5):
+            sink.emit("probe", i=i)
+        self._drain_until(
+            lambda: obs_counters.value("serve.events_dropped") - before >= 5
+        )
+        # Post-death emits are counted too (warn-once, count-always).
+        sink.emit("late", i=99)
+        sink.close()
+        dropped = obs_counters.value("serve.events_dropped") - before
+        assert dropped == 6
+        err = capfd.readouterr().err
+        assert err.count("event sink write failed") == 1  # warn ONCE
+        assert "serve.events_dropped" in err
+        # Nothing landed on disk.
+        path = tmp_path / "events.jsonl"
+        assert not path.exists() or path.read_text() == ""
+
+    def test_game_sink_uses_its_own_drop_counter(self, tmp_path, chaos):
+        chaos("diskfail@sink.write:1")
+        before_game = obs_counters.value("game.events_dropped")
+        before_serve = obs_counters.value("serve.events_dropped")
+        sink = obs_export.EventSink(
+            str(tmp_path / "game.jsonl"), drop_counter="game.events_dropped"
+        )
+        for i in range(4):
+            sink.emit("round_probe", i=i)
+        self._drain_until(
+            lambda: obs_counters.value("game.events_dropped")
+            - before_game >= 4
+        )
+        sink.close()
+        assert obs_counters.value("game.events_dropped") - before_game == 4
+        assert obs_counters.value("serve.events_dropped") == before_serve
+
+    def test_healthy_sink_unaffected(self, tmp_path):
+        before = obs_counters.value("serve.events_dropped")
+        sink = obs_export.EventSink(str(tmp_path / "ok.jsonl"))
+        for i in range(3):
+            sink.emit("probe", i=i)
+        sink.close()
+        lines = (tmp_path / "ok.jsonl").read_text().strip().splitlines()
+        assert len(lines) == 3
+        assert obs_counters.value("serve.events_dropped") == before
+
+
+# --------------------------------------------------------- sweep job retry
+
+
+def _sweep_spec():
+    return {
+        "name": "retry-sweep",
+        "base": {"agents": 3, "byzantine": 0, "max_rounds": 3,
+                 "backend": "fake"},
+        "axes": {"seed": [1, 2, 3]},
+    }
+
+
+class TestSweepJobRetry:
+    def test_transient_failure_requeues_completes_reports_once(
+            self, tmp_path, chaos):
+        from bcg_tpu.sweep.controller import render_report, run_sweep
+
+        chaos("crash@sweep.job:2")
+        before = obs_counters.snapshot()
+        summary = run_sweep(
+            _sweep_spec(), str(tmp_path), max_concurrent=1,
+            engine=FakeEngine(seed=0), max_job_retries=2,
+        )
+        moved = obs_counters.delta(before)
+        assert summary["completed"] == 3 and summary["failed"] == 0
+        assert len(summary["results"]) == 3  # terminal outcome per job
+        assert moved.get("sweep.jobs.retried") == 1
+
+        # Manifest: the crashed attempt's job_end is failed/transient,
+        # superseded by a completed job_end for the SAME job — exactly
+        # one completed end per job id.
+        records = [
+            json.loads(line)
+            for line in open(glob.glob(
+                os.path.join(str(tmp_path), "sweep-manifest-r*.jsonl")
+            )[0])
+        ]
+        ends = [r for r in records if r.get("event") == "job_end"]
+        failed = [r for r in ends if r["status"] == "failed"]
+        assert len(failed) == 1
+        assert failed[0]["failure"] == "transient"
+        completed = [r for r in ends if r["status"] == "completed"]
+        assert len(completed) == 3
+        assert len({r["job"] for r in completed}) == 3
+        retried_end = [r for r in completed
+                       if r["job"] == failed[0]["job"]]
+        assert retried_end[0].get("attempt") == 1
+        # Config-grouped report counts each job once (the completed end
+        # supersedes the transient failed attempt): 3 jobs ended, no
+        # failed-jobs footer.
+        report = render_report(str(tmp_path))
+        assert "3 jobs ended" in report
+        assert "failed" not in report
+
+        # Duplicate-game detection over the event files stays EMPTY:
+        # the requeued job produced exactly one game_end.
+        cr_path = os.path.join(REPO, "scripts", "consensus_report.py")
+        spec = importlib.util.spec_from_file_location("cr_retry", cr_path)
+        cr = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(cr)
+        games, problems = [], []
+        for path in sorted(glob.glob(
+                os.path.join(str(tmp_path), "events-*.jsonl"))):
+            games.extend(cr.parse_file(path, problems))
+        assert cr.duplicate_job_problems(games) == []
+        ended = [g for g in games if g.ended]
+        assert len(ended) == 3
+
+    def test_permanent_failure_never_retries(self, tmp_path):
+        from bcg_tpu.sweep.controller import run_sweep
+
+        class BrokenEngine(FakeEngine):
+            def batch_generate_json(self, prompts, temperature=0.8,
+                                    max_tokens=512):
+                raise ValueError("deterministically broken config")
+
+        before = obs_counters.snapshot()
+        summary = run_sweep(
+            {"name": "perm", "base": {"agents": 3, "byzantine": 0,
+                                      "max_rounds": 2, "backend": "fake"},
+             "axes": {"seed": [1]}},
+            str(tmp_path), max_concurrent=1, engine=BrokenEngine(seed=0),
+            max_job_retries=3,
+        )
+        moved = obs_counters.delta(before)
+        assert summary["failed"] == 1
+        assert summary["results"][0]["failure"] == "permanent"
+        # A permanent failure burns zero retry budget.
+        assert moved.get("sweep.jobs.retried", 0) == 0
+
+    def test_retry_budget_exhaustion_is_terminal(self, tmp_path, chaos):
+        from bcg_tpu.sweep.controller import run_sweep
+
+        chaos("crash@sweep.job:1+")  # every attempt crashes
+        summary = run_sweep(
+            {"name": "always", "base": {"agents": 3, "byzantine": 0,
+                                        "max_rounds": 2, "backend": "fake"},
+             "axes": {"seed": [1]}},
+            str(tmp_path), max_concurrent=1, engine=FakeEngine(seed=0),
+            max_job_retries=2,
+        )
+        assert summary["failed"] == 1
+        assert summary["completed"] == 0
+        # 1 initial + 2 retries, then terminal.
+        assert resilience.stats() == {"crash@sweep.job": 3}
+
+
+# ----------------------------------------------- kill-style oracle identity
+
+
+class TestKillStyleOracle:
+    def test_faulted_run_outcome_identical_to_fault_free_oracle(
+            self, chaos, monkeypatch):
+        """Acceptance: a seeded serving run with an injected engine
+        crash mid-wave, a device hang (watchdog + rebuild), and a
+        PoolExhausted completes ALL games with outcomes identical to
+        the fault-free oracle run — recovery is invisible to the game
+        layer (FakeEngine responses are pure functions of prompt
+        content, so retried batches reproduce byte-identical rows)."""
+        monkeypatch.delenv("BCG_TPU_CHAOS", raising=False)
+        resilience.reset()
+
+        def play(engine_proxy):
+            outs = []
+
+            def make(i):
+                def go(engine):
+                    return run_simulation(
+                        n_agents=4, byzantine_count=1, max_rounds=4,
+                        backend="fake", seed=i, engine=engine,
+                    )
+                return go
+
+            outs = run_serving_simulations(
+                None, [make(i) for i in range(4)], serving=engine_proxy,
+            )
+            return outs
+
+        def outcome(result):
+            return (
+                result["metrics"]["consensus_reached"],
+                result["metrics"].get("consensus_value"),
+                result["metrics"].get("total_rounds"),
+            )
+
+        # Oracle: no chaos, plain scheduler.
+        oracle_serving = ServingEngine(FakeEngine(seed=0), linger_ms=2)
+        oracle = [outcome(r) for r in play(oracle_serving)]
+        oracle_serving.shutdown()
+
+        # Faulted run: crash mid-wave + hang + exhaust, recovery on.
+        chaos("seed=3;crash@serve.dispatch:2;hang@serve.dispatch:4:5.0;"
+              "exhaust@serve.dispatch:6")
+        sched = Scheduler(
+            FakeEngine(seed=0), linger_ms=2, max_dispatch_retries=2,
+            watchdog_s=1, engine_factory=lambda: FakeEngine(seed=0),
+        )
+        serving = ServingEngine(FakeEngine(seed=0), scheduler=sched)
+        faulted_results = play(serving)
+        snap = sched.snapshot()
+        serving.shutdown()
+
+        assert all(isinstance(r, dict) for r in faulted_results), (
+            faulted_results
+        )
+        assert [outcome(r) for r in faulted_results] == oracle
+        # All three faults actually fired and were recovered.
+        assert resilience.stats() == {
+            "crash@serve.dispatch": 1, "hang@serve.dispatch": 1,
+            "exhaust@serve.dispatch": 1,
+        }
+        assert snap["failed"] == 0
+        assert snap["recovery"]["recoveries"] == 3
+        assert snap["recovery"]["engine_rebuilds"] == 1
+        # No leaked futures.
+        assert snap["pending"] == 0
+
+
+# ------------------------------------------------------------- gate-backed
+
+
+def _load_gate():
+    spec = importlib.util.spec_from_file_location("perf_gate_chaos", GATE)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def chaos_gate():
+    resilience.reset()
+    mod = _load_gate()
+    measured = mod.run_chaos_scenario()
+    resilience.reset()
+    return mod, measured
+
+
+class TestChaosGate:
+    def test_scenario_green_at_head(self, chaos_gate):
+        mod, measured = chaos_gate
+        findings = mod.check_metrics(measured, mod.load_baseline())
+        findings += mod.check_stale(measured, mod.load_baseline(), ("chaos",))
+        assert findings == [], "\n".join(findings)
+
+    def test_measures_the_advertised_metrics(self, chaos_gate):
+        _, measured = chaos_gate
+        for name in (
+            "chaos.completed_fraction", "chaos.lost_futures",
+            "chaos.dispatch_retries", "chaos.batch_splits",
+            "chaos.recoveries", "chaos.engine_rebuilds",
+            "chaos.faults_injected", "chaos.recovery_hist_sanity",
+            "chaos.sweep_jobs_retried", "chaos.sweep_completed_fraction",
+            "chaos.sweep_duplicate_job_problems",
+        ):
+            assert name in measured, name
+
+    def test_removing_entry_resurfaces_unbaselined_failure(self, chaos_gate):
+        mod, measured = chaos_gate
+        baseline = mod.load_baseline()
+        pruned = {
+            "metrics": {
+                k: v for k, v in baseline["metrics"].items()
+                if k != "chaos.recoveries"
+            }
+        }
+        findings = mod.check_metrics(measured, pruned)
+        assert any("chaos.recoveries" in f and "no entry" in f
+                   for f in findings), findings
+
+    def test_chaos_off_injection_fails_naming_recovery_metrics(self):
+        resilience.reset()
+        mod = _load_gate()
+        measured = mod.run_chaos_scenario("chaos-off")
+        resilience.reset()
+        findings = mod.check_metrics(measured, mod.load_baseline())
+        named = "\n".join(findings)
+        for metric in ("chaos.dispatch_retries", "chaos.recoveries",
+                       "chaos.engine_rebuilds", "chaos.faults_injected",
+                       "chaos.sweep_jobs_retried"):
+            assert metric in named, (metric, findings)

@@ -848,3 +848,133 @@ class TestStragglerBenchmark:
             f"serving {serve_s:.3f}s should beat collective {coll_s:.3f}s "
             "on the straggler workload"
         )
+
+
+# ------------------------------------------------- fair-share refund (ISSUE 15)
+
+
+class TestFairShareRefund:
+    def test_engine_error_refunds_served_rows_charge(self):
+        """A dispatch that fails must refund the tenants' served_rows
+        charge taken at selection: without the refund, a crashing
+        tenant's traffic permanently deflates its own virtual time and
+        its next requests OUTRANK every healthy tenant exactly because
+        its dispatches keep dying."""
+        class Boom(StubEngine):
+            def __init__(self):
+                super().__init__()
+                self.fail = True
+
+            def batch_generate_json(self, prompts, temperature=0.8,
+                                    max_tokens=512):
+                if self.fail:
+                    raise RuntimeError("device on fire")
+                return super().batch_generate_json(
+                    prompts, temperature, max_tokens
+                )
+
+        eng = Boom()
+        sched = Scheduler(eng, linger_ms=1)
+        crashy = sched.register_tenant("crashy", weight=1.0)
+        with pytest.raises(RuntimeError, match="device on fire"):
+            sched.submit_and_wait(
+                ("json",), [("s", f"u{i}", DECIDE) for i in range(4)],
+                [0.0] * 4, [64] * 4, tenant="crashy",
+            )
+        # Charged 4 at selection, refunded 4 at failure.
+        assert crashy.served_rows == 0
+        # Control: a successful dispatch keeps its charge.
+        eng.fail = False
+        sched.submit_and_wait(("json",), [("s", "ok", DECIDE)], [0.0], [64],
+                              tenant="crashy")
+        assert crashy.served_rows == 1
+        sched.close()
+
+    def test_untenanted_failure_refunds_anonymous_account(self):
+        class AlwaysBoom(StubEngine):
+            def batch_generate_json(self, prompts, temperature=0.8,
+                                    max_tokens=512):
+                raise RuntimeError("boom")
+
+        sched = Scheduler(AlwaysBoom(), linger_ms=1)
+        sched.register_tenant("bystander")  # activates fair ordering
+        with pytest.raises(RuntimeError):
+            sched.submit_and_wait(("json",), [("s", "u", DECIDE)],
+                                  [0.0], [64])
+        assert sched._anon_tenant.served_rows == 0
+        sched.close()
+
+
+# --------------------------------------- tenant deferral hardening (ISSUE 15)
+
+
+class _SchedulerScript:
+    """Scripted Scheduler stand-in for the ServingEngine deferral loop:
+    defers the first ``defer_n`` submits (or forever with -1), each
+    carrying a fixed retry-after."""
+
+    def __init__(self, defer_n, retry_after_s=0.01):
+        self.calls = 0
+        self.defer_n = defer_n
+        self.retry_after_s = retry_after_s
+        self._thread = threading.current_thread()  # alive by construction
+
+    def submit_and_wait(self, sig, payload, temps, budgets, tenant=None):
+        from bcg_tpu.serve.scheduler import AdmissionDeferred
+
+        self.calls += 1
+        if self.defer_n < 0 or self.calls <= self.defer_n:
+            raise AdmissionDeferred(
+                "quota full", retry_after_s=self.retry_after_s
+            )
+        return [{"ok": True}] * len(payload)
+
+    def close(self):
+        pass
+
+
+class TestDeferralHardening:
+    def test_transient_deferrals_retry_through(self):
+        script = _SchedulerScript(defer_n=2)
+        serve = ServingEngine(StubEngine(), scheduler=script, tenant="t",
+                              defer_wait_ceiling_s=30)
+        out = serve.batch_generate_json([("s", "u", DECIDE)], 0.0, 64)
+        assert out == [{"ok": True}]
+        assert script.calls == 3  # 2 deferrals + the success
+
+    def test_wedged_scheduler_hits_the_ceiling(self):
+        """An endlessly-deferring (wedged) scheduler must surface
+        SchedulerClosed once cumulative backoff passes the ceiling —
+        never spin the fixed-sleep loop forever."""
+        script = _SchedulerScript(defer_n=-1, retry_after_s=0.02)
+        serve = ServingEngine(StubEngine(), scheduler=script, tenant="t",
+                              defer_wait_ceiling_s=0.15)
+        t0 = time.monotonic()
+        with pytest.raises(SchedulerClosed, match="ceiling"):
+            serve.batch_generate_json([("s", "u", DECIDE)], 0.0, 64)
+        wall = time.monotonic() - t0
+        assert wall < 2.0  # bounded, not unbounded spin
+        assert script.calls >= 2  # it DID retry before giving up
+
+    def test_retry_delays_are_jittered(self):
+        """Two proxies' backoff sequences must decorrelate (per-proxy
+        seeded jitter): equal fixed sleeps re-herd every deferred
+        tenant into the same later dispatch window."""
+        delays = {}
+        for name in ("a", "b"):
+            serve = ServingEngine(StubEngine(),
+                                  scheduler=_SchedulerScript(defer_n=0),
+                                  tenant=name)
+            seq = [serve._defer_rng.uniform(0.75, 1.25) for _ in range(4)]
+            delays[name] = seq
+        assert delays["a"] != delays["b"]
+
+    def test_dead_scheduler_thread_raises_immediately(self):
+        script = _SchedulerScript(defer_n=-1)
+        dead = threading.Thread(target=lambda: None)
+        dead.start()
+        dead.join()
+        script._thread = dead
+        serve = ServingEngine(StubEngine(), scheduler=script, tenant="t")
+        with pytest.raises(SchedulerClosed, match="died"):
+            serve.batch_generate_json([("s", "u", DECIDE)], 0.0, 64)
