@@ -68,6 +68,16 @@ class NetworkTopology:
             mask[i, neighbors] = True
         return mask
 
+    def receiver_mask(self) -> np.ndarray:
+        """Dense [n, n] bool mask in RECEIVER orientation:
+        ``mask[i, j]`` = receiver i hears sender j — the transpose of
+        :meth:`neighbor_mask`, which is what the delivery paths
+        (``runtime/orchestrator._broadcast_receive_spmd`` and the fused
+        mega-round's ``parallel/game_step.masked_exchange``) consume.
+        Kept as a named surface so the orientation convention lives in
+        one place instead of ad-hoc ``.T`` at every call site."""
+        return self.neighbor_mask().T.copy()
+
     @property
     def avg_degree(self) -> float:
         return (

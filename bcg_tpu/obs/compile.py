@@ -1,7 +1,7 @@
 """Compile-cost observability (``BCG_TPU_COMPILE_OBS``) + profiler
 capture windows (``BCG_TPU_PROFILE`` / ``BCG_TPU_PROFILE_ROUNDS``).
 
-ROADMAP item 2 fuses the whole consensus round into one
+ROADMAP item 1 fuses the whole consensus round into one
 ``lax``-controlled jit entry, which makes COMPILATION the next dominant
 invisible cost: the ``engine.compile.<entry>`` / ``engine.retrace.<entry>``
 counters (PR 4) say *that* a trace-cache miss happened but never *why*

@@ -1,6 +1,6 @@
 """Runtime host↔device transfer auditor (``BCG_TPU_HOSTSYNC``).
 
-ROADMAP item 2 ("on-device mega-round") names its target metric —
+ROADMAP item 1 ("on-device mega-round") names its target metric —
 *host-syncs per round → ~1* — but until this module nothing at runtime
 COUNTED the device→host round-trips the game loop actually performs:
 ``BCG-HOST-SYNC`` is a static AST rule over traced regions, blind to

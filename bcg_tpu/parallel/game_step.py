@@ -26,6 +26,85 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from bcg_tpu.parallel.compat import shard_map
 
 
+def _masked_receive(all_vals: jax.Array, mask_rows: jax.Array) -> jax.Array:
+    """The exchange body shared by every delivery path: row i of the
+    result holds agent j's value iff ``mask_rows[i, j]`` AND j proposed
+    (``all_vals[j] >= 0``), else -1.  ``all_vals`` is the full [n] value
+    vector, ``mask_rows`` the (possibly sharded) receiver-mask rows —
+    the shard_map collectives and the dense mega-round program both call
+    this, so topology semantics can never fork between them."""
+    return jnp.where(mask_rows & (all_vals >= 0)[None, :], all_vals[None, :], -1)
+
+
+def masked_exchange(
+    values: jax.Array,         # [n] int32, -1 = abstain
+    receiver_mask: jax.Array,  # [n, n] bool, mask[i, j] = i receives from j
+) -> Tuple[jax.Array, jax.Array]:
+    """Dense (replicated, jit-composable) topology-masked exchange — the
+    mega-round form of :func:`exchange_values`: no mesh, no collective,
+    so it inlines into the fused round program.  Returns ``(received,
+    deliveries)`` where ``received[i, j]`` is agent j's value as seen by
+    agent i (-1 = not delivered) and ``deliveries[i]`` is the number of
+    proposals delivered to receiver i — the adjacency mask applied as a
+    masked matmul over the proposed-indicator vector, which is also the
+    per-receiver count the orchestrator's ``deliveries`` game event and
+    message accounting read."""
+    received = _masked_receive(values, receiver_mask)
+    proposed = (values >= 0).astype(jnp.int32)
+    deliveries = receiver_mask.astype(jnp.int32) @ proposed
+    return received, deliveries
+
+
+def tally_votes_dense(votes: jax.Array) -> Dict[str, jax.Array]:
+    """Dense form of :func:`tally_votes` (same vote conventions, same
+    2n/3 rule from reference byzantine_consensus.py:373-398) — scalar
+    outputs, no mesh, so the mega-round program can inline it."""
+    stop = (votes == 1).sum()
+    cont = (votes == 0).sum()
+    abstain = (votes == -1).sum()
+    total = stop + cont + abstain
+    return {
+        "stop": stop,
+        "continue": cont,
+        "abstain": abstain,
+        "terminate": stop * 3 >= total * 2,
+        "half_stop": stop * 2 >= total,
+    }
+
+
+def check_consensus_dense(
+    values: jax.Array,          # [n] int32 current values, -1 = none
+    is_byzantine: jax.Array,    # [n] bool
+    initial_values: jax.Array,  # [n] int32 honest initials, -1 for Byz
+) -> Dict[str, jax.Array]:
+    """Dense form of :func:`check_consensus_spmd` — the reference's
+    exact rule (byzantine_consensus.py:182-249): ALL honest agents hold
+    the same value AND it is some honest agent's initial value.  Scalar
+    outputs; shares the pairwise-equality modal count with the spmd
+    body so the two paths cannot diverge semantically."""
+    honest_valid = (~is_byzantine) & (values >= 0)
+    n_honest = honest_valid.sum()
+    same = honest_valid[:, None] & honest_valid[None, :] & (
+        values[:, None] == values[None, :]
+    )
+    counts = jnp.where(honest_valid, same.sum(axis=1), 0)
+    modal_idx = jnp.argmax(counts)
+    ref = values[modal_idx]
+    modal_count = counts[modal_idx]
+    agreement = jnp.where(
+        n_honest > 0, modal_count / jnp.maximum(n_honest, 1) * 100.0, 0.0
+    )
+    all_equal = (modal_count == n_honest) & (n_honest > 0)
+    from_initial = (
+        (initial_values == ref) & ~is_byzantine & (initial_values >= 0)
+    ).any()
+    return {
+        "has_consensus": all_equal & from_initial,
+        "consensus_value": ref,
+        "agreement_pct": agreement,
+    }
+
+
 def exchange_values(
     values: jax.Array,        # [n] int32, -1 = abstain, sharded over dp
     neighbor_mask: jax.Array, # [n, n] bool (static topology)
@@ -37,8 +116,7 @@ def exchange_values(
 
     def body(local_vals, mask_rows):
         all_vals = jax.lax.all_gather(local_vals, axis_name, tiled=True)  # [n]
-        received = jnp.where(mask_rows & (all_vals >= 0)[None, :], all_vals[None, :], -1)
-        return received
+        return _masked_receive(all_vals, mask_rows)
 
     f = shard_map(
         body,
@@ -82,9 +160,7 @@ def exchange_values_global(
 
     def body(local_vals, mask_rows):
         all_vals = jax.lax.all_gather(local_vals, axis_name, tiled=True)
-        received = jnp.where(
-            mask_rows & (all_vals >= 0)[None, :], all_vals[None, :], -1
-        )
+        received = _masked_receive(all_vals, mask_rows)
         # Second gather: replicate the full matrix onto every device so
         # each HOST can read the whole round locally.
         return jax.lax.all_gather(received, axis_name, tiled=True)

@@ -454,7 +454,7 @@ class SchedulerStats:
             # Host-sync view (BCG_TPU_HOSTSYNC): device->host transfers
             # this scheduler's dispatches performed, normalized per
             # dispatch and per completed request — the serve-side form
-            # of ROADMAP item 2's syncs-per-round metric.  None when
+            # of ROADMAP item 1's syncs-per-round metric.  None when
             # the auditor is off (kv_pool idiom).
             "hostsync": (
                 {

@@ -168,6 +168,10 @@ _CONFIG_OVERRIDE_ENVS = (
     "BCG_TPU_CHAOS", "BCG_TPU_FAULT_RATE", "BCG_TPU_FAULT_SEED",
     "BCG_TPU_SERVE_MAX_DISPATCH_RETRIES", "BCG_TPU_SERVE_WATCHDOG_S",
     "BCG_TPU_SERVE_DEFER_WAIT_S", "BCG_TPU_SWEEP_MAX_JOB_RETRIES",
+    # The fused mega-round replaces the lockstep decide/exchange/vote
+    # host loop with one jit entry per round — a different measured
+    # execution shape, so a megaround run is never a default-config row.
+    "BCG_TPU_MEGAROUND",
     # BCG_TPU_RUN_ID / BCG_TPU_METRICS_SHARD_MS stay out: a run label
     # and a flush period are provenance/measurement knobs, not a change
     # to the served configuration.  BCG_TPU_SWEEP_DIR stays out for the
@@ -272,6 +276,22 @@ def _hostsync_stats_or_none():
         from bcg_tpu.runtime import metrics as _metrics
 
         return _metrics.LAST_HOSTSYNC
+    except Exception:
+        # Inside the never-rc=1 contract (see _obs_payload).
+        return None
+
+
+def _megaround_stats_or_none():
+    """Fused mega-round summary (fused_rounds, syncs_per_round — 1.0 by
+    construction, rounds_per_sec) when the BCG_TPU_MEGAROUND path ran
+    any fused rounds; None otherwise.  Read from runtime.metrics (not
+    the engine object) so the ERROR path — where no engine handle
+    survives — keeps the profile the completed fused rounds already
+    published."""
+    try:
+        from bcg_tpu.runtime import metrics as _metrics
+
+        return _metrics.LAST_MEGAROUND
     except Exception:
         # Inside the never-rc=1 contract (see _obs_payload).
         return None
@@ -430,6 +450,12 @@ def _error_result(exc: BaseException, retried: bool) -> dict:
     hostsync_stats = _hostsync_stats_or_none()
     if hostsync_stats:
         out["hostsync"] = hostsync_stats
+    # Fused mega-round profile of the failed attempt (fused rounds,
+    # syncs/round, rounds/sec) — a fused-path crash must still show how
+    # many rounds fused before it died.
+    megaround_stats = _megaround_stats_or_none()
+    if megaround_stats:
+        out["megaround"] = megaround_stats
     # Compile-cost profile of the failed attempt (which entries
     # compiled, how long, what retraced and WHY) — the forensics a
     # first-compile OOM or a retrace storm otherwise loses.
@@ -868,6 +894,10 @@ def _run_attempt(cfg, model: str, backend: str, concurrency: int,
             # attributed transfers, syncs per phase site, syncs/round,
             # top attribution spans); None when the auditor is off.
             "hostsync": _hostsync_stats_or_none(),
+            # BCG_TPU_MEGAROUND: fused mega-round profile (fused_rounds,
+            # syncs_per_round — 1.0 by construction, rounds_per_sec);
+            # None when no round took the fused path.
+            "megaround": _megaround_stats_or_none(),
             # BCG_TPU_COMPILE_OBS: compile-cost profile (per-entry
             # compile_ms totals, first-compile vs retrace split,
             # cache-entry population, retrace causes); None when the

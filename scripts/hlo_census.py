@@ -39,7 +39,7 @@ from typing import Dict, List, Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-ARMS = ("plain", "ff", "spec", "paged", "paged_pallas", "fused")
+ARMS = ("plain", "ff", "spec", "paged", "paged_pallas", "fused", "megaround")
 _MODEL = "bcg-tpu/tiny-test"
 _SCHEMA = {
     "type": "object",
@@ -64,7 +64,16 @@ def baseline_path() -> str:
 def _force_cpu() -> None:
     # Hermetic: the census pins CPU-lowered programs (this environment's
     # sitecustomize force-registers TPU, so the env var alone is not
-    # enough — same dance as bench.py's BENCH_FORCE_CPU).
+    # enough — same dance as bench.py's BENCH_FORCE_CPU).  Pin the same
+    # 8-device virtual CPU mesh tests/conftest.py forces: XLA's fusion
+    # decisions depend on the host-platform device count, so the
+    # baseline is only comparable to tier-1's in-process census if both
+    # lower under identical geometry.
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -85,6 +94,9 @@ def run_scenario(arms=ARMS) -> Dict[str, Dict]:
     obs_hlo.enable(True)
     base = BCGConfig().engine
     for arm in arms:
+        if arm == "megaround":
+            _run_megaround_arm(base)
+            continue
         cfg = dataclasses.replace(
             base,
             model_name=_MODEL,
@@ -120,6 +132,44 @@ def run_scenario(arms=ARMS) -> Dict[str, Dict]:
         finally:
             engine.shutdown()
     return obs_hlo.snapshot()
+
+
+def _run_megaround_arm(base) -> None:
+    """One fused consensus round (ROADMAP item 1): pins the whole-round
+    program under the ``megaround`` entry — guided decode loops for both
+    phases, the DFA decision parse, the masked-matmul exchange, and the
+    vote tally all lower into ONE jit module, so a kernel added anywhere
+    in the round shows up here.  Also records the per-phase
+    static-prefix ``prefill_suffix``-style programs the plan caches
+    (``prefill`` family — shared entry, first arm to run wins)."""
+    import dataclasses as _dc
+
+    import numpy as np
+
+    from bcg_tpu.engine.jax_engine import JaxEngine
+
+    cfg = _dc.replace(
+        base, model_name=_MODEL, backend="jax", max_model_len=2048,
+    )
+    engine = JaxEngine(cfg)
+    try:
+        n = 2
+        plan = engine.prepare_megaround(
+            n_agents=n, lo=0, hi=100, max_rounds=6,
+        )
+        mask = np.ones((n, n), bool)
+        np.fill_diagonal(mask, False)
+        engine.run_megaround(
+            plan,
+            np.asarray([42, 41], np.int32),
+            np.full((n, n), -1, np.int32),
+            1,
+            mask,
+            np.zeros(n, bool),
+            np.asarray([42, 41], np.int32),
+        )
+    finally:
+        engine.shutdown()
 
 
 # ---------------------------------------------------------------- baseline

@@ -114,10 +114,24 @@ class TestCensusScenario:
                       "prefill_paged", "paged_decode_loop",
                       "paged_pallas_decode_loop",
                       "tpu_paged_decode_loop",
-                      "tpu_paged_pallas_decode_loop"):
+                      "tpu_paged_pallas_decode_loop",
+                      "megaround"):
             assert entry in census, sorted(census)
             assert "error" not in census[entry], census[entry]
             assert census[entry]["total_ops"] > 0
+
+    def test_megaround_fuses_both_phase_loops(self, scenario):
+        """ROADMAP item 1: the whole consensus round is ONE jit module —
+        the decide AND vote guided-decode while-loops lower inside the
+        single ``megaround`` entry (plus the DFA parse loops), so its
+        while-body kernel family strictly exceeds a single decode_loop
+        entry's, and it carries at least one while per phase."""
+        _, census = scenario
+        mega = census["megaround"]
+        single = census["decode_loop"]
+        assert mega["whiles"] >= 2, mega
+        assert mega["step_ops"] > single["step_ops"], (mega, single)
+        assert mega["step_fusions"] > single["step_fusions"], (mega, single)
 
     def test_fused_paged_step_kernels_below_gather_baseline(self, scenario):
         """ISSUE-8 acceptance: on the TPU cross-lowering (the kernel's

@@ -1,0 +1,347 @@
+"""Fused mega-round tests (ISSUE-16, ROADMAP item 1).
+
+Covers the host-side pieces hermetically — template rendering, plan
+building on the byte tokenizer, the FakeEngine mirror's sync profile and
+numpy exchange twin, and the orchestrator's eligibility/fallback matrix
+— plus one real-engine pin: the JaxEngine fused round compiles ONCE and
+never retraces across rounds that vary round number, inbox contents, and
+convergence state (the retrace-pinning acceptance criterion).  The
+fused-vs-lockstep greedy ORACLE identity and the rounds/sec speedup live
+in scripts/perf_gate.py's ``megaround`` scenario (perf_baseline.json).
+"""
+
+import dataclasses
+import importlib.util
+import json
+import os
+import re
+import warnings
+
+import numpy as np
+import pytest
+
+from bcg_tpu.config import BCGConfig, EngineConfig, GameConfig, MetricsConfig
+from bcg_tpu.engine.fake import FakeEngine
+from bcg_tpu.engine.megaround import (
+    MegaroundTemplate,
+    MegaroundUnsupported,
+    build_plan,
+    decision_schema,
+    vote_schema,
+)
+from bcg_tpu.engine.tokenizer import ByteTokenizer
+
+_LADDER = (256, 384, 512, 768, 1024)
+
+
+def _chat_parts(system: str, user: str):
+    # A minimal chat template: the plan builder needs (prefix, suffix)
+    # whose concatenation embeds the user prompt exactly once, like the
+    # real model templates the engine binds.
+    return (f"<s>[SYS]{system}[/SYS]\n{user}", "\n[END]")
+
+
+def _tiny_plan(n=4, lo=0, hi=50, max_rounds=6):
+    template = MegaroundTemplate(n_agents=n, lo=lo, hi=hi,
+                                 max_rounds=max_rounds)
+    return build_plan(template, ByteTokenizer(), _chat_parts, 2048, _LADDER)
+
+
+class TestTemplate:
+    def test_fixed_width_rendering(self):
+        """Every (values, inbox, round) combination renders to the SAME
+        byte length — the property that lets slots become static token
+        columns."""
+        t = MegaroundTemplate(n_agents=4, lo=0, hi=50, max_rounds=9)
+        lengths = set()
+        for values, round_num in [
+            ([3, 17, 3, 42], 1),
+            ([50, 0, 7, 9], 9),
+            ([-1, -1, -1, -1], 0),
+        ]:
+            vals = np.asarray(values, np.int32)
+            inbox = np.tile(vals, (4, 1))
+            for _sys, user, _schema in t.decision_prompts(vals, inbox,
+                                                          round_num):
+                lengths.add(len(user.encode("utf-8")))
+            for _sys, user, _schema in t.vote_prompts(vals, inbox,
+                                                      round_num):
+                lengths.add(len(user.encode("utf-8")))
+        # One length per phase (tails differ), not per round state.
+        assert len(lengths) == 2, lengths
+
+    def test_slot_lines_feed_fake_engine_policies(self):
+        """The rendered lines deliberately match the FakeEngine's stock
+        prompt regexes (present slots parse, dash slots fail) so the
+        fake mirror exercises the same policy code as lockstep
+        prompts."""
+        from bcg_tpu.engine.fake import _CURRENT_RE, _ROUND_RE, _VALUE_RE
+
+        t = MegaroundTemplate(n_agents=3, lo=0, hi=50, max_rounds=6)
+        vals = np.asarray([7, -1, 23], np.int32)
+        inbox = np.asarray(
+            [[-1, -1, 23], [7, -1, 23], [7, -1, -1]], np.int32
+        )
+        _sys, user, _schema = t.decision_prompts(vals, inbox, 2)[0]
+        assert [int(v) for v in _VALUE_RE.findall(user)] == [23]
+        assert int(_CURRENT_RE.search(user).group(1)) == 7
+        assert int(_ROUND_RE.search(user).group(1)) == 2
+        # Row 1 abstained: its own slot renders dashes and fails the
+        # current-value regex rather than parsing as garbage.
+        _sys2, user2, _schema2 = t.decision_prompts(vals, inbox, 2)[1]
+        assert _CURRENT_RE.search(user2) is None
+
+    def test_schemas(self):
+        d = decision_schema(0, 50)
+        assert d["properties"]["value"]["minimum"] == 0
+        assert d["properties"]["value"]["maximum"] == 50
+        v = vote_schema()
+        assert v["properties"]["value"]["maximum"] == 1
+
+
+class TestPlan:
+    def test_static_key_is_round_state_free(self):
+        """Two plans for the same game layout share one static key (one
+        compiled program), and the key holds only hashable layout
+        scalars — round number / values / inbox can never leak in."""
+        k1 = _tiny_plan().static_key()
+        k2 = _tiny_plan().static_key()
+        assert k1 == k2
+        assert hash(k1) == hash(k2)
+
+    def test_layout_change_changes_key(self):
+        assert _tiny_plan(n=4).static_key() != _tiny_plan(n=5).static_key()
+
+    def test_prefix_precedes_every_dynamic_slot(self):
+        """The static-prefix split: every dynamic slot column sits at or
+        after prefix_len, and the prefix region is non-trivial (the
+        engine prefills it once per plan, not once per round)."""
+        plan = _tiny_plan()
+        for phase in (plan.decide, plan.vote):
+            dynamic = (phase.round_col, phase.own_col) + phase.inbox_cols
+            assert all(col >= phase.prefix_len for col in dynamic)
+            assert 0 < phase.prefix_len < phase.L
+
+    def test_negative_range_unsupported(self):
+        template = MegaroundTemplate(n_agents=4, lo=-5, hi=5, max_rounds=6)
+        with pytest.raises(MegaroundUnsupported, match="negative"):
+            build_plan(template, ByteTokenizer(), _chat_parts, 2048, _LADDER)
+
+
+class TestFakeEngineMirror:
+    def test_prepare_mirrors_range_gate(self):
+        eng = FakeEngine()
+        with pytest.raises(MegaroundUnsupported):
+            eng.prepare_megaround(n_agents=4, lo=-1, hi=5, max_rounds=6)
+
+    def test_fused_round_exchange_and_tally(self):
+        """The numpy mirror reproduces the dense game_step bodies: a
+        full-mask round where honest agents agree stops the game, and
+        deliveries/received match the masked-matmul twin exactly."""
+        from bcg_tpu.parallel.game_step import masked_exchange
+
+        eng = FakeEngine(policy="consensus")
+        plan = eng.prepare_megaround(n_agents=4, lo=0, hi=50, max_rounds=6)
+        values = np.asarray([7, 7, 7, 7], np.int32)
+        inbox = np.tile(values, (4, 1))
+        mask = ~np.eye(4, dtype=bool)
+        res = eng.run_megaround(
+            plan, values, inbox, 2, mask, np.zeros(4, bool), values
+        )
+        assert list(res.proposed) == [7, 7, 7, 7]
+        received, deliveries = masked_exchange(res.proposed, mask)
+        np.testing.assert_array_equal(res.received, np.asarray(received))
+        np.testing.assert_array_equal(res.deliveries, np.asarray(deliveries))
+        assert res.terminate and res.has_consensus
+        assert res.consensus_value == 7 and res.agreement_pct == 100.0
+        assert res.vote_dict(["a", "b", "c", "d"]) == {
+            "a": True, "b": True, "c": True, "d": True,
+        }
+
+    def test_sync_profile_matches_fused_entry(self):
+        """The mirror carries the real fused entry's host-sync shape:
+        one round -> one fused round in stats, syncs_per_round 1.0."""
+        eng = FakeEngine()
+        plan = eng.prepare_megaround(n_agents=3, lo=0, hi=50, max_rounds=6)
+        values = np.asarray([3, 17, 42], np.int32)
+        eng.run_megaround(
+            plan, values, np.full((3, 3), -1, np.int32), 1,
+            ~np.eye(3, dtype=bool), np.zeros(3, bool), values,
+        )
+        stats = eng.megaround_stats()
+        assert stats["fused_rounds"] == 1
+        assert stats["syncs_per_round"] == 1.0
+        assert stats["rounds_per_sec"] > 0
+
+
+def _sim_config(**agent_kw):
+    cfg = BCGConfig(
+        game=GameConfig(num_honest=3, num_byzantine=1, max_rounds=6, seed=0),
+        engine=EngineConfig(backend="fake", model_name="bcg-tpu/tiny-test"),
+        metrics=MetricsConfig(save_results=False),
+    )
+    return dataclasses.replace(
+        cfg, agent=dataclasses.replace(cfg.agent, **agent_kw)
+    )
+
+
+class TestOrchestratorDispatch:
+    def test_fused_game_converges(self):
+        from bcg_tpu.runtime.orchestrator import BCGSimulation
+
+        sim = BCGSimulation(config=_sim_config(megaround=True))
+        stats = sim.run()
+        assert stats["consensus_outcome"] == "valid"
+        assert sim.engine.megaround_rounds == stats["total_rounds"]
+        assert sim.engine.megaround_stats()["syncs_per_round"] == 1.0
+
+    def test_flag_off_stays_lockstep(self, monkeypatch):
+        from bcg_tpu.runtime.orchestrator import BCGSimulation
+
+        monkeypatch.delenv("BCG_TPU_MEGAROUND", raising=False)
+        sim = BCGSimulation(config=_sim_config(megaround=False))
+        stats = sim.run()
+        assert stats["consensus_outcome"] == "valid"
+        assert sim.engine.megaround_rounds == 0
+
+    @pytest.mark.parametrize(
+        "break_it",
+        ["structured", "batched", "protocol"],
+        ids=["free-text", "sequential", "lossy-channel"],
+    )
+    def test_unsupported_configs_fall_back_with_warning(self, break_it):
+        """The fallback matrix (DESIGN.md): any ineligible configuration
+        plays the full lockstep game and says so ONCE."""
+        from bcg_tpu.runtime.orchestrator import BCGSimulation
+
+        cfg = _sim_config(megaround=True)
+        if break_it == "structured":
+            cfg = dataclasses.replace(
+                cfg, agent=dataclasses.replace(
+                    cfg.agent, use_structured_output=False
+                )
+            )
+        elif break_it == "batched":
+            cfg = dataclasses.replace(
+                cfg, agent=dataclasses.replace(
+                    cfg.agent, use_batched_inference=False
+                )
+            )
+        else:
+            cfg = dataclasses.replace(
+                cfg, communication=dataclasses.replace(
+                    cfg.communication, protocol_type="lossy_sim"
+                )
+            )
+        sim = BCGSimulation(config=cfg)
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            stats = sim.run()
+        mega_warnings = [
+            w for w in caught
+            if issubclass(w.category, RuntimeWarning)
+            and "megaround" in str(w.message)
+        ]
+        assert len(mega_warnings) == 1, [str(w.message) for w in caught]
+        assert sim.engine.megaround_rounds == 0
+        assert stats["total_rounds"] >= 1
+
+
+class TestJaxFusedRound:
+    def test_round_state_never_retraces(self):
+        """Retrace pinning on the real engine: round 1 compiles the
+        fused program ONCE; rounds with different round numbers, inbox
+        matrices, values, and convergence states reuse it (compile and
+        retrace counters frozen)."""
+        from bcg_tpu.engine.jax_engine import JaxEngine
+        from bcg_tpu.obs import counters as obs_counters
+
+        eng = JaxEngine(EngineConfig(
+            backend="jax", model_name="bcg-tpu/tiny-test",
+            max_model_len=2048,
+        ))
+        try:
+            n = 3
+            plan = eng.prepare_megaround(
+                n_agents=n, lo=0, hi=50, max_rounds=6
+            )
+            mask = ~np.eye(n, dtype=bool)
+            values = np.asarray([3, 17, 42], np.int32)
+            initials = values.copy()
+            inbox = np.full((n, n), -1, np.int32)
+            res = eng.run_megaround(
+                plan, values, inbox, 1, mask, np.zeros(n, bool), initials
+            )
+            snap = obs_counters.snapshot()
+            compiles = snap.get("engine.compile.megaround", 0)
+            retraces = snap.get("engine.retrace.megaround", 0)
+            for round_num in (2, 3):
+                res = eng.run_megaround(
+                    plan, res.values, res.received, round_num, mask,
+                    np.zeros(n, bool), initials,
+                )
+            snap = obs_counters.snapshot()
+            assert snap.get("engine.compile.megaround", 0) == compiles
+            assert snap.get("engine.retrace.megaround", 0) == retraces
+            assert eng.megaround_rounds == 3
+            assert eng.megaround_stats()["syncs_per_round"] == 1.0
+            # Parses stay in-range or abstain; received is mask-shaped.
+            assert all(-1 <= v <= 50 for v in res.proposed)
+            assert (np.asarray(res.received)[~mask] == -1).all()
+        finally:
+            eng.shutdown()
+
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def megaround_gate():
+    """One in-process run of the perf_gate megaround scenario — this
+    file owns the ``megaround.`` namespace's resurface contract
+    (tests/test_perf_gate.py NAMESPACE_OWNERS)."""
+    spec = importlib.util.spec_from_file_location(
+        "perf_gate", os.path.join(_REPO, "scripts", "perf_gate.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod, mod.run_megaround_scenario()
+
+
+class TestPerfGateMegaround:
+    def test_scenario_green_and_nothing_stale(self, megaround_gate):
+        mod, measured = megaround_gate
+        findings = mod.check_metrics(measured, mod.load_baseline())
+        findings += mod.check_stale(measured, mod.load_baseline(),
+                                    ("megaround",))
+        assert findings == [], "\n".join(findings)
+
+    def test_acceptance_values(self, megaround_gate):
+        """ISSUE-16 acceptance: greedy decisions/votes identical to the
+        lockstep oracle, warm fused rounds faster than lockstep, zero
+        steady-state retraces."""
+        _, measured = megaround_gate
+        assert measured["megaround.decision_mismatches"] == 0
+        assert measured["megaround.vote_mismatches"] == 0
+        assert measured["megaround.steady_retraces"] == 0
+        assert measured["megaround.round_speedup"] > 1.0
+
+    def test_removing_each_entry_resurfaces_its_finding(
+        self, megaround_gate
+    ):
+        mod, measured = megaround_gate
+        baseline = mod.load_baseline()
+        entries = [
+            n for n in baseline["metrics"] if n.startswith("megaround.")
+        ]
+        assert sorted(entries) == [
+            "megaround.decision_mismatches", "megaround.round_speedup",
+            "megaround.steady_retraces", "megaround.vote_mismatches",
+        ]
+        for removed in entries:
+            pruned = json.loads(json.dumps(baseline))
+            del pruned["metrics"][removed]
+            findings = mod.check_metrics(measured, pruned)
+            assert any(
+                removed in f and "no entry" in f for f in findings
+            ), (removed, findings)

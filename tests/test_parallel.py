@@ -482,6 +482,40 @@ class TestSPMDGameStep:
         assert received[4, 5] == -1  # agent 5 abstained
         assert received[3, 3] == -1  # no self-delivery
 
+    @pytest.mark.parametrize("topo_name", ["ring", "grid", "full"])
+    def test_masked_exchange_matches_spmd_body_n64(self, topo_name):
+        """ISSUE-16 satellite: the mega-round's dense masked-matmul
+        exchange (masked_exchange) must be value-identical to the
+        shard_map collective form (exchange_values) at the 64-agent
+        one-agent-per-chip scale, for every stock topology — same mask
+        matrix into both, per-cell received values AND the per-receiver
+        ``deliveries`` counts the orchestrator's delivery events read."""
+        from bcg_tpu.parallel.game_step import masked_exchange
+
+        n = 64
+        topo = {
+            "ring": lambda: NetworkTopology.ring(n),
+            "grid": lambda: NetworkTopology.grid(8, 8),
+            "full": lambda: NetworkTopology.fully_connected(n),
+        }[topo_name]()
+        mask = topo.receiver_mask()
+        rng = np.random.default_rng(16)
+        values_np = rng.integers(0, 50, size=n).astype(np.int32)
+        values_np[rng.choice(n, size=7, replace=False)] = -1  # abstainers
+        spmd = np.asarray(exchange_values(
+            jnp.asarray(values_np), jnp.asarray(mask), self.mesh
+        ))
+        received, deliveries = masked_exchange(
+            jnp.asarray(values_np), jnp.asarray(mask)
+        )
+        np.testing.assert_array_equal(np.asarray(received), spmd)
+        # deliveries[i] == number of proposals receiver i actually got
+        # in the collective form (delivered cells are exactly the >= 0
+        # cells: abstainers and non-neighbours read -1).
+        np.testing.assert_array_equal(
+            np.asarray(deliveries), (spmd >= 0).sum(axis=1)
+        )
+
     def test_exchange_values_global_matches_sharded_form(self):
         """The sweep tier's cooperative (dp-across-hosts) exchange
         (exchange_values_global: host inputs -> global placement ->
