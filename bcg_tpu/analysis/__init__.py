@@ -33,6 +33,35 @@ Rule catalog (stable IDs — see DESIGN.md "Static analysis pass"):
 * ``BCG-EXCEPT-BROAD``  ``except Exception`` that neither re-raises,
                         logs, nor inspects the exception
 * ``BCG-MUT-DEFAULT``   mutable default argument values
+* ``BCG-LOCK-CALL``     engine dispatch lexically inside a ``with lock:``
+                        body (the intra-module ancestor of
+                        ``BCG-LOCK-BLOCK`` below)
+* ``BCG-TIME-WALL``     ``time.time()`` used to measure device work
+                        (wall clock races async dispatch)
+* ``BCG-RETRY-SLEEP``   fixed-interval retry sleeps where backoff is
+                        expected
+* ``BCG-OBS-NAME``      observability metric names outside the
+                        registered namespaces
+* ``BCG-OBS-BUCKET``    histogram bucket lists drifting from the shared
+                        bound constants
+
+Whole-program rules (interprocedural pass, ``interproc.py`` — call
+graph across modules, thread-root inventory, per-function lock model):
+
+* ``BCG-LOCK-ORDER``    two thread roots acquire the same named locks in
+                        opposite orders (cycle in the lock-acquisition
+                        graph) — potential deadlock
+* ``BCG-LOCK-BLOCK``    blocking work (sleep, file I/O, engine dispatch,
+                        device transfer, join, un-timed queue ops) while
+                        a named lock is held, directly or through the
+                        call graph
+* ``BCG-SHARED-MUT``    attribute or module global mutated from two or
+                        more thread roots with no common guarding lock
+
+The same pass lifts jit-region resolution across module boundaries
+(``propagate_jit_regions``), so ``BCG-HOST-SYNC``/``BCG-JIT-NP`` see
+helpers that only trace because ANOTHER module jits a caller; the
+``--locks`` CLI mode dumps the thread-root × lock table it computes.
 
 Suppression: a checked-in baseline (``lint_baseline.json``) parks
 existing deliberate violations with a one-line justification each;

@@ -17,6 +17,7 @@ from typing import List, Optional
 from bcg_tpu.analysis.core import (
     analyze_paths,
     baseline_path,
+    build_program,
     default_paths,
     load_baseline,
 )
@@ -47,16 +48,34 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--show-baselined", action="store_true",
         help="also list findings matched by the baseline",
     )
+    parser.add_argument(
+        "--locks", action="store_true",
+        help="print the thread-root × lock table and lock-acquisition "
+             "order edges instead of running the rules",
+    )
     args = parser.parse_args(argv)
+
+    if args.locks:
+        prog = build_program(args.paths or default_paths())
+        print(prog.locks_report())
+        return 0
 
     baseline = [] if args.no_baseline else load_baseline(args.baseline)
     result = analyze_paths(paths=args.paths or default_paths(), baseline=baseline)
 
     if args.as_json:
+        # Every finding carries its disposition so downstream tooling
+        # (scripts/lint.py --diff, CI annotators) never has to join the
+        # two lists to learn whether an entry is new debt.
         print(json.dumps({
             "files_scanned": result.files_scanned,
-            "findings": [f.__dict__ for f in result.findings],
-            "baselined": [f.__dict__ for f in result.baselined],
+            "findings": [
+                {**f.__dict__, "status": "new"} for f in result.findings
+            ],
+            "baselined": [
+                {**f.__dict__, "status": "baselined"}
+                for f in result.baselined
+            ],
             "unused_baseline": [e.__dict__ for e in result.unused_baseline],
             "parse_errors": result.parse_errors,
         }, indent=2))

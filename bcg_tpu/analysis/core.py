@@ -94,6 +94,10 @@ class ModuleContext:
             for child in ast.iter_child_nodes(parent):
                 self._parents[child] = parent
         self.jit_regions = _resolve_jit_regions(self.tree)
+        # Filled by the whole-program pass (interproc.py): functions in
+        # THIS module that trace because a jit region in ANOTHER module
+        # calls them.  Per-module rules see both sets via in_jit_region.
+        self.extra_jit_regions: Set[ast.AST] = set()
 
     def parent(self, node: ast.AST) -> Optional[ast.AST]:
         return self._parents.get(node)
@@ -133,7 +137,7 @@ class ModuleContext:
             if isinstance(
                 cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
             ):
-                if cur in self.jit_regions:
+                if cur in self.jit_regions or cur in self.extra_jit_regions:
                     return True
             cur = self._parents.get(cur)
         return False
@@ -323,6 +327,7 @@ def analyze_paths(
 
     result = AnalysisResult()
     raw: List[Finding] = []
+    contexts: List[ModuleContext] = []
     for file_path in iter_python_files(paths):
         rel = os.path.relpath(os.path.abspath(file_path), root).replace(
             os.sep, "/"
@@ -335,27 +340,85 @@ def analyze_paths(
             result.parse_errors.append(f"{rel}: {exc}")
             continue
         result.files_scanned += 1
-        for rule in rules:
+        contexts.append(ctx)
+
+    # Whole-program pass FIRST: the cross-module jit-region lift feeds
+    # the per-module jit rules, and the program-level rules (lock order,
+    # blocking-under-lock, shared mutation) consume the same index.
+    from bcg_tpu.analysis.interproc import ProgramContext
+
+    prog = ProgramContext(contexts)
+    prog.propagate_jit_regions()
+
+    module_rules = [
+        r for r in rules if not getattr(r, "program_level", False)
+    ]
+    program_rules = [r for r in rules if getattr(r, "program_level", False)]
+    for ctx in contexts:
+        for rule in module_rules:
             for finding in rule(ctx):
                 if not ctx.suppressed(finding.line, finding.rule):
                     raw.append(finding)
+    for rule in program_rules:
+        for finding in rule(prog):
+            fctx = prog.modules.get(finding.path)
+            if fctx is None or not fctx.suppressed(
+                finding.line, finding.rule
+            ):
+                raw.append(finding)
 
+    result.findings, result.baselined, result.unused_baseline = (
+        apply_baseline(raw, baseline)
+    )
+    return result
+
+
+def apply_baseline(
+    raw: Sequence[Finding], baseline: Sequence[BaselineEntry]
+) -> Tuple[List[Finding], List[Finding], List[BaselineEntry]]:
+    """Split ``raw`` findings into (new, baselined, unused-entries).
+
+    Pure function of its inputs — the load-bearing meta-test replays
+    baseline variants against ONE analysis run instead of re-analyzing
+    the tree per entry, so the matching semantics must live here, shared
+    with ``analyze_paths``."""
+    new: List[Finding] = []
+    baselined: List[Finding] = []
     matched_keys: Set[Tuple[str, str, str]] = set()
     budget: Dict[Tuple[str, str, str], int] = {}
     for e in baseline:
         budget[e.key()] = budget.get(e.key(), 0) + max(1, e.count)
-    raw.sort(key=lambda f: (f.path, f.line, f.rule))
-    for finding in raw:
+    for finding in sorted(raw, key=lambda f: (f.path, f.line, f.rule)):
         if budget.get(finding.key(), 0) > 0:
             budget[finding.key()] -= 1
             matched_keys.add(finding.key())
-            result.baselined.append(finding)
+            baselined.append(finding)
         else:
             # Over-budget duplicates of a baselined line are NEW debt —
             # they resurface instead of riding the existing entry.
-            result.findings.append(finding)
-    result.unused_baseline = [
-        e for e in baseline if e.key() not in matched_keys
-    ]
-    result.findings.sort(key=lambda f: (f.path, f.line, f.rule))
-    return result
+            new.append(finding)
+    unused = [e for e in baseline if e.key() not in matched_keys]
+    return new, baselined, unused
+
+
+def build_program(paths: Optional[Sequence[str]] = None):
+    """Parse every python file under ``paths`` and return the
+    whole-program index (``interproc.ProgramContext``) without running
+    any rules — backs the ``--locks`` report.  Unparseable files are
+    skipped; the lint entry point is where parse errors get teeth."""
+    from bcg_tpu.analysis.interproc import ProgramContext
+
+    paths = list(paths) if paths else default_paths()
+    root = repo_root()
+    contexts: List[ModuleContext] = []
+    for file_path in iter_python_files(paths):
+        rel = os.path.relpath(os.path.abspath(file_path), root).replace(
+            os.sep, "/"
+        )
+        try:
+            with open(file_path, encoding="utf-8") as f:
+                source = f.read()
+            contexts.append(ModuleContext(file_path, rel, source))
+        except (SyntaxError, UnicodeDecodeError):
+            continue
+    return ProgramContext(contexts)

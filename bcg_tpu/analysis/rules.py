@@ -963,6 +963,181 @@ def rule_mut_default(ctx: ModuleContext) -> Iterable[Finding]:
                 )
 
 
+# ===================================================== program-level rules
+# These receive a ProgramContext (interproc.py) instead of a
+# ModuleContext: they reason over the package-wide call graph, the
+# thread-root inventory, and the lock model.  The engine dispatches on
+# the ``program_level`` attribute.
+
+
+def _program_rule(rule_id: str):
+    def wrap(fn):
+        fn.rule_id = rule_id
+        fn.program_level = True
+        return fn
+    return wrap
+
+
+def _short(qname: str) -> str:
+    """``bcg_tpu/serve/scheduler.py::Scheduler._loop`` -> ``Scheduler._loop``."""
+    return qname.rsplit("::", 1)[-1]
+
+
+def _lock_short(lock_id: str) -> str:
+    return lock_id.rsplit("::", 1)[-1]
+
+
+@_program_rule("BCG-LOCK-ORDER")
+def rule_lock_order(prog) -> Iterable[Finding]:
+    """Cycle in the lock-acquisition graph reachable from two distinct
+    thread roots (or two instances of one pooled root): thread A holds
+    L1 wanting L2 while thread B holds L2 wanting L1 — the classic
+    deadlock, and exactly the shape the PR-15 watchdog avoided by
+    swapping the device lock instead of nesting it under the queue cond.
+    The edge set comes from lexically nested ``with`` blocks AND from
+    calls made under a lock into functions that (transitively) acquire
+    another — module boundaries don't hide the ordering."""
+    edges = prog.lock_order_edges()
+    cycles = prog.find_lock_cycles(edges)
+    for cycle in sorted(cycles, key=lambda c: tuple(sorted(c))):
+        edge_roots = []
+        for e in cycle:
+            roots = []
+            for ev in edges[e]:
+                roots.extend(prog.roots_reaching(ev.fn))
+            edge_roots.append({r.target: r for r in roots})
+        held_by_two = False
+        names = set()
+        for i in range(len(cycle)):
+            for j in range(len(cycle)):
+                if i == j:
+                    continue
+                for r1 in edge_roots[i].values():
+                    for r2 in edge_roots[j].values():
+                        if r1.target != r2.target or r1.multi:
+                            held_by_two = True
+                            names.add(r1.name)
+                            names.add(r2.name)
+        if not held_by_two:
+            continue
+        ev = edges[cycle[0]][0]
+        fi = prog.functions[ev.fn]
+        order = " -> ".join(
+            [_lock_short(a) for a, _ in cycle] + [_lock_short(cycle[0][0])]
+        )
+        sites = "; ".join(
+            f"{_lock_short(a)}->{_lock_short(b)} at "
+            f"{prog.functions[edges[(a, b)][0].fn].ctx.rel_path}:"
+            f"{getattr(edges[(a, b)][0].node, 'lineno', '?')}"
+            for a, b in cycle
+        )
+        yield fi.ctx.finding(
+            "BCG-LOCK-ORDER",
+            ev.node,
+            f"lock-order cycle {order} across thread roots "
+            f"({', '.join(sorted(names))}) — potential deadlock; "
+            f"acquisitions: {sites}",
+        )
+
+
+@_program_rule("BCG-LOCK-BLOCK")
+def rule_lock_block(prog) -> Iterable[Finding]:
+    """A blocking operation — sleep, thread join, queue get/put without
+    timeout, file I/O, engine dispatch, device transfer — executed while
+    a lock is held, directly or through any resolvable call chain.  The
+    interprocedural generalization of BCG-LOCK-CALL: every other thread
+    needing that lock stalls for the full blocking duration, and a
+    completion path that needs the same lock deadlocks.  Copy state
+    under the lock, release it, then block (serve/scheduler.py is the
+    reference shape)."""
+    reported: Set[int] = set()
+    findings = []
+    for fi, site in prog.iter_held_regions():
+        region_ids = {id(n) for n in prog.region_nodes(site)}
+        for node, kind in prog.direct_blocking(fi.qname):
+            if id(node) not in region_ids or id(node) in reported:
+                continue
+            reported.add(id(node))
+            findings.append(fi.ctx.finding(
+                "BCG-LOCK-BLOCK",
+                node,
+                f"blocking {kind} while holding "
+                f"{_lock_short(site.lock_id)} — copy state under the "
+                "lock, block outside it",
+            ))
+        for call, callee in fi.calls:
+            if id(call) not in region_ids or id(call) in reported:
+                continue
+            kinds = prog.blocking_kinds(callee)
+            if not kinds:
+                continue
+            reported.add(id(call))
+            kind = sorted(kinds)[0]
+            chain = " -> ".join(
+                _short(q) for q in prog.blocking_witness(callee, kind)
+            )
+            findings.append(fi.ctx.finding(
+                "BCG-LOCK-BLOCK",
+                call,
+                f"call into {_short(callee)}() performs {kind} while "
+                f"{_lock_short(site.lock_id)} is held (chain: {chain})",
+            ))
+    return findings
+
+
+@_program_rule("BCG-SHARED-MUT")
+def rule_shared_mut(prog) -> Iterable[Finding]:
+    """An attribute (or module global) mutated from two or more distinct
+    thread roots — or, for module globals only, from two instances of one
+    pooled root — with no common lock held across the mutation sites: a
+    data race.  Pooled workers usually construct their own objects, so a
+    single pooled root is not evidence that an *instance* attribute is
+    shared; a module global IS shared across the pool by construction.
+    Constructor-family writes are object birth and excluded; a single
+    common guarding lock (or thread confinement to one root) silences
+    the rule."""
+    muts = prog.attribute_mutations()
+    for (owner, attr), sites in sorted(muts.items()):
+        is_global = owner.endswith("::<global>")
+        root_map = {}
+        multi = False
+        rooted_sites = []
+        for fi, node, guards in sites:
+            roots = prog.roots_reaching(fi.qname)
+            if roots:
+                rooted_sites.append((fi, node, guards))
+            for r in roots:
+                root_map[r.target] = r
+                multi = multi or r.multi
+        if len(root_map) < 2 and not (
+            len(root_map) == 1 and multi and is_global
+        ):
+            continue
+        common = None
+        for _, _, guards in rooted_sites:
+            common = guards if common is None else (common & guards)
+        if common:
+            continue
+        fi, node, _ = sorted(
+            rooted_sites,
+            key=lambda s: (s[0].ctx.rel_path, getattr(s[1], "lineno", 0)),
+        )[0]
+        names = sorted(r.name for r in root_map.values())
+        what = (
+            f"module global {attr!r}"
+            if is_global
+            else f"attribute {attr!r} of {_short(owner)}"
+        )
+        yield fi.ctx.finding(
+            "BCG-SHARED-MUT",
+            node,
+            f"{what} mutated from {len(root_map)} thread root(s) "
+            f"({', '.join(names)}) with no common guarding lock — "
+            "guard every mutation site with one lock or confine the "
+            "attribute to a single thread",
+        )
+
+
 ALL_RULES: Sequence = (
     rule_host_sync,
     rule_jit_np,
@@ -980,6 +1155,9 @@ ALL_RULES: Sequence = (
     rule_retry_sleep,
     rule_obs_name,
     rule_obs_bucket,
+    rule_lock_order,
+    rule_lock_block,
+    rule_shared_mut,
 )
 
 RULE_IDS: List[str] = [r.rule_id for r in ALL_RULES]

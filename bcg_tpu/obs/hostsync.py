@@ -154,6 +154,11 @@ class HostSyncAuditor:
         self._local = threading.local()
         self._installed_device_get = None
         self._orig_device_get = None
+        # install/uninstall_interception run from serve workers, the
+        # scheduler thread, and sweep workers alike; the check-then-act
+        # on _installed_device_get must be atomic or two installers can
+        # chain-wrap jax.device_get and lose the true original.
+        self._install_lock = threading.Lock()
         self._round_lock = threading.Lock()
         self._open_rounds: list = []
         # Register the namespace at construction: an enabled-but-idle
@@ -256,28 +261,30 @@ class HostSyncAuditor:
             import jax
         except ImportError:
             return
-        if self._installed_device_get is not None:
-            return
-        orig = jax.device_get
+        with self._install_lock:
+            if self._installed_device_get is not None:
+                return
+            orig = jax.device_get
 
-        def _audited_device_get(x):
-            self.note("device_get")
-            return orig(x)
+            def _audited_device_get(x):
+                self.note("device_get")
+                return orig(x)
 
-        self._orig_device_get = orig
-        self._installed_device_get = _audited_device_get
-        jax.device_get = _audited_device_get
+            self._orig_device_get = orig
+            self._installed_device_get = _audited_device_get
+            jax.device_get = _audited_device_get
 
     def uninstall_interception(self) -> None:
-        if self._installed_device_get is None:
-            return
-        import jax
+        with self._install_lock:
+            if self._installed_device_get is None:
+                return
+            import jax
 
-        # Only restore if nothing else re-wrapped it after us.
-        if jax.device_get is self._installed_device_get:
-            jax.device_get = self._orig_device_get
-        self._installed_device_get = None
-        self._orig_device_get = None
+            # Only restore if nothing else re-wrapped it after us.
+            if jax.device_get is self._installed_device_get:
+                jax.device_get = self._orig_device_get
+            self._installed_device_get = None
+            self._orig_device_get = None
 
     # ------------------------------------------------------------- reading
 

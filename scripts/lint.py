@@ -8,7 +8,9 @@
 ``python scripts/lint.py PATH...``  — explicit files/dirs
 
 Any remaining ``python -m bcg_tpu.analysis`` flags pass through
-(``--no-baseline``, ``--json``, ``--show-baselined``).
+(``--no-baseline``, ``--json`` — each finding tagged ``new`` or
+``baselined`` — ``--show-baselined``, ``--locks`` for the whole-program
+thread-root × lock report).
 """
 
 from __future__ import annotations

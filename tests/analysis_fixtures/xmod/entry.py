@@ -1,0 +1,13 @@
+"""Cross-module fixture, caller side: the jit region lives here, the
+violation lives in helper.py.  A per-module pass sees a clean file in
+both places; the whole-program pass marks helper.scale as traced and
+the host-sync rule fires at the np.asarray it contains."""
+
+import jax
+
+from tests.analysis_fixtures.xmod.helper import scale
+
+
+@jax.jit
+def fused_scale(x):
+    return scale(x, 2.0)

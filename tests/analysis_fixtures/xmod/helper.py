@@ -1,0 +1,17 @@
+"""Cross-module fixture, callee side: this module has NO jit of its
+own.  ``scale`` only traces because entry.py jits a caller — exactly
+the case the per-module jit-region fixpoint could not see and the
+whole-program lift (interproc.propagate_jit_regions) exists to catch.
+The np.asarray here must surface as BCG-HOST-SYNC in THIS file."""
+
+import numpy as np
+
+
+def scale(x, factor):
+    host = np.asarray(x)  # host materialization inside a traced helper
+    return host * factor
+
+
+def offset(x, bias):
+    # Not reachable from any jit region: must stay quiet.
+    return np.asarray(x) + bias

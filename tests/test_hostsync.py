@@ -528,10 +528,15 @@ class TestPerfGateHostsync:
 # cross-link test below asserts set equality BOTH ways, so a future
 # static suppression without a registered runtime story fails tier-1 —
 # baseline entries stop being unverifiable prose.  Today the set is
-# empty: every BCG-HOST-SYNC finding has been fixed rather than
-# suppressed, and the eager seams the auditor instruments live OUTSIDE
-# traced regions (where the static rule does not reach — which is
-# exactly why the runtime auditor exists).
+# empty, and that emptiness is now a VERIFIED claim rather than a blind
+# spot: the whole-program pass (bcg_tpu/analysis/interproc.py) lifts
+# jit-region resolution across module boundaries, so helpers that only
+# trace because another module jits a caller are inside the static
+# rule's reach (51 cross-module-marked functions at last count, see
+# ``python -m bcg_tpu.analysis --locks`` for the program index), and
+# the full-tree run still reports zero BCG-HOST-SYNC findings to park.
+# The eager seams the auditor instruments remain OUTSIDE every traced
+# region — which is exactly why the runtime auditor exists.
 HOST_SYNC_SUPPRESSION_COVERAGE = {}
 
 
@@ -552,6 +557,40 @@ class TestStaticRuntimeCrossLink:
             f"pruned: baseline={sorted(entries)}, "
             f"covered={sorted(HOST_SYNC_SUPPRESSION_COVERAGE)}"
         )
+
+    def test_cross_link_enforcement_is_live(self):
+        """De-vacuification of the empty-set equality above: drive a
+        REAL cross-module host-sync violation (the xmod fixture, whose
+        np.asarray only traces because a sibling module jits its
+        caller) through the real analyzer, baseline it the way a future
+        PR would, and assert that suppression (a) actually parks the
+        finding and (b) is exactly the shape the set-equality test
+        rejects until a runtime story is registered here."""
+        from bcg_tpu.analysis import analyze_paths
+        from bcg_tpu.analysis.core import BaselineEntry
+
+        fix = os.path.join(REPO, "tests", "analysis_fixtures", "xmod")
+        raw = analyze_paths(paths=[fix], baseline=None)
+        hs = [f for f in raw.findings if f.rule == "BCG-HOST-SYNC"]
+        assert len(hs) == 1 and hs[0].path.endswith("helper.py"), (
+            "xmod fixture must yield exactly the cross-module host-sync "
+            "finding: " + "; ".join(f.format() for f in raw.findings)
+        )
+        entry = BaselineEntry(
+            rule="BCG-HOST-SYNC", path=hs[0].path, content=hs[0].content,
+            reason="hypothetical future suppression",
+        )
+        parked = analyze_paths(paths=[fix], baseline=[entry])
+        assert not any(
+            f.rule == "BCG-HOST-SYNC" for f in parked.findings
+        ), "the baseline entry failed to park the cross-module finding"
+        assert (entry.path, entry.content) not in (
+            HOST_SYNC_SUPPRESSION_COVERAGE
+        ), "fixture suppressions must never be registered as covered"
+        # The equality assertion above would now fail on exactly this
+        # delta — the enforcement is live, not an empty==empty truism.
+        would_be_baseline = {(entry.path, entry.content)}
+        assert would_be_baseline != set(HOST_SYNC_SUPPRESSION_COVERAGE)
 
     def test_auditor_observes_the_documented_engine_sites(self,
                                                           hostsync_gate):
