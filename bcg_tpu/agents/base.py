@@ -39,12 +39,19 @@ class BCGAgent:
         temperature_vote: float = 0.3,
         max_tokens_decide: int = 300,
         max_tokens_vote: int = 200,
+        strategy: Optional[str] = None,
+        strategy_seed: Optional[int] = None,
     ):
         self.agent_id = agent_id
         self.is_byzantine = is_byzantine
         self.engine = engine
         self.value_range = tuple(value_range)
         self.byzantine_awareness = byzantine_awareness
+        # Adversary-library strategy (scenarios/strategies.py): shapes
+        # the Byzantine prompt persona/task; honest agents ignore it.
+        # strategy_seed feeds the clique's shared-target derivation.
+        self.strategy = strategy
+        self.strategy_seed = strategy_seed
         self.max_json_retries = max_json_retries
         self.temperature_decide = temperature_decide
         self.temperature_vote = temperature_vote

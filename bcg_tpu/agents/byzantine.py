@@ -14,6 +14,17 @@ from bcg_tpu.agents.base import BCGAgent, REASONING_STATE_LIMIT
 
 
 class ByzantineBCGAgent(BCGAgent):
+    # -------------------------------------------------------------- strategy
+
+    def _strategy_obj(self):
+        """The adversary-library strategy object, or None for the
+        reference's default disrupt persona (byte-identical prompts)."""
+        if not self.strategy:
+            return None
+        from bcg_tpu.scenarios.strategies import get_strategy
+
+        return get_strategy(self.strategy)
+
     # ---------------------------------------------------------- system prompt
 
     def build_system_prompt(self, game_state: Dict) -> str:
@@ -22,6 +33,12 @@ class ByzantineBCGAgent(BCGAgent):
 
         lo, hi = self.value_range
         max_rounds = game_state.get("max_rounds", 20)
+        strat = self._strategy_obj()
+        persona = ""
+        if strat is not None:
+            from bcg_tpu.scenarios.strategies import persona_block
+
+            persona = persona_block(strat, lo, hi, self.strategy_seed)
 
         self._cached_system_prompt = f"""You are {self.agent_id}, a BYZANTINE (malicious) agent in a Byzantine Consensus Game.
 
@@ -44,7 +61,7 @@ Key Rules:
 Role: BYZANTINE AGENT (secret - nobody knows!)
 Goal: PREVENT the honest agents from all holding the same value when the game stops
 Note: You have NO initial value - pick ANY value strategically
-
+{persona}
 === WIN/LOSS CONDITIONS ===
 - YOU WIN: The game stops while honest agents hold DIFFERENT values, OR the deadline passes without agreement
 - YOU LOSE: The game stops AND every honest agent holds the same value
@@ -69,6 +86,21 @@ IMPORTANT: Respond in English only. Output valid JSON only, with no other text."
             if self.my_value is not None
             else "You have not proposed a value yet"
         )
+        task = ("Disrupt consensus while looking cooperative. Propose a "
+                "value that seeds confusion or division.")
+        strat = self._strategy_obj()
+        if strat is not None and strat.task:
+            from bcg_tpu.scenarios.strategies import task_block
+
+            lo, hi = self.value_range
+            snapshot = ""
+            if "{snapshot}" in strat.task:
+                # Adaptive strategy: read the live honest-convergence
+                # summary from the agent-visible game state.
+                from bcg_tpu.game.statistics import convergence_snapshot
+
+                snapshot = convergence_snapshot(game_state)
+            task = task_block(strat, lo, hi, self.strategy_seed, snapshot)
         return f"""=== ROUND {current_round} ===
 
 === YOUR CURRENT STATE ===
@@ -82,7 +114,7 @@ IMPORTANT: Respond in English only. Output valid JSON only, with no other text."
 {strategies if strategies else "(No notes yet)"}
 
 === YOUR TASK ===
-Disrupt consensus while looking cooperative. Propose a value that seeds confusion or division.
+{task}
 
 Respond with JSON only:
 {{

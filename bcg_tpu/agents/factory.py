@@ -2,7 +2,7 @@
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
 from bcg_tpu.agents.base import BCGAgent
 from bcg_tpu.agents.byzantine import ByzantineBCGAgent
@@ -18,6 +18,8 @@ def create_agent(
     value_range: Tuple[int, int],
     byzantine_awareness: str = "may_exist",
     llm_config: LLMConfig = LLMConfig(),
+    strategy: Optional[str] = None,
+    strategy_seed: Optional[int] = None,
 ) -> BCGAgent:
     cls = ByzantineBCGAgent if is_byzantine else HonestBCGAgent
     return cls(
@@ -31,4 +33,8 @@ def create_agent(
         temperature_vote=llm_config.temperature_vote,
         max_tokens_decide=llm_config.max_tokens_decide,
         max_tokens_vote=llm_config.max_tokens_vote,
+        # Adversary-library strategy (scenarios/): only the Byzantine
+        # prompt layer reads it, but it rides the shared ctor.
+        strategy=strategy if is_byzantine else None,
+        strategy_seed=strategy_seed,
     )

@@ -186,6 +186,40 @@ class A2ASimProtocol(CommunicationProtocol):
                 ),
             )
 
+    def send_per_receiver(
+        self,
+        sender_id: int,
+        round: int,
+        phase: str,
+        decisions: Dict[int, Decision],
+        reasoning: str,
+        timestamp: int,
+    ) -> None:
+        """Equivocating broadcast: a DIFFERENT decision per neighbour
+        under one timestamp (the adversary 'broadcasts' once; the
+        channel carries receiver-addressed variants).  Neighbours
+        without an entry in ``decisions`` get nothing.  Routes through
+        :meth:`send_message`, so neighbour validation, dedup, counters,
+        and channel overrides (lossy ``_route``) all apply per variant.
+        """
+        for neighbor_id in self.topology.get(sender_id, []):
+            decision = decisions.get(neighbor_id)
+            if decision is None:
+                continue
+            self.send_message(
+                sender_id,
+                neighbor_id,
+                A2AMessage(
+                    sender_id=sender_id,
+                    receiver_id=neighbor_id,
+                    round=round,
+                    phase=phase,
+                    decision=decision,
+                    reasoning=reasoning,
+                    timestamp=timestamp,
+                ),
+            )
+
     def deliver_messages(self, agent_id: int, round: int) -> List[A2AMessage]:
         """Inbox for (agent, round), ordered by (sender_id, timestamp)
         (reference a2a_sim.py:212-233)."""
@@ -292,6 +326,21 @@ class A2ASimClient(ProtocolClient):
             round=round,
             phase=phase,
             decision=decision,
+            reasoning=reasoning,
+            timestamp=self.next_timestamp(),
+        )
+
+    def send_per_receiver(
+        self, round: int, phase: str = Phase.PROPOSE.value,
+        decisions: Optional[Dict[int, Decision]] = None, reasoning: str = "",
+    ) -> None:
+        """Equivocating variant of :meth:`send_to_neighbors`: one
+        timestamp, per-neighbour decisions (see the protocol method)."""
+        self.protocol.send_per_receiver(
+            sender_id=self.agent_id,
+            round=round,
+            phase=phase,
+            decisions=decisions or {},
             reasoning=reasoning,
             timestamp=self.next_timestamp(),
         )

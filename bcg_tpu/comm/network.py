@@ -56,6 +56,24 @@ class AgentNetwork:
             reasoning=reasoning,
         )
 
+    def send_per_receiver(
+        self,
+        sender_id: str,
+        round_num: int,
+        phase: Phase,
+        decisions_by_index: Dict[int, Decision],
+        reasoning: str,
+    ) -> None:
+        """Equivocating broadcast: per-receiver decisions keyed by agent
+        INDEX (the exchange layer's receiver indexing), one timestamp —
+        see ``A2ASimClient.send_per_receiver``."""
+        self.clients[sender_id].send_per_receiver(
+            round=round_num,
+            phase=phase.value if isinstance(phase, Phase) else phase,
+            decisions=decisions_by_index,
+            reasoning=reasoning,
+        )
+
     def get_messages(
         self, receiver_id: str, round_num: int, phase: Optional[Phase] = None
     ) -> List[Message]:

@@ -271,6 +271,13 @@ class GameConfig:
     consensus_threshold: float = 66.0
     max_rounds: int = 50
     byzantine_awareness: str = "may_exist"  # may_exist | none_exist
+    # Byzantine strategy from the adversary library
+    # (scenarios/strategies.py): shapes the adversary prompt persona,
+    # selects the scripted FakeEngine mirror, and — for the
+    # "equivocate" strategy — routes the exchange through per-receiver
+    # proposal tensors.  None = the reference's single disrupt persona
+    # (byte-identical prompts).
+    byzantine_strategy: Optional[str] = None
     seed: Optional[int] = None
 
 

@@ -26,6 +26,14 @@ Policies
   infiltration adversary that tries to freeze consensus early on a
   value it helped pick.
 * ``silent``: abstains wherever the schema allows (decision and vote).
+* ``clique``: every byzantine row pushes ONE seed-derived decoy value
+  (``scenarios.strategies.clique_target`` — the shared secret needs no
+  runtime coordination channel) and votes "continue".
+* ``adaptive``: proposes the modular antipode of the observed mode —
+  the margin-targeting adversary, scripted.
+* ``equivocate``: proposes a deterministic per-round base value; the
+  EXCHANGE layer (per-receiver proposal matrix) spreads it so each
+  receiver sees a different variant.
 
 ROLE-AWARE MIXES: ``"mixed:<honest_policy>:<byzantine_policy>"`` applies
 different policies by ROW, detecting Byzantine rows from their schema
@@ -65,8 +73,15 @@ _CURRENT_RE = re.compile(r"[Yy]our current value: (-?\d+)")
 # callers take the MAX match (the current round never trails history).
 _ROUND_RE = re.compile(r"round (\d+)", re.IGNORECASE)
 
+from bcg_tpu.scenarios.strategies import SCRIPTED_POLICIES
+
 HONEST_POLICIES = ("consensus", "schema_min", "stubborn", "median")
-BYZANTINE_POLICIES = ("disrupt", "oscillate", "mimic", "silent")
+# The strategy library's scripted mirrors (clique/adaptive/equivocate)
+# extend the hand-rolled adversary policies — one source of truth for
+# which byzantine policies exist (scenarios/strategies.py).
+BYZANTINE_POLICIES = (
+    "disrupt", "oscillate", "mimic", "silent"
+) + SCRIPTED_POLICIES
 
 
 def _schema_bounds(schema: Dict[str, Any]) -> Tuple[int, int]:
@@ -115,6 +130,7 @@ class FakeEngine(InferenceEngine):
                 f"{sorted(known)} or 'mixed:<honest>:<byzantine>'"
             )
         self.rng = random.Random(seed)
+        self.seed = seed  # clique policy derives its shared target from this
         self.policy = policy
         self.fail_first_n_calls = fail_first_n_calls
         self.call_count = 0  # counts individual JSON generations
@@ -261,7 +277,8 @@ class FakeEngine(InferenceEngine):
         )
 
     def run_megaround(self, plan, values, inbox, round_num,
-                      receiver_mask, is_byzantine, initial_values):
+                      receiver_mask, is_byzantine, initial_values,
+                      equivocators=None):
         """One fused round, hermetically: the stock decision policies
         answer the SAME rendered template prompts the device plan
         tokenizes, then exchange/tally/consensus run as the numpy mirror
@@ -287,6 +304,21 @@ class FakeEngine(InferenceEngine):
         mask = np.asarray(receiver_mask, dtype=bool)
         is_byz = np.asarray(is_byzantine, dtype=bool)
         initials = np.asarray(initial_values, dtype=np.int32)
+        equiv = (
+            np.zeros(n, dtype=bool) if equivocators is None
+            else np.asarray(equivocators, dtype=bool)
+        )
+
+        # Mega-round prompts are uniform integer-only schemas, so the
+        # mixed-policy schema-shape dispatch (_policy_for) is blind to
+        # roles here — dispatch per ROW on the is_byzantine array the
+        # fused entry already receives.
+        def row_policy(i: int) -> str:
+            if not self.policy.startswith("mixed:"):
+                return self.policy
+            _, honest_p, byz_p = self.policy.split(":")
+            return byz_p if is_byz[i] else honest_p
+
         t0 = time.perf_counter()
         with obs_tracer.span(
             "engine.megaround", args={"rows": n, "round": int(round_num)}
@@ -295,16 +327,34 @@ class FakeEngine(InferenceEngine):
             for i, (_system, user, schema) in enumerate(
                 template.decision_prompts(values, inbox, round_num)
             ):
-                out = self._decide(user, schema, self._policy_for(schema))
+                out = self._decide(user, schema, row_policy(i))
                 v = out.get("value")
                 proposed[i] = int(v) if isinstance(v, int) else -1
             new_values = np.where(proposed >= 0, proposed, values).astype(
                 np.int32
             )
-            # Masked exchange + tally: numpy twins of game_step's
-            # masked_exchange / tally_votes_dense / check_consensus_dense.
-            delivered = mask & (proposed >= 0)[None, :]
-            received = np.where(delivered, proposed[None, :], -1).astype(
+            # Per-receiver exchange + tally: numpy twins of game_step's
+            # equivocate_proposals / masked_exchange_matrix /
+            # tally_votes_dense / check_consensus_dense.  Column j of
+            # the proposal matrix is constant unless sender j
+            # equivocates, in which case each receiver row gets its own
+            # deterministic variant.
+            proposal_matrix = np.broadcast_to(
+                proposed[None, :], (n, n)
+            ).astype(np.int32)
+            if equiv.any():
+                from bcg_tpu.scenarios.strategies import equivocation_value
+
+                recv_idx = np.arange(n, dtype=np.int32)[:, None]
+                spread = equivocation_value(
+                    proposed[None, :], recv_idx, template.lo, template.hi
+                )
+                proposal_matrix = np.where(
+                    equiv[None, :] & (proposed >= 0)[None, :],
+                    spread, proposal_matrix,
+                ).astype(np.int32)
+            delivered = mask & (proposal_matrix >= 0)
+            received = np.where(delivered, proposal_matrix, -1).astype(
                 np.int32
             )
             deliveries = delivered.sum(axis=1).astype(np.int32)
@@ -312,11 +362,11 @@ class FakeEngine(InferenceEngine):
             # rendered vote prompt shows (own new value + delivered
             # peers; dash slots match no regex) — computed from the same
             # arrays the renderer reads, so prompt and vote agree.
-            policy = self._policy_for(template.vote_prompts(
-                new_values, received, round_num)[0][2])
             vote_raw = np.zeros(n, dtype=np.int32)
             for i in range(n):
-                if policy in ("disrupt", "oscillate"):
+                policy = row_policy(i)
+                if policy in ("disrupt", "oscillate", "clique",
+                              "adaptive", "equivocate"):
                     vote_raw[i] = 0
                 elif policy == "mimic":
                     vote_raw[i] = 1
@@ -459,6 +509,32 @@ class FakeEngine(InferenceEngine):
             value = max(lo, min(hi, value))
         elif policy == "silent":
             value = "abstain" if allows_abstain else lo
+        elif policy == "clique":
+            # Colluding clique: every byzantine row derives the SAME
+            # decoy value from the engine seed — the shared-target
+            # agreement oracle in the perf gate's scenarios arm.
+            from bcg_tpu.scenarios.strategies import clique_target
+
+            value = clique_target(self.seed, lo, hi)
+        elif policy == "adaptive":
+            # Margin-targeting adversary, scripted: the modular antipode
+            # of the observed mode — always the value farthest (mod
+            # span) from where honest agents are converging.
+            span = hi - lo + 1
+            if observed:
+                mode = Counter(observed).most_common(1)[0][0]
+                mode = max(lo, min(hi, mode))
+                value = lo + (mode - lo + span // 2) % span
+            else:
+                value = hi
+        elif policy == "equivocate":
+            # Deterministic per-round base; the exchange layer spreads
+            # it per-receiver (equivocation_value), so each receiver of
+            # this sender sees a different variant.
+            span = hi - lo + 1
+            rounds_seen = [int(x) for x in _ROUND_RE.findall(prompt)]
+            rnd = max(rounds_seen) if rounds_seen else 0
+            value = lo + rnd % span
         else:  # consensus
             if observed:
                 # most common, smallest on ties -> deterministic attractor
@@ -479,7 +555,8 @@ class FakeEngine(InferenceEngine):
 
     def _vote(self, prompt: str, schema: Dict, policy: str) -> Dict:
         options = _vote_options(schema)
-        if policy in ("disrupt", "oscillate") and "continue" in options:
+        if (policy in ("disrupt", "oscillate", "clique", "adaptive",
+                       "equivocate") and "continue" in options):
             return {"decision": "continue"}
         if policy == "silent" and "abstain" in options:
             return {"decision": "abstain"}

@@ -3555,7 +3555,8 @@ class JaxEngine(InferenceEngine):
         return got
 
     def run_megaround(self, plan, values, inbox, round_num: int,
-                      receiver_mask, is_byzantine, initial_values):
+                      receiver_mask, is_byzantine, initial_values,
+                      equivocators=None):
         """Run one WHOLE consensus round as a single jit entry and
         return its :class:`~bcg_tpu.engine.megaround.MegaroundResult`
         after ONE packed readback (``engine.hostsync.site.
@@ -3636,6 +3637,13 @@ class JaxEngine(InferenceEngine):
                     jnp.asarray(np.asarray(receiver_mask, bool)),
                     jnp.asarray(np.asarray(is_byzantine, bool)),
                     jnp.asarray(np.asarray(initial_values, np.int32)),
+                    # Equivocators enter TRACED (like is_byzantine): a
+                    # strategy switch can never retrace; all-False keeps
+                    # the exchange the plain broadcast matrix.
+                    jnp.asarray(
+                        np.zeros(n, bool) if equivocators is None
+                        else np.asarray(equivocators, bool)
+                    ),
                     guided_d, guided_v, sub,
                 )
             # THE round's one device->host sync: everything the host

@@ -497,7 +497,8 @@ def build_round_program(plan: MegaroundPlan, engine):
     from bcg_tpu.models.transformer import prefill_with_prefix
     from bcg_tpu.parallel.game_step import (
         check_consensus_dense,
-        masked_exchange,
+        equivocate_proposals,
+        masked_exchange_matrix,
         tally_votes_dense,
     )
 
@@ -506,6 +507,7 @@ def build_round_program(plan: MegaroundPlan, engine):
     impl = engine.attention_impl
     n = plan.n_agents
     lo = plan.template.lo
+    hi = plan.template.hi
     W = plan.template.val_width
     Wr = plan.template.round_width
     align = engine._kv_align
@@ -573,14 +575,24 @@ def build_round_program(plan: MegaroundPlan, engine):
     def program(params, base_d, valid_d, pcache_d, base_v, valid_v,
                 pcache_v, val_table, round_table, values, inbox,
                 round_idx, receiver_mask, is_byzantine, initial_values,
-                guided_d, guided_v, rng):
+                equivocators, guided_d, guided_v, rng):
         proposed, steps_d, rng = run_phase(
             "decide", params, base_d, valid_d, pcache_d, val_table,
             round_table, inbox, values, round_idx, guided_d, rng,
         )
         # Apply-proposals semantics: an abstainer keeps its old value.
         new_values = jnp.where(proposed >= 0, proposed, values)
-        received, deliveries = masked_exchange(proposed, receiver_mask)
+        # Per-receiver exchange: equivocating senders (a TRACED [n]
+        # bool — the plan's static key, and hence the compiled program,
+        # is strategy-agnostic) spread their proposal across receivers;
+        # with equivocators all-False the matrix is the plain broadcast
+        # and this reduces exactly to the PR 15 masked_exchange.
+        proposal_matrix = equivocate_proposals(
+            proposed, equivocators, lo, hi
+        )
+        received, deliveries = masked_exchange_matrix(
+            proposal_matrix, receiver_mask
+        )
         vote_raw, steps_v, rng = run_phase(
             "vote", params, base_v, valid_v, pcache_v, val_table,
             round_table, received, new_values, round_idx, guided_v, rng,

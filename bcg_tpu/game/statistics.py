@@ -88,6 +88,41 @@ def round_convergence(
     }
 
 
+def convergence_snapshot(game_state: Dict) -> str:
+    """One-line honest-convergence summary from the AGENT-VISIBLE game
+    state (``state.get_game_state()``) — the data feed of the adaptive
+    Byzantine strategy (scenarios/strategies.py), which targets the
+    consensus margin each round.
+
+    Uses only information an agent legitimately sees: current values of
+    agents whose ``initial_value`` is set (the parity-preserved
+    honest-identification leak documented on ``get_game_state``), the
+    emerging mode and how many agents hold it, and the distance to the
+    2/3 stop supermajority.
+    """
+    states = game_state.get("agent_states", {}) or {}
+    values = [
+        int(s["current_value"])
+        for s in states.values()
+        if s.get("initial_value") is not None
+        and s.get("current_value") is not None
+    ]
+    if not values:
+        return "no honest values observed yet"
+    counts: Dict[int, int] = {}
+    for v in values:
+        counts[v] = counts.get(v, 0) + 1
+    modal = min(v for v, c in counts.items() if c == max(counts.values()))
+    holders = counts[modal]
+    total = game_state.get("num_honest", len(values)) or len(values)
+    need = -(-2 * total // 3)  # ceil(2n/3)
+    return (
+        f"mode={modal} held by {holders}/{total} honest agents, "
+        f"spread={max(values) - min(values)}, "
+        f"margin to 2/3 supermajority: {max(0, need - holders)} agents"
+    )
+
+
 def compute_statistics(game) -> Dict:
     """Compute the full statistics dict for a (possibly finished) game.
 

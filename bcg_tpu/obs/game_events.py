@@ -26,7 +26,10 @@ line, every record carries ``ts`` + ``event`` + ``game`` + ``round``):
   every attempt failed).
 * ``deliveries`` — the topology-masked inbox of one agent for the
   round: ``agent``, ``senders`` (the proposals that actually arrived —
-  ring/grid/custom masks and lossy channels show up here).
+  ring/grid/custom masks and lossy channels show up here) and, when the
+  exchange path records them, ``values`` (what this receiver saw from
+  each sender — equivocation shows up as the same sender's value
+  differing across receivers' records).
 * ``vote`` — one agent's termination vote (``stop``/``continue``/
   ``abstain``).
 * ``round_end`` — the :func:`~bcg_tpu.game.statistics.round_record`
@@ -214,6 +217,8 @@ class GameEventRecorder:
             seed=cfg.game.seed,
             backend=cfg.engine.backend,
             model=cfg.engine.model_name,
+            strategy=cfg.game.byzantine_strategy,
+            awareness=cfg.game.byzantine_awareness,
         )
         self._publish()
 
@@ -272,14 +277,22 @@ class GameEventRecorder:
         )
 
     def deliveries(self, round_num: int, agent_id: str,
-                   senders: Sequence[str]) -> None:
+                   senders: Sequence[str],
+                   values: Optional[Sequence[int]] = None) -> None:
         """The topology-masked inbox one agent actually received this
         round (one record per receiver, not per message — O(agents)
-        lines per round, with the mask still fully reconstructable)."""
+        lines per round, with the mask still fully reconstructable).
+        ``values`` aligns with ``senders`` and records what THIS receiver
+        saw from each — under an equivocating adversary the same sender's
+        value differs across receivers, and this is the only record of
+        that split (the report's equivocation tabulation reads it)."""
         obs_counters.inc("game.deliveries", len(senders))
+        value_field = (
+            {"values": [int(v) for v in values]} if values is not None else {}
+        )
         self._emit(
             "deliveries", round=round_num, agent=agent_id,
-            senders=list(senders), count=len(senders),
+            senders=list(senders), count=len(senders), **value_field,
         )
 
     def vote(self, round_num: int, agent_id: str, is_byzantine: bool,

@@ -55,6 +55,62 @@ def masked_exchange(
     return received, deliveries
 
 
+def _masked_receive_matrix(
+    proposals: jax.Array, mask_rows: jax.Array
+) -> jax.Array:
+    """Per-receiver generalization of :func:`_masked_receive`:
+    ``proposals[i, j]`` is the value sender j addressed TO receiver i
+    (equivocating senders put different values in different rows; a
+    broadcasting sender's column is constant).  Row i of the result
+    holds that value iff ``mask_rows[i, j]`` AND the sender proposed
+    (``proposals[i, j] >= 0``), else -1."""
+    return jnp.where(mask_rows & (proposals >= 0), proposals, -1)
+
+
+def masked_exchange_matrix(
+    proposals: jax.Array,      # [n, n] int32, [i, j] = j's value for i
+    receiver_mask: jax.Array,  # [n, n] bool, mask[i, j] = i receives from j
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-receiver form of :func:`masked_exchange` — the mask·values
+    matmul generalized to an elementwise mask over a proposal MATRIX,
+    which is what equivocating adversaries need (ROADMAP item 2: one
+    sender, different values to different receivers).  When every
+    column of ``proposals`` is constant (nobody equivocates) this is
+    numerically identical to ``masked_exchange(proposals[0], mask)``
+    (tested), so the fused mega-round program routes ALL rounds through
+    it without changing the non-equivocating semantics."""
+    received = _masked_receive_matrix(proposals, receiver_mask)
+    delivered = receiver_mask & (proposals >= 0)
+    deliveries = delivered.astype(jnp.int32).sum(axis=1)
+    return received, deliveries
+
+
+def equivocate_proposals(
+    values: jax.Array,        # [n] int32 base proposals, -1 = abstain
+    equivocators: jax.Array,  # [n] bool, True = sender equivocates
+    lo: int,
+    hi: int,
+) -> jax.Array:
+    """Expand base proposals to the per-receiver proposal matrix:
+    column j is constant (the broadcast value) for honest/non-
+    equivocating senders, and the deterministic per-receiver spread
+    :func:`bcg_tpu.scenarios.strategies.equivocation_value` for
+    equivocating senders that proposed.  Abstaining senders stay -1
+    for every receiver.  Pure jnp, inlines into the fused round
+    program; the all-False case is exactly ``broadcast_to(values)``,
+    preserving the mega-round's greedy identity to the lockstep
+    oracle."""
+    from bcg_tpu.scenarios.strategies import equivocation_value
+
+    n = values.shape[0]
+    broadcast = jnp.broadcast_to(values[None, :], (n, n))
+    receiver_idx = jnp.arange(n, dtype=values.dtype)[:, None]
+    spread = equivocation_value(values[None, :], receiver_idx, lo, hi)
+    return jnp.where(
+        equivocators[None, :] & (values >= 0)[None, :], spread, broadcast
+    )
+
+
 def tally_votes_dense(votes: jax.Array) -> Dict[str, jax.Array]:
     """Dense form of :func:`tally_votes` (same vote conventions, same
     2n/3 rule from reference byzantine_consensus.py:373-398) — scalar
@@ -125,6 +181,43 @@ def exchange_values(
         out_specs=P(axis_name, None),
     )
     return f(values, neighbor_mask)
+
+
+def exchange_proposals(
+    proposals: jax.Array,      # [n, n] int32, [i, j] = j's value for i
+    receiver_mask: jax.Array,  # [n, n] bool (static topology)
+    mesh: Mesh,
+    axis_name: str = "dp",
+) -> jax.Array:
+    """Per-receiver (equivocation-capable) form of :func:`exchange_values`:
+    each sender owns a COLUMN of per-receiver values instead of one
+    scalar, so the gather runs over sender columns and each shard then
+    masks its own receiver rows.  With every column constant this
+    returns exactly what ``exchange_values(proposals[0], mask, mesh)``
+    returns (tested) — the SPMD twin of
+    :func:`masked_exchange_matrix`."""
+    n = proposals.shape[0]
+    rows_per = n // mesh.shape[axis_name]
+
+    def body(local_cols, mask_rows):
+        # local_cols [n, n/dp]: this shard's sender columns; gather the
+        # full matrix, then keep only this shard's receiver rows.
+        all_props = jax.lax.all_gather(
+            local_cols, axis_name, axis=1, tiled=True
+        )
+        idx = jax.lax.axis_index(axis_name)
+        local_rows = jax.lax.dynamic_slice_in_dim(
+            all_props, idx * rows_per, rows_per, axis=0
+        )
+        return _masked_receive_matrix(local_rows, mask_rows)
+
+    f = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(None, axis_name), P(axis_name, None)),
+        out_specs=P(axis_name, None),
+    )
+    return f(proposals, receiver_mask)
 
 
 def exchange_values_global(

@@ -404,6 +404,15 @@ _register(
     "0 = every failure is terminal, the pre-recovery behaviour).",
 )
 _register(
+    "BCG_TPU_SCENARIO", "str", None,
+    "Adversary scenario from the registry (bcg_tpu/scenarios): any "
+    "BCGSimulation construction overlays the named entry's strategy, "
+    "topology, channel, awareness, and agent split onto its config "
+    "(apply_scenario) — bench/api/CLI single runs get registry-true "
+    "adversary configs without new plumbing.  Unknown names fail "
+    "loudly; unset = the config as given.",
+)
+_register(
     "BCG_TPU_FAULT_RATE", "str", "",
     "Seeded response-corruption rate for FaultInjectingEngine "
     "(engine/fault.py), overriding EngineConfig.fault_rate / "

@@ -47,6 +47,7 @@ from bcg_tpu.obs import hostsync as obs_hostsync
 from bcg_tpu.obs import tracer as obs_tracer
 from bcg_tpu.runtime import envflags
 from bcg_tpu.runtime.logging import RunLogger
+from bcg_tpu.scenarios.strategies import equivocation_value
 from bcg_tpu.runtime.metrics import build_metrics_payload, save_json_results, save_metrics_csv
 from bcg_tpu.runtime.profiler import SimulationProfiler
 
@@ -99,6 +100,23 @@ class BCGSimulation:
         sweep_job_id: Optional[str] = None,
     ):
         self.config = config or BCGConfig()
+        # Scenario-registry overlay (BCG_TPU_SCENARIO): route any
+        # single-run construction through the named registry entry —
+        # strategy + topology + channel + awareness + agent split
+        # (scenarios/registry.apply_scenario).  The sweep tier expands
+        # scenarios at the spec layer instead, so it never sets this.
+        scenario_name = envflags.get_str("BCG_TPU_SCENARIO")
+        if scenario_name:
+            from bcg_tpu.scenarios import apply_scenario
+
+            self.config = apply_scenario(self.config, scenario_name)
+        # Resolved adversary strategy (scenarios/strategies.py), or None
+        # for the reference's single disrupt persona.
+        self._strategy = None
+        if self.config.game.byzantine_strategy:
+            from bcg_tpu.scenarios import get_strategy
+
+            self._strategy = get_strategy(self.config.game.byzantine_strategy)
         # Sweep-tier job identity (bcg_tpu/sweep): stamped into the
         # game-event stream's game_start/game_end records so sweep
         # resume and cross-host report merging can account games by
@@ -235,12 +253,32 @@ class BCGSimulation:
                 value_range=self.config.game.value_range,
                 byzantine_awareness=self.config.game.byzantine_awareness,
                 llm_config=self.config.llm,
+                strategy=self.config.game.byzantine_strategy,
+                strategy_seed=self.config.game.seed,
             )
             if game_agent.initial_value is not None:
                 agent.set_initial_value(game_agent.initial_value)
             self.network.register_agent(agent_id, agent, idx)
             self.agents[agent_id] = agent
         self.logger.log(f"All agents created! Total: {len(self.agents)}")
+
+    def _equivocation_active(self) -> bool:
+        """True when the resolved adversary strategy splits its proposal
+        per receiver (scenarios/strategies.py ``equivocates``)."""
+        return self._strategy is not None and self._strategy.equivocates
+
+    def _equivocators_np(self, ids):
+        """Per-agent equivocator flags aligned with ``ids`` (the sorted
+        agent order every exchange path uses): Byzantine rows when the
+        active strategy equivocates, else all-False — the identity that
+        keeps every exchange the plain broadcast matrix."""
+        import numpy as np
+
+        active = self._equivocation_active()
+        return np.asarray(
+            [active and self.game.agents[a].is_byzantine for a in ids],
+            dtype=bool,
+        )
 
     # --------------------------------------------------------------- validity
 
@@ -613,19 +651,51 @@ class BCGSimulation:
                 self._broadcast_receive_spmd()
         else:
             self.logger.log("[Broadcast Phase]")
+            lo, hi = self.config.game.value_range
+            equivocating = self._equivocation_active()
             with self.profiler.phase("broadcast"):
                 for aid, agent in self.agents.items():
                     proposed = self.game.agents[aid].proposed_value
                     if proposed is None:
                         self.logger.log(f"  {aid}: (abstaining, no broadcast)")
                         continue
+                    reasoning = (
+                        agent.last_reasoning
+                        or f"Proposing value: {int(proposed)}"
+                    )
+                    if equivocating and agent.is_byzantine:
+                        # Equivocation: one 'broadcast', receiver-addressed
+                        # variants — each neighbour gets the deterministic
+                        # per-receiver spread of the base proposal (the
+                        # same arithmetic the SPMD and fused exchanges
+                        # apply), under ONE timestamp so inbox ordering and
+                        # message accounting match the honest broadcast.
+                        sender_idx = self.network.agent_id_to_index[aid]
+                        decisions = {
+                            nbr: Decision(
+                                type=DecisionType.VALUE.value,
+                                value=int(
+                                    equivocation_value(
+                                        int(proposed), nbr, lo, hi
+                                    )
+                                ),
+                            )
+                            for nbr in self.topology.adjacency_list[sender_idx]
+                        }
+                        self.network.send_per_receiver(
+                            aid, round_num, phase, decisions, reasoning
+                        )
+                        self.logger.log(
+                            f"  {aid} (Byzantine): equivocates around value "
+                            f"{int(proposed)}"
+                        )
+                        continue
                     self.network.broadcast_message(
                         sender_id=aid,
                         round_num=round_num,
                         phase=phase,
                         decision=Decision(type=DecisionType.VALUE.value, value=int(proposed)),
-                        reasoning=agent.last_reasoning
-                        or f"Proposing value: {int(proposed)}",
+                        reasoning=reasoning,
                     )
                     tag = " (Byzantine)" if agent.is_byzantine else ""
                     self.logger.log(f"  {aid}{tag}: broadcasts value {int(proposed)}")
@@ -646,7 +716,8 @@ class BCGSimulation:
                     agent.my_value = self.game.agents[aid].proposed_value
                     if self._recorder:
                         self._recorder.deliveries(
-                            round_num, aid, [p[0] for p in proposals]
+                            round_num, aid, [p[0] for p in proposals],
+                            values=[int(p[1]) for p in proposals],
                         )
                     self.logger.log(f"  {aid}: received {len(proposals)} proposals, updated state")
 
@@ -777,6 +848,7 @@ class BCGSimulation:
 
         from bcg_tpu.comm.a2a_sim import truncate_reasoning
         from bcg_tpu.parallel.game_step import (
+            exchange_proposals,
             exchange_values,
             exchange_values_global,
         )
@@ -816,7 +888,35 @@ class BCGSimulation:
             ],
             dtype=np.int32,
         )
-        if self._spmd_multiprocess:
+        equiv = self._equivocators_np(ids)
+        if equiv.any():
+            # Equivocation in the ENCODED domain: with the lo-offset
+            # encoding, equivocation_value(base, i, lo, hi) becomes
+            # (enc + i) % span — receiver 0 still sees the base value
+            # and abstain columns (-1) never spread.
+            span = self.config.game.value_range[1] - lo + 1
+            matrix_np = np.where(
+                equiv[None, :] & (encoded_np[None, :] >= 0),
+                (encoded_np[None, :]
+                 + np.arange(n, dtype=np.int32)[:, None]) % span,
+                np.broadcast_to(encoded_np[None, :], (n, n)),
+            ).astype(np.int32)
+            if self._spmd_multiprocess:
+                # The cross-host collective carries one value per sender;
+                # a per-receiver matrix would need its own n x n shard
+                # layout.  The host-side masked receive is exact (and the
+                # dense matrix is tiny next to the decode batch).
+                received = np.where(
+                    self._spmd_mask_np & (matrix_np >= 0), matrix_np, -1
+                )
+            else:
+                received = np.asarray(
+                    exchange_proposals(
+                        jnp.asarray(matrix_np), self._spmd_mask,
+                        self._spmd_mesh,
+                    )
+                )
+        elif self._spmd_multiprocess:
             received = exchange_values_global(
                 encoded_np, self._spmd_mask_np, self._spmd_mesh
             )
@@ -845,7 +945,8 @@ class BCGSimulation:
             agent.my_value = self.game.agents[aid].proposed_value
             if self._recorder:
                 self._recorder.deliveries(
-                    self.game.current_round, aid, [p[0] for p in proposals]
+                    self.game.current_round, aid, [p[0] for p in proposals],
+                    values=[p[1] for p in proposals],
                 )
             self.logger.log(
                 f"  {aid}: received {len(proposals)} proposals (spmd), updated state"
@@ -986,6 +1087,7 @@ class BCGSimulation:
                 self._megaround_mask,
                 is_byz,
                 initials,
+                equivocators=self._equivocators_np(ids),
             )
 
         proposed = np.asarray(result.proposed)
@@ -1023,7 +1125,8 @@ class BCGSimulation:
             agent.my_value = self.game.agents[aid].proposed_value
             if self._recorder:
                 self._recorder.deliveries(
-                    round_num, aid, [p[0] for p in proposals]
+                    round_num, aid, [p[0] for p in proposals],
+                    values=[p[1] for p in proposals],
                 )
             self.logger.log(
                 f"  {aid}: received {len(proposals)} proposals (fused), "

@@ -41,6 +41,14 @@ import itertools
 import json
 from typing import Any, Dict, List, Mapping, Optional
 
+# Import-light like this module (no jax, no config): the scenario
+# registry feeds the ``scenario`` job key and the adversary-grid preset.
+from bcg_tpu.scenarios.registry import (
+    scenario_names,
+    scenario_params,
+    scripted_fake_policy,
+)
+
 # Every parameter a job may carry, with its default.  A closed set:
 # an unknown key in a spec is a hard error at EXPANSION time (a typo'd
 # axis silently defaulting would sweep the wrong grid and only show up
@@ -55,6 +63,11 @@ JOB_DEFAULTS: Dict[str, Any] = {
     "backend": "fake",
     "model": None,              # None = the backend's default model
     "fake_policy": None,        # engine/fake.py policy (fake backend)
+    "scenario": None,           # scenarios/registry.py entry: overlays
+                                # strategy/topology/channel/awareness/
+                                # agent split (explicit keys still win)
+    "strategy": None,           # scenarios/strategies.py adversary
+    "drop_prob": None,          # lossy channel (comm/lossy_sim.py)
     "spmd_exchange": False,     # broadcast/receive as one all_gather
     "max_model_len": None,      # EngineConfig override (jax backend)
     "data_parallel_size": None,
@@ -63,6 +76,18 @@ JOB_DEFAULTS: Dict[str, Any] = {
     "priority": 0,              # tenant priority class (scheduler)
     "weight": 1.0,              # tenant fair-share weight
 }
+
+
+def _effective_fake_policy(p: Mapping[str, Any]) -> Optional[Any]:
+    """The FakeEngine policy a job ACTUALLY runs: an explicit
+    ``fake_policy`` wins; otherwise a ``strategy`` on the fake backend
+    derives the role-aware scripted mirror (honest rows play consensus,
+    byzantine rows the strategy's policy).  Used by both ``to_config``
+    and ``engine_key`` — two jobs whose derived policies differ must
+    never share one engine."""
+    if p["fake_policy"] or p["backend"] != "fake" or not p["strategy"]:
+        return p["fake_policy"]
+    return scripted_fake_policy(str(p["strategy"]))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -91,8 +116,9 @@ class JobSpec:
         engine_kw: Dict[str, Any] = {"backend": p["backend"]}
         if p["model"]:
             engine_kw["model_name"] = resolve_model_name(str(p["model"]))
-        if p["fake_policy"]:
-            engine_kw["fake_policy"] = str(p["fake_policy"])
+        fp = _effective_fake_policy(p)
+        if fp:
+            engine_kw["fake_policy"] = str(fp)
         if p["max_model_len"]:
             engine_kw["max_model_len"] = int(p["max_model_len"])
         if p["data_parallel_size"]:
@@ -102,6 +128,13 @@ class JobSpec:
             llm_kw["max_tokens_decide"] = int(p["decide_tokens"])
         if p["vote_tokens"]:
             llm_kw["max_tokens_vote"] = int(p["vote_tokens"])
+        comm = base.communication
+        if p["drop_prob"]:
+            comm = dataclasses.replace(
+                comm,
+                protocol_type="lossy_sim",
+                drop_prob=float(p["drop_prob"]),
+            )
         return dataclasses.replace(
             base,
             game=dataclasses.replace(
@@ -110,6 +143,9 @@ class JobSpec:
                 num_byzantine=byz,
                 max_rounds=int(p["max_rounds"]),
                 byzantine_awareness=str(p["awareness"]),
+                byzantine_strategy=(
+                    str(p["strategy"]) if p["strategy"] else None
+                ),
                 seed=int(p["seed"]),
             ),
             network=dataclasses.replace(
@@ -117,6 +153,7 @@ class JobSpec:
                 topology_type=str(p["topology"]),
                 spmd_exchange=bool(p["spmd_exchange"]),
             ),
+            communication=comm,
             engine=dataclasses.replace(base.engine, **engine_kw),
             llm=dataclasses.replace(base.llm, **llm_kw),
             metrics=dataclasses.replace(
@@ -127,10 +164,13 @@ class JobSpec:
 
     def engine_key(self) -> tuple:
         """Jobs sharing this key can share one engine + scheduler (the
-        multi-tenant premise: one model boot serves the whole fleet)."""
+        multi-tenant premise: one model boot serves the whole fleet).
+        Keyed on the DERIVED fake policy, not the raw param — a
+        strategy job and an explicit-policy job that resolve to
+        different scripted behavior must boot separate engines."""
         p = self.params
         return (p["backend"], p["model"], p["max_model_len"],
-                p["data_parallel_size"], p["fake_policy"])
+                p["data_parallel_size"], _effective_fake_policy(p))
 
 
 def job_id_for(params: Mapping[str, Any]) -> str:
@@ -170,11 +210,22 @@ def expand(spec: Mapping[str, Any]) -> List[JobSpec]:
         if not isinstance(values, (list, tuple)) or not values:
             raise ValueError(f"axis {name!r} must be a non-empty list")
     names = sorted(axes)
+    # Scenario overlay precedence: JOB_DEFAULTS < registry entry <
+    # explicitly-specified base/axis keys — a preset can pin e.g.
+    # ``agents`` across every scenario without forking the registry.
+    explicit = (set(spec.get("base", {})) | set(axes)) - {"scenario"}
     jobs: List[JobSpec] = []
     seen: Dict[str, Mapping[str, Any]] = {}
     for combo in itertools.product(*(axes[n] for n in names)):
         params = dict(base)
         params.update(zip(names, combo))
+        if params.get("scenario"):
+            # Unknown names fail the whole expansion loudly (KeyError
+            # with the known list) — a typo'd scenario must never sweep
+            # the default grid under a wrong label.
+            for k, v in scenario_params(str(params["scenario"])).items():
+                if k not in explicit:
+                    params[k] = v
         jid = job_id_for(params)
         if jid in seen:
             raise ValueError(
@@ -226,17 +277,16 @@ PRESETS: Dict[str, Dict[str, Any]] = {
             "seed": list(range(9)),
         },
     },
-    # 12 jobs — adversary-strategy axis over the scripted policies
-    # (ROADMAP item 3's sweep hook: the registry plugs in here).
+    # 21 jobs — the scenario-registry axis (ROADMAP item 2's sweep
+    # surface): every named adversary experiment — strategy + topology
+    # + channel + awareness bundle from bcg_tpu/scenarios — x 3 seeds.
+    # Each job derives its role-aware FakeEngine policy from the
+    # strategy (see _effective_fake_policy), so the grid runs scripted
+    # mirrors hermetically and the same spec swaps to a real backend
+    # with one base key.
     "adversary-grid": {
-        "base": {"agents": 6, "byzantine": 2, "max_rounds": 6},
         "axes": {
-            "fake_policy": [
-                "mixed:consensus:disrupt",
-                "mixed:consensus:oscillate",
-                "mixed:consensus:mimic",
-                "mixed:consensus:silent",
-            ],
+            "scenario": list(scenario_names()),
             "seed": [0, 1, 2],
         },
     },

@@ -172,6 +172,10 @@ _CONFIG_OVERRIDE_ENVS = (
     # host loop with one jit entry per round — a different measured
     # execution shape, so a megaround run is never a default-config row.
     "BCG_TPU_MEGAROUND",
+    # A scenario overlay rewrites the game shape, adversary strategy,
+    # topology, and channel — a registry-driven run measures a
+    # different game than the default config.
+    "BCG_TPU_SCENARIO",
     # BCG_TPU_RUN_ID / BCG_TPU_METRICS_SHARD_MS stay out: a run label
     # and a flush period are provenance/measurement knobs, not a change
     # to the served configuration.  BCG_TPU_SWEEP_DIR stays out for the

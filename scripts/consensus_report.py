@@ -6,7 +6,10 @@
 Aggregates one or many game-event streams (each written by
 ``bcg_tpu.obs.game_events``, first line = run manifest) into the sweep
 tables the paper's evaluation methodology needs: convergence rate,
-rounds-to-consensus, and Byzantine influence, grouped by configuration.
+rounds-to-consensus, and Byzantine influence, grouped by configuration
+— plus, when games carry a ``strategy`` field (scenario-registry
+runs), a per-strategy table with an equivocation tabulation (rows
+where one sender's delivered values differ across receivers).
 Merging many files is mechanical BECAUSE of the manifest header — the
 group key is (agents split, topology, model, flag overrides), all read
 from ``manifest`` + ``game_start`` records, never from filenames.  The
@@ -60,7 +63,8 @@ class GameAgg:
 
     __slots__ = ("config_key", "run_id", "rank", "started", "ended",
                  "converged", "rounds_to_consensus", "influence",
-                 "round_ms", "decisions", "fallbacks", "invalids", "job")
+                 "round_ms", "decisions", "fallbacks", "invalids", "job",
+                 "strategy", "equivocation_rows")
 
     def __init__(self, config_key: str, run_id: str = "-",
                  rank: str = "-"):
@@ -85,6 +89,13 @@ class GameAgg:
         self.decisions = 0
         self.fallbacks = 0
         self.invalids = 0
+        # Adversary strategy stamped in game_start (scenario registry);
+        # None for streams written before the strategy field existed.
+        self.strategy: Optional[str] = None
+        # (round, sender) pairs whose delivered values DIFFER across
+        # receivers — the equivocation signature, tabulated from the
+        # per-receiver ``values`` field of deliveries records.
+        self.equivocation_rows = 0
 
 
 def _config_key(manifest: Dict, start: Optional[Dict]) -> str:
@@ -99,6 +110,12 @@ def _config_key(manifest: Dict, start: Optional[Dict]) -> str:
             parts.append(str(start["topology"]))
         if start.get("model"):
             parts.append(str(start["model"]))
+        if start.get("strategy"):
+            parts.append(f"strategy={start['strategy']}")
+        # Awareness only when it deviates from the default — keeps
+        # pre-strategy rows and may_exist rows keyed identically.
+        if start.get("awareness") and start["awareness"] != "may_exist":
+            parts.append(f"awareness={start['awareness']}")
     elif manifest.get("preset"):
         parts.append(str(manifest["preset"]))
     flags = manifest.get("flags") or {}
@@ -127,6 +144,11 @@ def parse_file(path: str, problems: List[str]) -> List[GameAgg]:
     manifest: Dict = {}
     games: Dict[str, GameAgg] = {}
     starts: Dict[str, Dict] = {}
+    # game -> (round, sender) -> delivered-value set, from deliveries
+    # records that carry per-receiver values; a set with >1 member is
+    # one equivocation row (same sender, same round, different values
+    # at different receivers).
+    equiv_seen: Dict[str, Dict[Tuple[int, str], set]] = {}
     bad_lines = 0
     with open(path) as f:
         for lineno, line in enumerate(f, 1):
@@ -161,6 +183,8 @@ def parse_file(path: str, problems: List[str]) -> List[GameAgg]:
                 agg.started = True
                 if rec.get("job"):
                     agg.job = str(rec["job"])
+                if rec.get("strategy"):
+                    agg.strategy = str(rec["strategy"])
                 games[gid] = agg
                 continue
             agg = games.get(gid)
@@ -184,6 +208,12 @@ def parse_file(path: str, problems: List[str]) -> List[GameAgg]:
                     agg.fallbacks += 1
                 elif outcome == "invalid":
                     agg.invalids += 1
+            elif event == "deliveries" and rec.get("values") is not None:
+                per = equiv_seen.setdefault(gid, {})
+                rnd = rec.get("round")
+                for sender, val in zip(rec.get("senders") or (),
+                                       rec["values"]):
+                    per.setdefault((rnd, sender), set()).add(val)
             elif event == "game_end":
                 agg.ended = True
                 agg.converged = bool(rec.get("converged"))
@@ -196,6 +226,12 @@ def parse_file(path: str, problems: List[str]) -> List[GameAgg]:
                 )
     if bad_lines:
         problems.append(f"{path}: skipped {bad_lines} unparseable line(s)")
+    for gid, per in equiv_seen.items():
+        agg = games.get(gid)
+        if agg is not None:
+            agg.equivocation_rows = sum(
+                1 for vals in per.values() if len(vals) > 1
+            )
     return list(games.values())
 
 
@@ -296,6 +332,47 @@ def render_report(games: List[GameAgg], problems: List[str]) -> str:
     return "\n".join(lines)
 
 
+def render_strategies(games: List[GameAgg]) -> str:
+    """Per-strategy table: the adversary-library readout.  Groups by
+    the strategy stamped in game_start (scenario-registry runs), so a
+    registry sweep reads as one row per Byzantine strategy regardless
+    of topology/channel/seed spread.  ``equiv_rows`` counts (round,
+    sender) pairs whose delivered values differed across receivers —
+    nonzero ONLY under an equivocating adversary, and the acceptance
+    signal the perf gate's scenarios arm floors."""
+    by_strat: Dict[str, List[GameAgg]] = defaultdict(list)
+    for g in games:
+        if g.strategy:
+            by_strat[g.strategy].append(g)
+    if not by_strat:
+        return ""
+    lines = ["== outcomes by adversary strategy =="]
+    lines.append(
+        f"{'strategy':<12}  {'games':>5}  {'done':>4}  {'conv':>4}  "
+        f"{'rate':>6}  {'rounds(med/mean)':>16}  {'byz_infl':>8}  "
+        f"{'equiv_rows':>10}"
+    )
+    for strat in sorted(by_strat):
+        group = by_strat[strat]
+        done = [g for g in group if g.ended]
+        conv = [g for g in done if g.converged]
+        rate = (100.0 * len(conv) / len(done)) if done else 0.0
+        to_consensus = sorted(
+            g.rounds_to_consensus for g in conv
+            if g.rounds_to_consensus is not None
+        )
+        med = _median(to_consensus)
+        mean = (sum(to_consensus) / len(to_consensus)) if to_consensus else 0.0
+        infl = sum(g.influence for g in done)
+        equiv = sum(g.equivocation_rows for g in group)
+        lines.append(
+            f"{strat:<12}  {len(group):>5}  {len(done):>4}  "
+            f"{len(conv):>4}  {rate:>5.1f}%  {med:>7.1f}/{mean:<8.1f}  "
+            f"{infl:>8}  {equiv:>10}"
+        )
+    return "\n".join(lines)
+
+
 def render_rounds(games: List[GameAgg]) -> str:
     """--rounds: distribution of rounds-to-consensus over converged
     games (sweep plots read this table)."""
@@ -341,6 +418,10 @@ def main(argv=None) -> int:
         return 1
     problems.extend(duplicate_job_problems(games))
     print(render_report(games, problems))
+    strategies = render_strategies(games)
+    if strategies:
+        print()
+        print(strategies)
     if args.rounds:
         print()
         print(render_rounds(games))

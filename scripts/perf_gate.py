@@ -53,10 +53,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 SCENARIOS = ("serve", "engine", "paged", "sampler", "int4", "consensus",
              "fleet", "hostsync", "megaround", "compile", "sweep", "chaos",
-             "hlo")
+             "scenarios", "hlo")
 REGRESSIONS = ("none", "spec-off", "fail-rows", "events-off",
                "straggler-off", "hostsync-off", "compile-off",
-               "fairness-off", "chaos-off")
+               "fairness-off", "chaos-off", "scenarios-off")
 
 DECISION = {
     "type": "object",
@@ -1472,6 +1472,125 @@ def run_chaos_scenario(inject: str = "none") -> Dict[str, float]:
     }
 
 
+def run_scenarios_scenario(inject: str = "none") -> Dict[str, float]:
+    """Adversary library + scenario registry gates (bcg_tpu/scenarios):
+    a 4-scenario FakeEngine sweep (adaptive-margin, baseline-disrupt,
+    clique-collusion, equivocation-split at seed 0) through the REAL
+    sweep controller — each job derives its role-aware scripted policy
+    from the registry (no injected engine) — consumed by the REAL
+    report parser (scripts/consensus_report.py):
+
+    * ``influence_<strategy>`` — per-strategy byzantine_influence
+      floors (non-vacuity: every scripted adversary must actually move
+      honest values, not just exist in config);
+    * ``equivocation_divergence_rows`` — (round, sender) pairs whose
+      delivered values differ across receivers, floored >= 1 under the
+      equivocating strategy; ``offstrategy_divergence_rows`` pinned 0
+      EXACT (only the equivocator may split values per receiver);
+    * ``clique_shared_target_agreement`` — fraction of byzantine
+      decisions in the clique games equal to the seed-derived
+      ``clique_target`` (1.0 exact: collusion is scripted arithmetic);
+    * ``strategies_covered`` — distinct strategies stamped in
+      game_start (4 exact); ``error_rows`` — invalid decisions (0).
+
+    ``scenarios-off`` injection runs the same grid shape with the
+    registry unplugged (plain default jobs, no scenario key): the
+    influence floors, coverage, divergence, and clique agreement must
+    all FAIL loudly rather than pass vacuously."""
+    import glob as _glob
+    import importlib.util
+    import tempfile
+
+    from bcg_tpu.scenarios.strategies import clique_target
+    from bcg_tpu.sweep.controller import run_sweep
+
+    scen = ["adaptive-margin", "baseline-disrupt", "clique-collusion",
+            "equivocation-split"]
+    if inject == "scenarios-off":
+        spec = {"name": "scenarios-gate",
+                "base": {"agents": 6, "byzantine": 2, "max_rounds": 6},
+                "axes": {"seed": [0, 1, 2, 3]}}
+    else:
+        spec = {"name": "scenarios-gate", "axes": {"scenario": scen}}
+    out_dir = os.path.join(
+        tempfile.mkdtemp(prefix="bcg-scen-gate-"), "sweep"
+    )
+    run_sweep(spec, out_dir, max_concurrent=1, max_job_retries=2)
+
+    cr_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "consensus_report.py"
+    )
+    cr_spec = importlib.util.spec_from_file_location(
+        "consensus_report", cr_path
+    )
+    cr = importlib.util.module_from_spec(cr_spec)
+    cr_spec.loader.exec_module(cr)
+    games: List = []
+    problems: List[str] = []
+    event_files = sorted(
+        _glob.glob(os.path.join(out_dir, "events-*.jsonl"))
+    )
+    for path in event_files:
+        games.extend(cr.parse_file(path, problems))
+
+    influence: Dict[str, int] = {}
+    equiv_rows = off_rows = invalids = 0
+    strategies = set()
+    for g in games:
+        if not g.ended:
+            continue
+        invalids += g.invalids
+        if g.strategy:
+            strategies.add(g.strategy)
+            influence[g.strategy] = (
+                influence.get(g.strategy, 0) + g.influence
+            )
+            if g.strategy == "equivocate":
+                equiv_rows += g.equivocation_rows
+            else:
+                off_rows += g.equivocation_rows
+
+    # Clique oracle: collusion is pure arithmetic, so EVERY byzantine
+    # decision in the clique games must equal the seed-derived target —
+    # read straight from the decision events, not the aggregates.
+    clique_hits = clique_total = 0
+    for path in event_files:
+        meta: Dict[str, Dict] = {}
+        with open(path) as f:
+            for line in f:
+                rec = json.loads(line)
+                if rec.get("event") == "game_start":
+                    meta[rec["game"]] = rec
+                elif (rec.get("event") == "decision"
+                      and rec.get("role") == "byzantine"
+                      and rec.get("value") is not None):
+                    start = meta.get(rec.get("game"))
+                    if start and start.get("strategy") == "clique":
+                        lo_, hi_ = start["value_range"]
+                        clique_total += 1
+                        clique_hits += int(
+                            rec["value"]
+                            == clique_target(start.get("seed"), lo_, hi_)
+                        )
+    return {
+        "scenarios.influence_disrupt": float(influence.get("disrupt", 0)),
+        "scenarios.influence_clique": float(influence.get("clique", 0)),
+        "scenarios.influence_adaptive": float(
+            influence.get("adaptive", 0)
+        ),
+        "scenarios.influence_equivocate": float(
+            influence.get("equivocate", 0)
+        ),
+        "scenarios.equivocation_divergence_rows": float(equiv_rows),
+        "scenarios.offstrategy_divergence_rows": float(off_rows),
+        "scenarios.clique_shared_target_agreement": (
+            clique_hits / clique_total if clique_total else 0.0
+        ),
+        "scenarios.strategies_covered": float(len(strategies)),
+        "scenarios.error_rows": float(invalids),
+    }
+
+
 def run_hlo_scenario(inject: str = "none") -> Dict[str, float]:
     """Kernel-census drift findings (scripts/hlo_census.py) as a gated
     metric — 0 findings = the lowered programs still match
@@ -1517,6 +1636,7 @@ _RUNNERS = {
     "compile": run_compile_scenario,
     "sweep": run_sweep_scenario,
     "chaos": run_chaos_scenario,
+    "scenarios": run_scenarios_scenario,
     "hlo": run_hlo_scenario,
 }
 

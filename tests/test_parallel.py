@@ -516,6 +516,61 @@ class TestSPMDGameStep:
             np.asarray(deliveries), (spmd >= 0).sum(axis=1)
         )
 
+    @pytest.mark.parametrize("topo_name", ["ring", "grid", "full"])
+    def test_matrix_exchange_matches_spmd_form_n64(self, topo_name):
+        """ISSUE-18 satellite: the equivocation-capable proposal-MATRIX
+        exchange must agree between its dense mega-round form
+        (masked_exchange_matrix) and its shard_map collective form
+        (exchange_proposals) at the 64-agent scale — same equivocated
+        matrix into both, per-cell received values identical; and with
+        nobody equivocating both reduce to the scalar-broadcast
+        exchange (the identity that keeps non-adversary rounds
+        byte-stable on the fused path)."""
+        from bcg_tpu.parallel.game_step import (
+            equivocate_proposals,
+            exchange_proposals,
+            masked_exchange_matrix,
+        )
+
+        n, lo, hi = 64, 0, 50
+        topo = {
+            "ring": lambda: NetworkTopology.ring(n),
+            "grid": lambda: NetworkTopology.grid(8, 8),
+            "full": lambda: NetworkTopology.fully_connected(n),
+        }[topo_name]()
+        mask = jnp.asarray(topo.receiver_mask())
+        rng = np.random.default_rng(18)
+        values_np = rng.integers(lo, hi + 1, size=n).astype(np.int32)
+        values_np[rng.choice(n, size=7, replace=False)] = -1  # abstainers
+        equiv_np = np.zeros(n, dtype=bool)
+        equiv_np[rng.choice(n, size=9, replace=False)] = True
+        matrix = equivocate_proposals(
+            jnp.asarray(values_np), jnp.asarray(equiv_np), lo, hi
+        )
+        dense, _ = masked_exchange_matrix(matrix, mask)
+        spmd = np.asarray(exchange_proposals(matrix, mask, self.mesh))
+        np.testing.assert_array_equal(np.asarray(dense), spmd)
+        # An equivocating non-abstaining sender delivers receiver-
+        # dependent values to its delivered cells; receiver 0's cell
+        # (when delivered) carries the base value.
+        mask_np = np.asarray(mask)
+        for j in np.flatnonzero(equiv_np & (values_np >= 0)):
+            delivered = spmd[mask_np[:, j], j]
+            if delivered.size > 1:
+                assert len(set(delivered.tolist())) > 1, j
+            if mask_np[0, j]:
+                assert spmd[0, j] == values_np[j]
+        # Nobody equivocating: matrix paths reduce to the scalar form.
+        plain = equivocate_proposals(
+            jnp.asarray(values_np), jnp.zeros(n, dtype=bool), lo, hi
+        )
+        scalar = np.asarray(exchange_values(
+            jnp.asarray(values_np), mask, self.mesh
+        ))
+        np.testing.assert_array_equal(
+            np.asarray(exchange_proposals(plain, mask, self.mesh)), scalar
+        )
+
     def test_exchange_values_global_matches_sharded_form(self):
         """The sweep tier's cooperative (dp-across-hosts) exchange
         (exchange_values_global: host inputs -> global placement ->

@@ -49,6 +49,7 @@ NAMESPACE_OWNERS = {
     "compile": "tests/test_compile_obs.py",
     "sweep": "tests/test_sweep.py",
     "chaos": "tests/test_resilience.py",
+    "scenarios": "tests/test_scenarios.py",
 }
 # Namespaces owned elsewhere, as the prefix tuple the measurement-match
 # tests skip (derived, not hand-maintained).

@@ -1,0 +1,481 @@
+"""Adversary library + scenario registry (bcg_tpu/scenarios/).
+
+Owns the perf-gate ``scenarios.*`` namespace (tests/test_perf_gate.py
+NAMESPACE_OWNERS): the gate-backed class at the bottom pins the
+scenario green at HEAD, the resurface contract (removing a baseline
+entry fails as "no entry"), and the scenarios-off injection failing
+loudly instead of vacuously green.
+
+Above it, the subsystem's own contracts:
+
+* strategy library — the two pure value formulas (equivocation spread,
+  clique target), the catalog/lookup surface, and the prompt-block
+  substitution the LLM path grafts in;
+* scenario registry — param overlays for the sweep layer, the
+  role-aware scripted-policy mirror, apply_scenario onto a BCGConfig;
+* sweep integration — adversary-grid expansion, overlay precedence
+  (explicit keys beat the registry), derived-policy engine keying;
+* end-to-end — an equivocation game's ``deliveries`` events carry
+  per-receiver divergent values; a plain strategy's do not.
+"""
+
+import dataclasses
+import importlib.util
+import json
+import os
+
+import pytest
+
+from bcg_tpu.config import (
+    BCGConfig,
+    EngineConfig,
+    GameConfig,
+    MetricsConfig,
+    NetworkConfig,
+)
+from bcg_tpu.engine.fake import BYZANTINE_POLICIES
+from bcg_tpu.obs import game_events
+from bcg_tpu.runtime.orchestrator import BCGSimulation
+from bcg_tpu.scenarios.registry import (
+    SCENARIOS,
+    apply_scenario,
+    get_scenario,
+    scenario_names,
+    scenario_params,
+    scripted_fake_policy,
+)
+from bcg_tpu.scenarios.strategies import (
+    STRATEGIES,
+    clique_target,
+    equivocation_value,
+    get_strategy,
+    persona_block,
+    strategy_names,
+    task_block,
+)
+from bcg_tpu.sweep.spec import JOB_DEFAULTS, expand, load_spec
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GATE = os.path.join(REPO, "scripts", "perf_gate.py")
+
+
+# ------------------------------------------------------------- strategies
+
+
+class TestStrategyLibrary:
+    def test_equivocation_value_receiver_zero_is_identity(self):
+        for base in (0, 7, 50):
+            assert equivocation_value(base, 0, 0, 50) == base
+
+    def test_equivocation_value_spreads_within_range(self):
+        lo, hi = 10, 20
+        seen = set()
+        for receiver in range(8):
+            v = equivocation_value(14, receiver, lo, hi)
+            assert lo <= v <= hi
+            seen.add(v)
+        # 8 receivers over an 11-value span: all distinct.
+        assert len(seen) == 8
+
+    def test_equivocation_value_wraps_modularly(self):
+        # base at the top of the range wraps to the bottom, never out.
+        assert equivocation_value(50, 1, 0, 50) == 0
+
+    def test_clique_target_is_deterministic_and_in_range(self):
+        lo, hi = 0, 50
+        for seed in (None, 0, 1, 2, 99):
+            t = clique_target(seed, lo, hi)
+            assert lo <= t <= hi
+            assert t == clique_target(seed, lo, hi)
+        # None and 0 share the pre-agreed target (seed or 0).
+        assert clique_target(None, lo, hi) == clique_target(0, lo, hi)
+
+    def test_clique_target_varies_with_seed(self):
+        targets = {clique_target(s, 0, 50) for s in range(8)}
+        assert len(targets) > 1
+
+    def test_catalog_and_lookup(self):
+        assert set(strategy_names()) == set(STRATEGIES)
+        assert get_strategy("disrupt").fake_policy == "disrupt"
+        with pytest.raises(KeyError, match="unknown byzantine strategy"):
+            get_strategy("nope")
+
+    def test_every_fake_policy_is_engine_valid(self):
+        """A strategy's scripted mirror must name a real FakeEngine
+        byzantine policy — a typo here would otherwise only fail at
+        engine boot inside a sweep job."""
+        for s in STRATEGIES.values():
+            assert s.fake_policy in BYZANTINE_POLICIES, s.name
+
+    def test_exactly_the_structured_strategies_flag_their_layer(self):
+        assert get_strategy("equivocate").equivocates
+        assert get_strategy("clique").clique
+        for name in ("disrupt", "oscillate", "mimic", "silent"):
+            s = get_strategy(name)
+            assert not s.equivocates and not s.clique, name
+
+    def test_persona_block_resolves_clique_target(self):
+        s = get_strategy("clique")
+        block = persona_block(s, 0, 50, seed=0)
+        assert str(clique_target(0, 0, 50)) in block
+        assert "{target}" not in block
+        assert "STRATEGY DIRECTIVE (clique)" in block
+
+    def test_default_strategy_keeps_reference_persona(self):
+        assert persona_block(get_strategy("disrupt"), 0, 50, 0) == ""
+        assert task_block(get_strategy("disrupt"), 0, 50, 0) is None
+
+    def test_task_block_substitutes_snapshot(self):
+        s = get_strategy("adaptive")
+        text = task_block(s, 0, 50, 0, snapshot="spread=12 mode=30")
+        assert "spread=12 mode=30" in text
+        assert "{snapshot}" not in text
+        assert "{snapshot}" not in task_block(s, 0, 50, 0)
+
+
+# --------------------------------------------------------------- registry
+
+
+class TestRegistry:
+    def test_catalog_and_lookup(self):
+        assert set(scenario_names()) == set(SCENARIOS)
+        with pytest.raises(KeyError, match="unknown scenario"):
+            get_scenario("nope")
+
+    def test_every_scenario_names_a_real_strategy(self):
+        for s in SCENARIOS.values():
+            assert s.strategy in STRATEGIES, s.name
+
+    def test_scenario_params_overlay_shape(self):
+        p = scenario_params("silent-ring")
+        assert p["strategy"] == "silent"
+        assert p["topology"] == "ring"
+        # Every overlay key is a sweep job parameter.
+        assert set(p) <= set(JOB_DEFAULTS)
+        # Channel key only present when the scenario sets it.
+        assert "drop_prob" not in p
+        assert scenario_params("oscillate-lossy")["drop_prob"] == 0.2
+
+    def test_awareness_variant_rides_the_overlay(self):
+        assert scenario_params("mimic-unaware")["awareness"] == "none_exist"
+
+    def test_scripted_policy_is_role_aware(self):
+        assert scripted_fake_policy("clique") == "mixed:consensus:clique"
+        with pytest.raises(KeyError):
+            scripted_fake_policy("nope")
+
+    def test_apply_scenario_onto_fake_config(self):
+        base = dataclasses.replace(
+            BCGConfig(), engine=EngineConfig(backend="fake"),
+        )
+        cfg = apply_scenario(base, "oscillate-lossy")
+        assert cfg.game.byzantine_strategy == "oscillate"
+        assert cfg.game.num_byzantine == 2
+        assert cfg.engine.fake_policy == "mixed:consensus:oscillate"
+        assert cfg.communication.protocol_type == "lossy_sim"
+        assert cfg.communication.drop_prob == 0.2
+
+    def test_apply_scenario_leaves_ideal_channel_alone(self):
+        cfg = apply_scenario(BCGConfig(), "baseline-disrupt")
+        assert cfg.communication.protocol_type != "lossy_sim"
+        assert cfg.network.topology_type == "fully_connected"
+
+
+# ------------------------------------------------------- sweep integration
+
+
+class TestSweepIntegration:
+    def test_adversary_grid_expands_every_scenario(self):
+        jobs = expand(load_spec("adversary-grid"))
+        assert len(jobs) == len(SCENARIOS) * 3
+        strategies = {j.params["strategy"] for j in jobs}
+        assert strategies == set(STRATEGIES)
+
+    def test_overlay_fills_registry_values(self):
+        jobs = expand({"axes": {"scenario": ["silent-ring"]}})
+        (job,) = jobs
+        assert job.params["topology"] == "ring"
+        assert job.params["strategy"] == "silent"
+        assert job.params["agents"] == 6
+
+    def test_explicit_keys_beat_the_overlay(self):
+        jobs = expand({
+            "base": {"agents": 8},
+            "axes": {"scenario": ["silent-ring"]},
+        })
+        (job,) = jobs
+        assert job.params["agents"] == 8        # pinned
+        assert job.params["topology"] == "ring"  # still overlaid
+
+    def test_unknown_scenario_fails_expansion_loudly(self):
+        with pytest.raises(KeyError, match="unknown scenario"):
+            expand({"axes": {"scenario": ["typo-grid"]}})
+
+    def test_strategy_jobs_derive_distinct_engine_keys(self):
+        """Two jobs whose strategies script different FakeEngine
+        policies must never share one engine."""
+        jobs = expand({
+            "axes": {"scenario": ["clique-collusion", "silent-ring"]},
+        })
+        keys = {j.engine_key() for j in jobs}
+        assert len(keys) == 2
+        for job in jobs:
+            assert job.engine_key()[-1] == scripted_fake_policy(
+                str(job.params["strategy"])
+            )
+
+    def test_explicit_fake_policy_wins_over_strategy(self):
+        jobs = expand({
+            "base": {"fake_policy": "mixed:consensus:disrupt"},
+            "axes": {"scenario": ["clique-collusion"]},
+        })
+        (job,) = jobs
+        cfg = job.to_config()
+        assert cfg.engine.fake_policy == "mixed:consensus:disrupt"
+        assert job.engine_key()[-1] == "mixed:consensus:disrupt"
+
+    def test_strategy_reaches_the_game_config(self):
+        jobs = expand({"axes": {"scenario": ["adaptive-margin"]}})
+        cfg = jobs[0].to_config()
+        assert cfg.game.byzantine_strategy == "adaptive"
+        assert cfg.engine.fake_policy == "mixed:consensus:adaptive"
+
+    def test_lossy_scenario_configures_the_channel(self):
+        jobs = expand({"axes": {"scenario": ["oscillate-lossy"]}})
+        cfg = jobs[0].to_config()
+        assert cfg.communication.protocol_type == "lossy_sim"
+        assert cfg.communication.drop_prob == 0.2
+
+
+# ------------------------------------------------------------- end-to-end
+
+
+def _scenario_config(name, seed=0):
+    base = dataclasses.replace(
+        BCGConfig(),
+        game=GameConfig(seed=seed),
+        network=NetworkConfig(),
+        engine=EngineConfig(backend="fake"),
+        metrics=MetricsConfig(save_results=False),
+        verbose=False,
+    )
+    return apply_scenario(base, name)
+
+
+@pytest.fixture
+def events_enabled(tmp_path, monkeypatch):
+    path = tmp_path / "game_events.jsonl"
+    monkeypatch.setenv("BCG_TPU_GAME_EVENTS", str(path))
+    game_events.reset_sink()
+    game_events._reset_aggregate()
+    yield path
+    game_events.reset_sink()
+    game_events._reset_aggregate()
+
+
+def _divergent_rows(path):
+    """(round, sender) pairs whose receivers logged different values —
+    the same tabulation consensus_report.py runs over deliveries."""
+    per = {}
+    strategy = None
+    for line in path.read_text().splitlines():
+        rec = json.loads(line)
+        if rec.get("event") == "game_start":
+            strategy = rec.get("strategy")
+        if rec.get("event") != "deliveries" or rec.get("values") is None:
+            continue
+        for sender, value in zip(rec["senders"], rec["values"]):
+            per.setdefault((rec["round"], sender), set()).add(value)
+    return strategy, sum(1 for vals in per.values() if len(vals) > 1)
+
+
+def _run_scenario_game(name, path):
+    sim = BCGSimulation(config=_scenario_config(name))
+    try:
+        sim.run()
+    finally:
+        sim.close()
+    game_events.reset_sink()  # drain to disk
+    return [json.loads(l) for l in path.read_text().splitlines()]
+
+
+def _byzantine_decisions(records):
+    return [
+        (r["round"], r["agent"], r["value"]) for r in records
+        if r["event"] == "decision" and r["role"] == "byzantine"
+    ]
+
+
+class TestScenarioEndToEnd:
+    def test_equivocation_game_emits_divergent_deliveries(
+        self, events_enabled
+    ):
+        _run_scenario_game("equivocation-split", events_enabled)
+        strategy, divergent = _divergent_rows(events_enabled)
+        assert strategy == "equivocate"
+        assert divergent >= 1
+
+    def test_plain_strategy_game_never_diverges(self, events_enabled):
+        _run_scenario_game("clique-collusion", events_enabled)
+        strategy, divergent = _divergent_rows(events_enabled)
+        assert strategy == "clique"
+        assert divergent == 0
+
+    def test_clique_mirror_holds_the_shared_target(self, events_enabled):
+        """Every byzantine decision of the scripted clique mirror is
+        the seed-derived shared target — no runtime coordination, both
+        colluders land on it independently."""
+        records = _run_scenario_game("clique-collusion", events_enabled)
+        lo, hi = next(
+            r for r in records if r["event"] == "game_start"
+        )["value_range"]
+        decisions = _byzantine_decisions(records)
+        assert decisions
+        target = clique_target(0, lo, hi)
+        assert all(value == target for _, _, value in decisions), decisions
+
+    def test_adaptive_mirror_targets_the_antipode(self, events_enabled):
+        """The scripted adaptive mirror is an exact oracle: each round
+        it proposes the modular antipode of the mode of the values it
+        RECEIVED last round (smallest-on-ties), reconstructed here from
+        the per-receiver deliveries telemetry."""
+        from collections import Counter
+
+        records = _run_scenario_game("adaptive-margin", events_enabled)
+        lo, hi = next(
+            r for r in records if r["event"] == "game_start"
+        )["value_range"]
+        span = hi - lo + 1
+        received = {
+            (r["round"], r["agent"]):
+                [v for v in r.get("values", []) if v is not None and v >= 0]
+            for r in records if r["event"] == "deliveries"
+        }
+        decisions = _byzantine_decisions(records)
+        assert decisions
+        for rnd, agent, value in decisions:
+            observed = received.get((rnd - 1, agent), [])
+            if observed:
+                counts = Counter(observed)
+                best = max(counts.values())
+                mode = min(v for v, c in counts.items() if c == best)
+                expected = lo + (mode - lo + span // 2) % span
+            else:
+                expected = hi
+            assert value == expected, (rnd, agent, value, expected)
+
+    def test_equivocate_mirror_spreads_its_round_base(
+        self, events_enabled
+    ):
+        """The scripted equivocate mirror proposes ``lo + round mod
+        span`` as its base, and the exchange layer spreads it: every
+        value an equivocating sender delivered in round r is a
+        per-receiver offset of that base (equivocation_value over some
+        receiver index)."""
+        records = _run_scenario_game("equivocation-split", events_enabled)
+        start = next(r for r in records if r["event"] == "game_start")
+        lo, hi = start["value_range"]
+        span = hi - lo + 1
+        byz = {agent for _, agent, _ in _byzantine_decisions(records)}
+        assert byz
+        for rnd, agent, value in _byzantine_decisions(records):
+            assert value == lo + rnd % span, (rnd, agent, value)
+        n = int(start["num_honest"]) + int(start["num_byzantine"])
+        allowed = {
+            (rnd, lo + (rnd % span + i) % span)
+            for rnd in range(1, 1 + int(start["max_rounds"]))
+            for i in range(n)
+        }
+        for r in records:
+            if r["event"] != "deliveries" or r.get("values") is None:
+                continue
+            for sender, value in zip(r["senders"], r["values"]):
+                if sender in byz:
+                    assert (r["round"], value) in allowed, (r, sender)
+
+
+# ------------------------------------------------------------- gate-backed
+
+
+def _load_gate():
+    spec = importlib.util.spec_from_file_location("perf_gate_scn", GATE)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def scenarios_gate():
+    mod = _load_gate()
+    measured = mod.run_scenarios_scenario()
+    return mod, measured
+
+
+class TestScenariosGate:
+    def test_scenario_green_at_head(self, scenarios_gate):
+        mod, measured = scenarios_gate
+        findings = mod.check_metrics(measured, mod.load_baseline())
+        findings += mod.check_stale(
+            measured, mod.load_baseline(), ("scenarios",)
+        )
+        assert findings == [], "\n".join(findings)
+
+    def test_measures_the_advertised_metrics(self, scenarios_gate):
+        _, measured = scenarios_gate
+        for name in (
+            "scenarios.influence_disrupt",
+            "scenarios.influence_clique",
+            "scenarios.influence_adaptive",
+            "scenarios.influence_equivocate",
+            "scenarios.equivocation_divergence_rows",
+            "scenarios.offstrategy_divergence_rows",
+            "scenarios.clique_shared_target_agreement",
+            "scenarios.strategies_covered",
+            "scenarios.error_rows",
+        ):
+            assert name in measured, sorted(measured)
+
+    def test_equivocation_diverges_and_nothing_else_does(
+        self, scenarios_gate
+    ):
+        """ISSUE acceptance: per-receiver divergence >= 1 under the
+        equivocate strategy and EXACTLY 0 everywhere else (the all-off
+        equivocators mask reduces to a plain broadcast)."""
+        _, measured = scenarios_gate
+        assert measured["scenarios.equivocation_divergence_rows"] >= 1
+        assert measured["scenarios.offstrategy_divergence_rows"] == 0
+
+    def test_clique_holds_its_shared_target(self, scenarios_gate):
+        _, measured = scenarios_gate
+        assert measured["scenarios.clique_shared_target_agreement"] == 1.0
+
+    def test_removing_entry_resurfaces_unbaselined_failure(
+        self, scenarios_gate
+    ):
+        mod, measured = scenarios_gate
+        baseline = mod.load_baseline()
+        pruned = {
+            "metrics": {
+                k: v for k, v in baseline["metrics"].items()
+                if k != "scenarios.equivocation_divergence_rows"
+            }
+        }
+        findings = mod.check_metrics(measured, pruned)
+        assert any(
+            "scenarios.equivocation_divergence_rows" in f and "no entry" in f
+            for f in findings
+        ), findings
+
+    def test_scenarios_off_injection_fails_naming_metrics(self):
+        mod = _load_gate()
+        measured = mod.run_scenarios_scenario("scenarios-off")
+        findings = mod.check_metrics(measured, mod.load_baseline())
+        named = "\n".join(findings)
+        for metric in (
+            "scenarios.influence_disrupt",
+            "scenarios.influence_clique",
+            "scenarios.equivocation_divergence_rows",
+            "scenarios.clique_shared_target_agreement",
+            "scenarios.strategies_covered",
+        ):
+            assert metric in named, (metric, findings)
