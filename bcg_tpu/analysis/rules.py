@@ -784,7 +784,8 @@ _OBS_PREFIX_RE = re.compile(r"^[a-z][a-z0-9_]*\.")
 # new subsystem is a deliberate registry decision, not a call-site
 # spelling.  Extend HERE (and the DESIGN.md table) when one is added.
 _OBS_SUBSYSTEMS = frozenset(
-    {"engine", "serve", "game", "hbm", "kvpool", "fleet", "sweep", "chaos"}
+    {"engine", "serve", "game", "hbm", "kvpool", "fleet", "sweep", "chaos",
+     "alert"}
 )
 _OBS_CALL_ATTRS = {
     "inc", "counter", "gauge", "set_gauge", "value", "histogram", "observe",
@@ -854,7 +855,7 @@ def rule_obs_name(ctx: ModuleContext) -> Iterable[Finding]:
     ("Serve.Requests", a bare "requests") fragments the namespace every
     dashboard and baseline keys on.  The leading segment must also be a
     REGISTERED subsystem (``_OBS_SUBSYSTEMS`` — engine/serve/game/hbm/
-    kvpool/fleet/sweep/chaos): an unknown subsystem is a namespace fork the
+    kvpool/fleet/sweep/chaos/alert): an unknown subsystem is a namespace fork the
     fleet shard merge and every dashboard would silently split on.  Literal
     names are checked whole; f-string names have their static fragments
     checked (the leading fragment must carry the subsystem prefix);

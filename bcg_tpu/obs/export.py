@@ -43,6 +43,24 @@ from bcg_tpu.runtime import envflags, resilience
 _NAME_PREFIX = "bcg_"
 _INVALID_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
 
+# Optional extra exposition blocks (labeled sample families the
+# label-free registry can't carry, e.g. the alert plane's
+# bcg_alert_firing{rule=...}).  None — the default, and the alerts-off
+# state — keeps render_prometheus byte-identical to the provider-free
+# form; bcg_tpu/obs/alerts.py installs its provider only while an
+# engine is live.
+_extra_blocks_provider = None
+_provider_lock = threading.Lock()
+
+
+def set_extra_blocks_provider(provider) -> None:
+    """Install (or, with None, remove) a ``labels -> [(metric_name,
+    [exposition lines])]`` callback merged into every rendered
+    exposition."""
+    global _extra_blocks_provider
+    with _provider_lock:
+        _extra_blocks_provider = provider
+
 
 def prometheus_name(registry_name: str, counter: bool = False) -> str:
     """Dotted registry name -> Prometheus metric name
@@ -122,6 +140,9 @@ def render_prometheus(typed: Optional[Dict[str, Dict[str, Any]]] = None,
         lines.append(f"{metric}_count{wrap} "
                      f"{_format_value(hist.get('count', 0))}")
         blocks.append((metric, lines))
+    provider = _extra_blocks_provider
+    if provider is not None:
+        blocks.extend(provider(labels))
     out = []
     for _, lines in sorted(blocks, key=lambda b: b[0]):
         out.extend(lines)
@@ -344,7 +365,23 @@ def start_http_server(port: int) -> Tuple[Any, int]:
 
     class _Handler(BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 (stdlib casing)
-            if self.path.split("?")[0] not in ("/metrics", "/"):
+            path = self.path.split("?")[0]
+            if path in ("/healthz", "/readyz"):
+                # Lazy import: alerts imports this module for its
+                # EventSink, so the reverse edge stays request-time.
+                from bcg_tpu.obs import alerts as obs_alerts
+
+                ok, detail = (obs_alerts.health() if path == "/healthz"
+                              else obs_alerts.readiness())
+                body = (json.dumps(detail, sort_keys=True) + "\n"
+                        ).encode("utf-8")
+                self.send_response(200 if ok else 503)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            if path not in ("/metrics", "/"):
                 self.send_response(404)
                 self.end_headers()
                 return

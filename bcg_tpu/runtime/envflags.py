@@ -301,6 +301,29 @@ _register(
     "feeding the serve.slo.violations counter and the "
     "serve.slo.headroom_ms histogram (0 = no SLO tracking).",
 )
+# BCG_TPU_ALERT* — health & alerting plane (bcg_tpu/obs/alerts.py).
+_register(
+    "BCG_TPU_ALERTS", "bool", False,
+    "Rule-driven alert engine (bcg_tpu/obs/alerts.py): a periodic "
+    "evaluator thread checks the default ruleset (SLO burn-rate, "
+    "engine-error/retrace storms, pool-headroom floor, heartbeat "
+    "staleness, ...) against ONE registry snapshot per cycle, counts "
+    "firing/resolved transitions under alert.*, exports "
+    "alert_firing{rule=...} on the Prometheus exposition, and feeds "
+    "the /healthz page-severity verdict.  Off: zero surface — nothing "
+    "registered, no threads.",
+)
+_register(
+    "BCG_TPU_ALERT_MS", "int", 1000,
+    "Alert-rule evaluation period in milliseconds (delta-rate and "
+    "burn-rate rules measure per-window deltas at this cadence).",
+)
+_register(
+    "BCG_TPU_ALERT_EVENTS", "str", None,
+    "Append alert firing/resolved transition events as JSONL to this "
+    "path (first line = run manifest; scripts/alert_report.py merges "
+    "one or many such files into a fleet firing timeline).",
+)
 
 # BCG_TPU_SERVE_* — continuous-batching serving subsystem (bcg_tpu/serve).
 _register(

@@ -142,6 +142,22 @@ def publish_compile_obs(snapshot: Optional[Dict]) -> None:
     LAST_COMPILE_OBS = snapshot
 
 
+# Latest alert-engine summary (obs/alerts.AlertEngine.summary: rules
+# evaluated, fired/resolved transition counts, flaps, currently-firing
+# rule names) — published at every evaluation cycle so bench.py can
+# attach the alerting verdict on success AND error paths, mirroring
+# LAST_SERVE_STATS.  None until an engine evaluates (i.e. always None
+# unless BCG_TPU_ALERTS is set).
+LAST_ALERTS: Optional[Dict] = None
+
+
+def publish_alerts(snapshot: Optional[Dict]) -> None:
+    """Record the most recent alert-engine summary (called by
+    ``obs.alerts.AlertEngine.publish``)."""
+    global LAST_ALERTS
+    LAST_ALERTS = snapshot
+
+
 def _device_memory():
     """(bytes_in_use, peak_bytes_in_use) as the MAX across all devices,
     or (None, None) where the backend exposes no allocator stats (CPU).

@@ -45,6 +45,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from bcg_tpu.obs import (
+    alerts as obs_alerts,
     compile as obs_compile,
     counters as obs_counters,
     export as obs_export,
@@ -606,6 +607,10 @@ class Scheduler:
         self._queue: List[Request] = []
         self._queue_rows = 0
         self._closed = False
+        # True between a hang-watchdog engine rebuild and the first
+        # dispatch the fresh engine completes — the /readyz "hang
+        # window".  Only the dispatch thread writes it.
+        self._engine_unready = False
         # Multi-tenant scheduling (games-as-tenants, bcg_tpu/sweep):
         # empty = every request rides the anonymous default tenant and
         # dispatch order is byte-identical to the single-tenant
@@ -634,6 +639,18 @@ class Scheduler:
         # disabled; a FakeEngine serving run is scrapeable/shardable too.
         obs_export.maybe_start_http_server()
         obs_fleet.maybe_start_shard_writer()
+        # Health & alerting plane (BCG_TPU_ALERTS, bcg_tpu/obs/alerts.py):
+        # start the rule evaluator (no-op when off) and hook this
+        # scheduler's lifecycle into the readiness state behind /readyz —
+        # booted+accepting now, unready across the hang window /
+        # EngineDead, shed-worthy at the backpressure watermark (pull
+        # probe: sampled at request time, not evented).
+        obs_alerts.maybe_start()
+        obs_alerts.mark_ready("scheduler")
+        obs_alerts.mark_ready("engine")
+        obs_alerts.register_readiness_probe(
+            "backpressure", self._backpressure_probe
+        )
 
     # -------------------------------------------------------------- tenancy
 
@@ -1112,6 +1129,11 @@ class Scheduler:
                 time.sleep(resilience.backoff_s(
                     attempt - 1, rng=self._retry_rng
                 ))
+        if self._engine_unready:
+            # First completed dispatch on the rebuilt engine: the
+            # /readyz hang window closes here.
+            self._engine_unready = False
+            obs_alerts.mark_ready("engine")
         device_ms = round(device_s * 1e3, 3)
         self.stats.record_device_time(device_s)
         slo_violations = 0
@@ -1177,6 +1199,10 @@ class Scheduler:
         their submitters discovering a dead thread one liveness probe
         at a time.  (``close()`` can still be called later; it joins a
         thread that has already exited.)"""
+        # /readyz: an EngineDead verdict is a standing veto (close()
+        # clears it — a test's retired scheduler should not pin the
+        # process unready forever).
+        obs_alerts.mark_unready("scheduler", f"engine dead: {err}")
         with self._cond:
             if self._closed:
                 return
@@ -1189,6 +1215,18 @@ class Scheduler:
             self._queue = []
             self._queue_rows = 0
             self._cond.notify_all()
+
+    def _backpressure_probe(self) -> Optional[str]:
+        """Read-only /readyz pull probe: unready at (or above) the
+        admission watermark so a front door sheds load before queueing
+        behind it (advisory peek — no lock, the ints are written under
+        ``self._cond`` and read here at most one admission stale)."""
+        if self._closed:
+            return "scheduler closed"
+        if self._queue_rows >= self._max_queue_rows:
+            return (f"backpressure: {self._queue_rows} queued rows at "
+                    f"the {self._max_queue_rows}-row watermark")
+        return None
 
     def _device_call(self, sig: Tuple, merged: List, temperature, max_tokens,
                      n_requests: int, anchor):
@@ -1315,6 +1353,12 @@ class Scheduler:
         self._device_lock = threading.Lock()
         self._engine = self._engine_factory()
         obs_counters.inc("serve.engine_rebuilds")
+        # /readyz hang window opens at the watchdog verdict; the first
+        # dispatch the fresh engine completes closes it (_dispatch).
+        self._engine_unready = True
+        obs_alerts.mark_unready(
+            "engine", "device call hung; engine rebuilt, retry pending"
+        )
         return EngineHung(
             f"device call exceeded the {self._watchdog_s:g}s watchdog; "
             "engine rebuilt, dispatch will be retried"
@@ -1387,3 +1431,8 @@ class Scheduler:
             self._cond.notify_all()
         self._thread.join(timeout=timeout)
         self._publish_stats()
+        # Unhook this scheduler from the /readyz state: a closed
+        # scheduler is not "unready", it is GONE — the next boot
+        # re-registers and starts clean (clears a _declare_dead veto
+        # too; a dead production process never reaches close()).
+        obs_alerts.clear_readiness("scheduler", "engine", "backpressure")

@@ -176,6 +176,13 @@ _CONFIG_OVERRIDE_ENVS = (
     # topology, and channel — a registry-driven run measures a
     # different game than the default config.
     "BCG_TPU_SCENARIO",
+    # Alerting plane: the evaluator thread snapshots the registry every
+    # BCG_TPU_ALERT_MS inside the measured window (in-window overhead,
+    # like BCG_TPU_PROFILE), and the JSONL sink adds a drainer thread —
+    # an alerting run is not a default-config number.  BCG_TPU_ALERT_MS
+    # itself stays out: a period knob on an already-declared override,
+    # same reasoning as BCG_TPU_METRICS_SHARD_MS.
+    "BCG_TPU_ALERTS", "BCG_TPU_ALERT_EVENTS",
     # BCG_TPU_RUN_ID / BCG_TPU_METRICS_SHARD_MS stay out: a run label
     # and a flush period are provenance/measurement knobs, not a change
     # to the served configuration.  BCG_TPU_SWEEP_DIR stays out for the
@@ -312,6 +319,22 @@ def _compile_stats_or_none():
         from bcg_tpu.runtime import metrics as _metrics
 
         return _metrics.LAST_COMPILE_OBS
+    except Exception:
+        # Inside the never-rc=1 contract (see _obs_payload).
+        return None
+
+
+def _alerts_stats_or_none():
+    """Alert-engine verdict for the window (rules evaluated, fired/
+    resolved transition counts, flaps, currently-firing rules) when
+    BCG_TPU_ALERTS evaluated it; None otherwise.  Read from
+    runtime.metrics (not the engine object) so the ERROR path — where
+    no engine handle survives — keeps the last published verdict: a
+    crash with `engine_errors` firing is the whole point of the plane."""
+    try:
+        from bcg_tpu.runtime import metrics as _metrics
+
+        return _metrics.LAST_ALERTS
     except Exception:
         # Inside the never-rc=1 contract (see _obs_payload).
         return None
@@ -478,6 +501,12 @@ def _error_result(exc: BaseException, retried: bool) -> dict:
     fault_stats = _fault_stats_or_none()
     if fault_stats:
         out["faults"] = fault_stats
+    # Alerting verdict of the failed attempt (what fired before the
+    # death, what never resolved) — the timeline a post-mortem starts
+    # from.
+    alerts_stats = _alerts_stats_or_none()
+    if alerts_stats:
+        out["alerts"] = alerts_stats
     # Boot-phase breakdown of the failed attempt (engine boots record
     # into runtime.metrics.LAST_BOOT_PHASES even when construction
     # dies mid-phase): a RESOURCE_EXHAUSTED error line now names the
@@ -915,6 +944,10 @@ def _run_attempt(cfg, model: str, backend: str, concurrency: int,
             # profile (corrupted responses + chaos seams fired); None
             # when neither injector ran.
             "faults": _fault_stats_or_none(),
+            # BCG_TPU_ALERTS: alert-engine verdict (rules evaluated,
+            # fired/resolved counts, flaps, still-firing rules); None
+            # when the evaluator is off.
+            "alerts": _alerts_stats_or_none(),
             "window_decode_steps": window_steps,
             "window_failed_row_fraction": round(failed_fraction, 4),
             "baseline_denominator_dec_per_sec": (

@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Merge per-rank alert JSONL streams into one firing timeline.
+
+Input files are ``BCG_TPU_ALERT_EVENTS`` sinks (first line = run
+manifest, then one record per firing/resolved transition) from any
+number of ranks and runs — plus alert-shaped records other tools emit
+into the same schema (``scripts/bench_trajectory.py --alert-out``
+writes its rc-2 perf regressions this way, so cross-run regressions
+and runtime alerts land on ONE timeline).
+
+Output: a chronological transition timeline (one line per event,
+stamped with run id, rank, severity) followed by a per-run/rule
+summary (fired / resolved / still-firing counts, flap detection — a
+rule that fired again after resolving).
+
+Deliberately import-free of bcg_tpu (stdlib only): must run on a
+laptop against files scp'd from a fleet.  Torn tail lines (a rank
+killed mid-write) are skipped, like every other sink reader here.
+
+Usage:
+  python scripts/alert_report.py alerts-*.jsonl
+  python scripts/alert_report.py --severity page merged/*.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Any, Dict, List
+
+SEVERITY_ORDER = {"info": 0, "warn": 1, "page": 2}
+
+
+def load_records(paths: List[str]) -> List[Dict[str, Any]]:
+    """Parse every file: each record is annotated with the run id and
+    rank its file's manifest header declared (``?`` when a file has no
+    manifest — e.g. a stream still being written, or a tool that emits
+    bare alert records)."""
+    records: List[Dict[str, Any]] = []
+    for path in paths:
+        run_id, rank = "?", "?"
+        try:
+            fh = open(path, encoding="utf-8")
+        except OSError as exc:
+            print(f"alert_report: cannot read {path}: {exc}",
+                  file=sys.stderr)
+            continue
+        with fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # torn tail: a rank died mid-write
+                if rec.get("event") == "manifest":
+                    run_id = str(rec.get("run_id", "?"))
+                    rank = rec.get("process_index", "?")
+                    continue
+                if rec.get("event") != "alert":
+                    continue
+                rec.setdefault("run_id", run_id)
+                rec.setdefault("rank", rank)
+                records.append(rec)
+    records.sort(key=lambda r: (r.get("ts", 0), str(r.get("rule", ""))))
+    return records
+
+
+def render_timeline(records: List[Dict[str, Any]]) -> str:
+    lines = ["== alert timeline =="]
+    for r in records:
+        ts = r.get("ts")
+        stamp = (time.strftime("%H:%M:%S", time.gmtime(ts))
+                 + f".{int((ts % 1) * 1000):03d}") if ts else "??:??:??"
+        arrow = "FIRING " if r.get("state") == "firing" else "resolved"
+        value = r.get("value")
+        val = f" value={value}" if value is not None else ""
+        lines.append(
+            f"{stamp}  run={r.get('run_id')} rank={r.get('rank')} "
+            f"[{r.get('severity', '?'):<4}] {arrow} {r.get('rule')}"
+            f"{val}  {r.get('summary', '')}".rstrip()
+        )
+    return "\n".join(lines)
+
+
+def summarize(records: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Per (run, rule, severity) rollup in firing order: fired/resolved
+    counts, whether the rule is STILL firing at its stream's end, and
+    flaps (re-fires after a resolve — the debounce's failure mode)."""
+    rollup: Dict[Any, Dict[str, Any]] = {}
+    for r in records:
+        key = (r.get("run_id"), r.get("rank"), r.get("rule"),
+               r.get("severity"))
+        row = rollup.setdefault(key, {
+            "run_id": key[0], "rank": key[1], "rule": key[2],
+            "severity": key[3], "fired": 0, "resolved": 0, "flaps": 0,
+            "firing_now": False,
+        })
+        if r.get("state") == "firing":
+            if row["fired"]:
+                row["flaps"] += 1
+            row["fired"] += 1
+            row["firing_now"] = True
+        elif r.get("state") == "resolved":
+            row["resolved"] += 1
+            row["firing_now"] = False
+    return sorted(
+        rollup.values(),
+        key=lambda row: (-SEVERITY_ORDER.get(row["severity"], -1),
+                         str(row["run_id"]), str(row["rule"])),
+    )
+
+
+def render_summary(rows: List[Dict[str, Any]]) -> str:
+    lines = ["== per-run rule summary =="]
+    for row in rows:
+        state = "STILL FIRING" if row["firing_now"] else "all resolved"
+        flap = f", {row['flaps']} flap(s)" if row["flaps"] else ""
+        lines.append(
+            f"run={row['run_id']} rank={row['rank']} "
+            f"[{row['severity']:<4}] {row['rule']}: "
+            f"{row['fired']} fired / {row['resolved']} resolved "
+            f"({state}{flap})"
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Merge alert JSONL files into one firing timeline."
+    )
+    parser.add_argument("paths", nargs="+",
+                        help="alert JSONL files (any ranks, any runs)")
+    parser.add_argument("--severity", choices=sorted(SEVERITY_ORDER),
+                        help="only transitions at (or above) this severity")
+    args = parser.parse_args(argv)
+    records = load_records(args.paths)
+    if args.severity:
+        floor = SEVERITY_ORDER[args.severity]
+        records = [r for r in records
+                   if SEVERITY_ORDER.get(r.get("severity"), -1) >= floor]
+    if not records:
+        print("alert_report: no alert transitions in "
+              f"{len(args.paths)} file(s)")
+        return 0
+    print(render_timeline(records))
+    print()
+    rows = summarize(records)
+    print(render_summary(rows))
+    still = [row for row in rows if row["firing_now"]]
+    if still:
+        print()
+        print(f"({len(still)} rule(s) still firing at stream end)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

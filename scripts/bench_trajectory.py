@@ -37,6 +37,7 @@ import glob
 import json
 import os
 import sys
+import time
 from typing import Dict, List, Optional, Tuple
 
 # Metrics trended when present: (label, extractor) over the parsed
@@ -220,6 +221,13 @@ def main(argv=None) -> int:
     parser.add_argument("--threshold", type=float, default=0.7,
                         help="regression threshold as a fraction of "
                         "best-known-good (default 0.7)")
+    parser.add_argument("--alert-out", metavar="PATH",
+                        help="also append each regression as an "
+                        "alert-shaped JSONL record (the "
+                        "BCG_TPU_ALERT_EVENTS sink schema) so "
+                        "cross-run perf regressions merge into one "
+                        "scripts/alert_report.py timeline with "
+                        "runtime alerts")
     args = parser.parse_args(argv)
     paths = collect_paths(args.paths)
     if not paths:
@@ -238,7 +246,35 @@ def main(argv=None) -> int:
     findings = find_regressions(runs, args.threshold)
     for f in findings:
         print(f"BENCH REGRESSION: {f}", file=sys.stderr)
+    if findings and args.alert_out:
+        try:
+            write_alert_records(args.alert_out, findings)
+        except OSError as exc:
+            print(f"bench_trajectory: cannot write {args.alert_out}: "
+                  f"{exc}", file=sys.stderr)
     return 2 if findings else 0
+
+
+def write_alert_records(path: str, findings: List[str]) -> None:
+    """Append the rc-2 verdict in the BCG_TPU_ALERT_EVENTS sink shape
+    (manifest header + one firing record per regression) — hand-rolled
+    by value, NOT imported from bcg_tpu.obs.export: this script stays
+    import-free so it runs on a laptop against scp'd files.  No
+    resolved record is ever written: a cross-run perf regression stays
+    firing on the alert_report timeline until a newer trajectory run
+    clears it (by simply not re-emitting)."""
+    now = time.time()
+    with open(path, "a", encoding="utf-8") as fh:
+        fh.write(json.dumps({
+            "ts": now, "event": "manifest", "schema_version": 1,
+            "run_id": "bench-trajectory", "kind": "bench",
+        }) + "\n")
+        for f in findings:
+            fh.write(json.dumps({
+                "ts": now, "event": "alert", "rule": "bench_regression",
+                "severity": "page", "state": "firing", "kind": "trend",
+                "value": None, "summary": f,
+            }) + "\n")
 
 
 if __name__ == "__main__":

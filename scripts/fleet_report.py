@@ -368,18 +368,33 @@ def render_watch(run: str, records: List[Dict[str, Any]],
     ref_ms = max(heartbeats, default=0.0)
     lines = [f"== run {run}: liveness ({len(records)} rank(s), "
              f"straggler factor {factor:g}) =="]
-    lines.append(f"{'rank':<24}  {'watermark':>9}  {'hb_age_ms':>10}  status")
+    lines.append(f"{'rank':<24}  {'watermark':>9}  {'hb_age_ms':>10}  "
+                 f"{'alerts':<20}  status")
     for rec, hb in zip(records, heartbeats):
         ident = rec.get("identity") or {}
         proc = ident.get("process_index")
-        watermark = float((rec.get("gauges") or {}).get("fleet.watermark", 0))
+        gauges = rec.get("gauges") or {}
+        watermark = float(gauges.get("fleet.watermark", 0))
         age = f"{ref_ms - hb:.0f}" if hb else "-"
+        # Firing alerts ride the shard plane as alert.firing.<rule>
+        # gauges (BCG_TPU_ALERTS; absent rank-side = '-', present but
+        # all zero = 'none').
+        firing = sorted(
+            n[len("alert.firing."):] for n, v in gauges.items()
+            if n.startswith("alert.firing.") and v
+        )
+        if firing:
+            alerts = ",".join(firing)
+        else:
+            alerts = ("none" if any(n.startswith("alert.firing.")
+                                    for n in gauges) else "-")
         hit = flagged_by_proc.get(proc)
         status = (
             f"STRAGGLER ({'+'.join(hit['reasons'])})" if hit else "ok"
         )
         lines.append(
-            f"{_rank_label(rec):<24}  {watermark:>9g}  {age:>10}  {status}"
+            f"{_rank_label(rec):<24}  {watermark:>9g}  {age:>10}  "
+            f"{alerts:<20}  {status}"
         )
     return "\n".join(lines), bool(flagged)
 

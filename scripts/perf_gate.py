@@ -51,12 +51,16 @@ from typing import Dict, List, Optional
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# "alerts" stays LAST: its oracle arm resets the counter registry to
+# kill absolute-gauge leftovers (headroom, heartbeats, stragglers)
+# that earlier scenarios legitimately leave behind.
 SCENARIOS = ("serve", "engine", "paged", "sampler", "int4", "consensus",
              "fleet", "hostsync", "megaround", "compile", "sweep", "chaos",
-             "scenarios", "hlo")
+             "scenarios", "hlo", "alerts")
 REGRESSIONS = ("none", "spec-off", "fail-rows", "events-off",
                "straggler-off", "hostsync-off", "compile-off",
-               "fairness-off", "chaos-off", "scenarios-off")
+               "fairness-off", "chaos-off", "scenarios-off",
+               "alerts-off")
 
 DECISION = {
     "type": "object",
@@ -1623,6 +1627,214 @@ def run_hlo_scenario(inject: str = "none") -> Dict[str, float]:
     return {"hlo.census_drift_findings": float(len(findings))}
 
 
+def run_alerts_scenario(inject: str = "none") -> Dict[str, float]:
+    """Health & alerting plane gates (bcg_tpu/obs/alerts.py) driven
+    over the chaos scenario's serve recipe — the evaluator watches a
+    run the gate KNOWS contains exactly 3 faults (crash at dispatch
+    pass 2, 4s hang at pass 4, PoolExhausted at pass 6), with the
+    periodic thread parked (BCG_TPU_ALERT_MS=1h) so every evaluation
+    cycle is driven explicitly and firing windows are deterministic:
+
+    * oracle arm — a fault-free FakeEngine serving run under manual
+      evaluation cycles: ``false_positives`` 0 EXACT (a quiet healthy
+      process may not alert; threshold rules read ABSOLUTE gauges, so
+      this arm starts from a reset registry — see SCENARIOS comment).
+    * chaos arm — the crash+hang+exhaust run with one evaluation cycle
+      per wave: the expected recovery rules (engine_errors,
+      engine_rebuilt, dispatch_retries) each fire exactly once
+      (``chaos_alerts_fired`` floored at the injected-fault count,
+      ``fault_coverage`` >= 1), every episode resolves on the
+      post-close quiet cycles (``unresolved_at_end`` 0, ``flaps`` 0 —
+      a condition spanning consecutive cycles is ONE episode), no
+      unexpected rule fires, ``health()`` flips failing while the
+      engine_errors page alert is up and back (``healthz_flip`` 1),
+      readiness flips unready INSIDE the hang window and back — read
+      from the pushed transition history, no polling race
+      (``readyz_flip`` 1) — and the JSONL alert stream's record counts
+      match the engine's fired/resolved totals (``event_stream_ok``).
+
+    ``alerts-off`` injection unsets BCG_TPU_ALERTS: the same faulted
+    run evaluates NOTHING and the gate must FAIL naming
+    rules_evaluated / chaos_alerts_fired / fault_coverage /
+    healthz_flip / event_stream_ok rather than pass vacuously (zero
+    observed faults means zero alerting evidence, not green alerting).
+    readyz_flip stays 1 by DESIGN: readiness is plain module state the
+    scheduler pushes regardless of the alerting flag."""
+    import tempfile
+
+    from bcg_tpu.engine.fake import FakeEngine
+    from bcg_tpu.obs import alerts as obs_alerts
+    from bcg_tpu.obs import counters as obs_counters
+    from bcg_tpu.runtime import resilience
+    from bcg_tpu.serve.scheduler import Scheduler
+
+    alerts_on = inject != "alerts-off"
+    # Save/restore the RAW values (None vs "") — registry accessors
+    # cannot round-trip "was unset".
+    prior_alerts = os.environ.get("BCG_TPU_ALERTS")  # lint: ignore[BCG-ENV-RAW]
+    prior_ms = os.environ.get("BCG_TPU_ALERT_MS")  # lint: ignore[BCG-ENV-RAW]
+    prior_events = os.environ.get("BCG_TPU_ALERT_EVENTS")  # lint: ignore[BCG-ENV-RAW]
+    prior_chaos = os.environ.get("BCG_TPU_CHAOS")  # lint: ignore[BCG-ENV-RAW]
+
+    events_path = os.path.join(
+        tempfile.mkdtemp(prefix="bcg-alert-gate-"), "alerts.jsonl"
+    )
+    if alerts_on:
+        os.environ["BCG_TPU_ALERTS"] = "1"
+    else:
+        os.environ.pop("BCG_TPU_ALERTS", None)
+    os.environ["BCG_TPU_ALERT_MS"] = "3600000"
+    os.environ["BCG_TPU_ALERT_EVENTS"] = events_path
+    # Threshold/staleness rules read absolute registry values; earlier
+    # scenarios legitimately leave stale heartbeats / zero headroom /
+    # straggler verdicts behind.  The 0-exact false-positive pin needs
+    # a pristine registry ('alerts' runs last for this reason).
+    obs_counters.reset()
+    obs_alerts.reset()
+    obs_alerts.reset_readiness()
+    resilience.reset()
+
+    payload = [
+        ("agent system prompt",
+         "Round 2. agent_1 value: 17. agent_2 value: 17. "
+         "Your current value: 17. Decide.",
+         DECISION),
+    ] * 2
+    expected = ("engine_errors", "engine_rebuilt", "dispatch_retries")
+    saw_failing = False
+    final_ok = False
+    try:
+        # --- oracle arm: healthy traffic may not alert ----------------
+        os.environ.pop("BCG_TPU_CHAOS", None)
+        sched = Scheduler(
+            FakeEngine(seed=0, policy="consensus"),
+            linger_ms=0, bucket_rows=4, max_queue_rows=4096,
+            deadline_ms=0, strict_admission=False,
+        )
+        obs_alerts.evaluate_now()  # base snapshot: rate rules need two
+        for _ in range(2):
+            sched.submit_and_wait(
+                ("json",), list(payload), [0.0] * 2, [64] * 2
+            )
+            obs_alerts.evaluate_now()
+        sched.close()
+        obs_alerts.evaluate_now()
+        eng = obs_alerts.engine()
+        false_pos = float(eng.fired) if eng is not None else 0.0
+
+        # --- chaos arm: the PR-15 recipe, one cycle per wave ----------
+        before = obs_counters.snapshot()
+        os.environ["BCG_TPU_CHAOS"] = (
+            "seed=7;crash@serve.dispatch:2;hang@serve.dispatch:4:4.0;"
+            "exhaust@serve.dispatch:6"
+        )
+        resilience.reset()
+        sched = Scheduler(
+            FakeEngine(seed=0, policy="consensus"),
+            linger_ms=0, bucket_rows=4, max_queue_rows=4096,
+            deadline_ms=0, strict_admission=False, max_dispatch_retries=2,
+            watchdog_s=1.5,
+            engine_factory=lambda: FakeEngine(seed=0, policy="consensus"),
+        )
+        obs_alerts.evaluate_now()  # fresh base: wave deltas are wave-only
+        errors: List[BaseException] = []
+
+        def one_request():
+            try:
+                sched.submit_and_wait(
+                    ("json",), list(payload), [0.0] * 2, [64] * 2
+                )
+            except BaseException as e:  # lost futures surface as metrics
+                errors.append(e)
+
+        for _wave in range(2):
+            threads = [
+                threading.Thread(target=one_request) for _ in range(8)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            obs_alerts.evaluate_now()
+            ok, _ = obs_alerts.health()
+            saw_failing = saw_failing or not ok
+        sched.close()
+        for _ in range(2):  # quiet cycles: every episode must resolve
+            obs_alerts.evaluate_now()
+        final_ok, _ = obs_alerts.health()
+
+        # --- verdicts (gathered before the engine is torn down) -------
+        moved = obs_counters.delta(before)
+        injected = moved.get("chaos.injected", 0)
+        if eng is not None:
+            by_rule = eng.fired_by_rule()
+            evaluations = float(eng.evaluations)
+            flaps = float(eng.flaps)
+            unresolved = float(len(eng.firing()))
+            total_fired, total_resolved = eng.fired, eng.resolved
+        else:
+            by_rule = {}
+            evaluations = flaps = unresolved = 0.0
+            total_fired = total_resolved = 0
+        chaos_fired = float(sum(by_rule.get(r, 0) for r in expected))
+        unexpected = float(total_fired) - chaos_fired - false_pos
+
+        hist = obs_alerts.readiness_history()
+        engine_flips = sum(
+            1 for h in hist if not h["ready"] and "engine" in h["reasons"]
+        )
+        readyz_flip = float(
+            engine_flips if hist and hist[-1]["ready"] else 0
+        )
+
+        # Stop the evaluator and CLOSE the sink (drains the queue) so
+        # the JSONL stream can be compared against the engine totals.
+        obs_alerts.reset()
+        firing_recs = resolved_recs = 0
+        manifest_first = False
+        try:
+            with open(events_path) as f:
+                recs = [json.loads(line) for line in f if line.strip()]
+            manifest_first = bool(recs) and recs[0].get("event") == "manifest"
+            firing_recs = sum(1 for r in recs if r.get("event") == "alert"
+                              and r.get("state") == "firing")
+            resolved_recs = sum(1 for r in recs if r.get("event") == "alert"
+                                and r.get("state") == "resolved")
+        except OSError:
+            pass  # alerts-off: no engine, no sink, no file
+        stream_ok = float(
+            manifest_first and total_fired > 0
+            and firing_recs == total_fired
+            and resolved_recs == total_resolved
+        )
+    finally:
+        for name, prior in (("BCG_TPU_ALERTS", prior_alerts),
+                            ("BCG_TPU_ALERT_MS", prior_ms),
+                            ("BCG_TPU_ALERT_EVENTS", prior_events),
+                            ("BCG_TPU_CHAOS", prior_chaos)):
+            if prior is None:
+                os.environ.pop(name, None)
+            else:
+                os.environ[name] = prior
+        obs_alerts.reset()
+        obs_alerts.reset_readiness()
+        resilience.reset()
+    if errors:
+        raise errors[0]
+    return {
+        "alerts.rules_evaluated": evaluations,
+        "alerts.chaos_alerts_fired": chaos_fired,
+        "alerts.fault_coverage": chaos_fired / max(1.0, float(injected)),
+        "alerts.false_positives": false_pos,
+        "alerts.flaps": flaps,
+        "alerts.unresolved_at_end": unresolved,
+        "alerts.unexpected_alerts": unexpected,
+        "alerts.readyz_flip": readyz_flip,
+        "alerts.healthz_flip": float(saw_failing and final_ok),
+        "alerts.event_stream_ok": stream_ok,
+    }
+
+
 _RUNNERS = {
     "serve": run_serve_scenario,
     "engine": run_engine_scenario,
@@ -1638,6 +1850,7 @@ _RUNNERS = {
     "chaos": run_chaos_scenario,
     "scenarios": run_scenarios_scenario,
     "hlo": run_hlo_scenario,
+    "alerts": run_alerts_scenario,
 }
 
 

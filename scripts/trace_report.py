@@ -121,7 +121,7 @@ def render_report(trace: dict, top: int = 20) -> str:
          if not k.startswith(("engine.hlo.", "hbm.", "engine.hostsync.",
                               "engine.compile_ms.",
                               "engine.retrace_cause.",
-                              "engine.compile_obs."))
+                              "engine.compile_obs.", "alert."))
          and _histogram_owner(k, hist_names) is None),
         key=lambda kv: (-kv[1], kv[0]),
     )[:max(0, top)]
@@ -171,6 +171,10 @@ def render_report(trace: dict, top: int = 20) -> str:
     if causes:
         lines.append("")
         lines.append(causes)
+    alert_line = alerts_section(counters)
+    if alert_line:
+        lines.append("")
+        lines.append(alert_line)
     return "\n".join(lines)
 
 
@@ -481,6 +485,30 @@ def prefill_positions(counters: Dict[str, float]) -> str:
         f"== prefill positions: {int(real)} real / {int(padded)} padded "
         f"({100.0 * real / padded:.1f}% real work) =="
     )
+
+
+def alerts_section(counters: Dict[str, float]) -> str:
+    """One-line alert-plane summary when the export carries alert.*
+    transition counters (BCG_TPU_ALERTS); '' otherwise.  The alert.*
+    family is excluded from the ranked top-counter list above — its
+    evaluation counter grows once per cycle and would crowd real event
+    counters out — so this line is where the plane surfaces."""
+    evaluations = counters.get("alert.evaluations")
+    if not evaluations:
+        return ""
+    fired = int(counters.get("alert.fired", 0))
+    resolved = int(counters.get("alert.resolved", 0))
+    flaps = int(counters.get("alert.flaps", 0))
+    firing = sorted(
+        k[len("alert.firing."):] for k, v in counters.items()
+        if k.startswith("alert.firing.") and v
+    )
+    line = (
+        f"== alerts: {fired} fired / {resolved} resolved over "
+        f"{int(evaluations)} evaluation(s), {flaps} flap(s)"
+    )
+    line += f"; firing: {', '.join(firing)} ==" if firing else " =="
+    return line
 
 
 def main(argv=None) -> int:

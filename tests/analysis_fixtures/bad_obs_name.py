@@ -1,5 +1,5 @@
 """Seeded BCG-OBS-NAME violations: metric names off the taxonomy
-(5 findings)."""
+(6 findings)."""
 from bcg_tpu.obs import counters as obs_counters
 
 
@@ -12,3 +12,6 @@ def record(entry):
     #                                               names are checked too
     obs_counters.inc("warp.requests")             # finding 5: unknown
     #                                               subsystem (namespace fork)
+    obs_counters.inc("alerts.fired")              # finding 6: the registered
+    #                                               subsystem is 'alert',
+    #                                               singular — 'alerts' forks it

@@ -14,3 +14,5 @@ def record(entry, name, account):
     obs_counters.set_gauge("fleet.heartbeat_ms", 0)         # fleet subsystem
     obs_counters.inc("sweep.jobs.completed")                # sweep subsystem
     obs_counters.inc("chaos.injected")                      # chaos subsystem
+    obs_counters.inc("alert.fired")                         # alert subsystem
+    obs_counters.set_gauge("alert.firing.slo_burn", 1)      # per-rule gauge

@@ -101,7 +101,7 @@ class TestRuleFixtures:
             "BCG-LOCK-CALL": 3,
             "BCG-TIME-WALL": 3,
             "BCG-RETRY-SLEEP": 3,
-            "BCG-OBS-NAME": 5,
+            "BCG-OBS-NAME": 6,
             "BCG-OBS-BUCKET": 3,
             # bad_lock_order.py seeds ONE two-lock inversion (the PR 15
             # device-lock-swap shape) between two thread roots.

@@ -50,6 +50,7 @@ NAMESPACE_OWNERS = {
     "sweep": "tests/test_sweep.py",
     "chaos": "tests/test_resilience.py",
     "scenarios": "tests/test_scenarios.py",
+    "alerts": "tests/test_alerts.py",
 }
 # Namespaces owned elsewhere, as the prefix tuple the measurement-match
 # tests skip (derived, not hand-maintained).

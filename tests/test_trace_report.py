@@ -340,6 +340,64 @@ def test_report_renders_compile_cost_tables(tmp_path):
     assert "retraces by cause" not in proc2.stdout
 
 
+def test_report_renders_alerts_line(tmp_path):
+    """alert.* transition counters in an export render as the one-line
+    alert-plane summary with firing rule names, AND stay out of the
+    ranked top-counter list (the crowding fix applied to the alert
+    namespace) — still with no bcg_tpu import."""
+    trace = {
+        "traceEvents": [],
+        "otherData": {"counters": {
+            "alert.evaluations": 40,
+            "alert.fired": 2,
+            "alert.resolved": 1,
+            "alert.flaps": 0,
+            "alert.rules": 12,
+            "alert.firing.engine_errors": 1,
+            "alert.firing.slo_burn": 0,
+            "serve.requests": 3,
+        }},
+    }
+    path = tmp_path / "alerts_trace.json"
+    path.write_text(json.dumps(trace))
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, str(path)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert ("== alerts: 2 fired / 1 resolved over 40 evaluation(s), "
+            "0 flap(s); firing: engine_errors ==") in proc.stdout
+    # The alert namespace never crowds the ranked counter list.
+    top_section = proc.stdout.split("top counters")[1].split("\n==")[0]
+    assert "serve.requests" in top_section
+    assert "alert." not in top_section
+    # No alert counters -> no line; resolved-quiet exports drop the
+    # firing suffix.
+    bare = tmp_path / "bare6.json"
+    bare.write_text(json.dumps(
+        {"traceEvents": [], "otherData": {"counters": {"serve.requests": 1}}}
+    ))
+    proc2 = subprocess.run(
+        [sys.executable, SCRIPT, str(bare)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert "== alerts:" not in proc2.stdout
+    quiet = tmp_path / "quiet.json"
+    quiet.write_text(json.dumps({
+        "traceEvents": [],
+        "otherData": {"counters": {"alert.evaluations": 5,
+                                   "alert.fired": 1,
+                                   "alert.resolved": 1,
+                                   "alert.firing.slo_burn": 0}},
+    }))
+    proc3 = subprocess.run(
+        [sys.executable, SCRIPT, str(quiet)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert ("== alerts: 1 fired / 1 resolved over 5 evaluation(s), "
+            "0 flap(s) ==") in proc3.stdout
+
+
 def test_report_handles_empty_trace(tmp_path):
     empty = tmp_path / "empty.json"
     empty.write_text(json.dumps({"traceEvents": [], "otherData": {}}))
